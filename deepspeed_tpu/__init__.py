@@ -1,0 +1,40 @@
+"""deepspeed_tpu — a TPU-native distributed training & inference framework.
+
+Capability-parity rebuild of DeepSpeed (reference: /root/reference, v0.11.2) designed
+TPU-first: compiled SPMD over a `jax.sharding.Mesh` instead of a hook-driven eager
+runtime. The public surface mirrors the reference's top-level API
+(`deepspeed/__init__.py:64` initialize, `:269` init_inference, `:246`
+add_config_arguments) so users of the reference can switch with minimal friction.
+"""
+
+__version__ = "0.1.0"
+version = __version__
+
+from deepspeed_tpu.config.core import TpuTrainConfig
+from deepspeed_tpu.runtime.engine import Engine, initialize
+from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
+from deepspeed_tpu import comm
+from deepspeed_tpu.utils.logging import logger, log_dist
+from deepspeed_tpu.platform import get_accelerator
+
+from deepspeed_tpu.runtime.arguments import add_config_arguments
+
+
+def _get_monitor():  # lazy to keep import light
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+    return MonitorMaster
+
+
+__all__ = [
+    "initialize",
+    "init_inference",
+    "add_config_arguments",
+    "Engine",
+    "InferenceEngine",
+    "TpuTrainConfig",
+    "comm",
+    "logger",
+    "log_dist",
+    "get_accelerator",
+    "__version__",
+]

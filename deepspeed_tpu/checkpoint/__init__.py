@@ -1,0 +1,1 @@
+from deepspeed_tpu.checkpoint.saver import save_checkpoint, load_checkpoint, get_latest_tag

@@ -1,0 +1,10 @@
+from deepspeed_tpu.config.core import (
+    TpuTrainConfig,
+    ConfigModel,
+    AUTO,
+    ZeroConfig,
+    Fp16Config,
+    Bf16Config,
+    MeshConfig,
+    OffloadDeviceEnum,
+)

@@ -1,0 +1,2 @@
+from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
+from deepspeed_tpu.inference.config import TpuInferenceConfig

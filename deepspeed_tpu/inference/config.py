@@ -1,0 +1,42 @@
+"""Inference config — analog of `DeepSpeedInferenceConfig` (`inference/config.py`)."""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.config.core import ConfigModel
+
+
+@dataclass
+class QuantConfig(ConfigModel):
+    enabled: bool = False
+    bits: int = 8
+    group_size: int = 64
+
+
+@dataclass
+class TensorParallelConfig(ConfigModel):
+    tp_size: int = 1
+    enabled: bool = True
+
+
+@dataclass
+class TpuInferenceConfig(ConfigModel):
+    dtype: str = "bfloat16"
+    tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
+    max_out_tokens: int = 1024
+    max_tokens: Optional[int] = None
+    min_out_tokens: int = 1
+    replace_with_kernel_inject: bool = True   # on TPU: use pallas decode kernels
+    quant: QuantConfig = field(default_factory=QuantConfig)
+    checkpoint: Optional[str] = None
+    max_batch_size: int = 8
+    # decoding
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    greedy: bool = True
+    eos_token_id: Optional[int] = None
+    # moe inference
+    moe: Dict[str, Any] = field(default_factory=dict)
+    # kv cache
+    kv_cache_dtype: str = "bfloat16"

@@ -1,0 +1,154 @@
+"""Inference engine.
+
+Analog of `InferenceEngine` (`inference/engine.py:39`) + `deepspeed.init_inference`
+(`deepspeed/__init__.py:269`). The reference swaps HF modules for fused CUDA blocks
+(kernel injection, `module_inject/replace_module.py:182`) or auto-shards linears
+(AutoTP, `module_inject/auto_tp.py:175`); the TPU-native equivalent compiles a
+decode step with a static-shape KV cache and shards it over the `tensor` mesh axis.
+
+A model for inference is a `DecodeModelSpec`:
+  * `prefill_fn(params, tokens, cache) -> (logits, cache)`
+  * `decode_fn(params, token, pos, cache) -> (logits, cache)`
+  * `init_cache(batch, max_len)` -> KV cache pytree
+The model zoo (deepspeed_tpu.models) provides these for GPT-2/LLaMA-style nets;
+the adapters in inference/adapters.py build them from HF checkpoints (the
+"containers" role, `module_inject/containers/*`).
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu import comm
+from deepspeed_tpu.inference.config import TpuInferenceConfig
+from deepspeed_tpu.utils.logging import logger, log_dist
+from deepspeed_tpu.utils.tree import tree_cast
+
+
+@dataclasses.dataclass
+class DecodeModelSpec:
+    prefill_fn: Callable       # (params, tokens[B,T], cache, pad_mask) -> (logits[B,T,V], cache)
+    decode_fn: Callable        # (params, token[B], pos[B], cache) -> (logits[B,V], cache)
+    init_cache: Callable       # (batch_size, max_len, dtype) -> cache pytree
+    params: Any
+    param_specs: Any = None
+    eos_token_id: Optional[int] = None
+    name: str = "model"
+
+
+class InferenceEngine:
+    def __init__(self, model: DecodeModelSpec, config: TpuInferenceConfig, mesh=None):
+        self.model_spec = model
+        self.config = config
+
+        if mesh is not None:
+            mesh_mod.set_mesh(mesh)
+        elif not mesh_mod.has_mesh():
+            from deepspeed_tpu.config.core import MeshConfig
+            tp = config.tensor_parallel.tp_size
+            comm.init_distributed(mesh_config=MeshConfig(data=-1, tensor=tp))
+        self.mesh = mesh_mod.get_mesh()
+
+        dtype = jnp.dtype(config.dtype) if config.dtype != "float" else jnp.float32
+        self.dtype = dtype
+
+        # TP placement: params sharded per their specs over the tensor axis,
+        # replicated over everything else.
+        if model.param_specs is not None:
+            shardings = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(self.mesh, spec), model.param_specs)
+        else:
+            shardings = jax.tree_util.tree_map(
+                lambda _: NamedSharding(self.mesh, P()), model.params)
+        self.params = jax.device_put(tree_cast(model.params, dtype), shardings)
+
+        self._prefill = jax.jit(model.prefill_fn)
+        self._decode = jax.jit(model.decode_fn, donate_argnums=(3,))
+        self._generate_jit = None
+        log_dist(f"inference engine: {model.name} dtype={dtype} "
+                 f"tp={config.tensor_parallel.tp_size}", ranks=[0])
+
+    def forward(self, tokens, cache=None, pad_mask=None):
+        """Prefill forward (logits for a full sequence)."""
+        tokens = jnp.asarray(tokens)
+        if cache is None:
+            cache = self.model_spec.init_cache(tokens.shape[0],
+                                               self.config.max_out_tokens,
+                                               jnp.dtype(self.config.kv_cache_dtype))
+        return self._prefill(self.params, tokens, cache, pad_mask)
+
+    __call__ = forward
+
+    def _build_generate(self):
+        decode_fn = self.model_spec.decode_fn
+        prefill_fn = self.model_spec.prefill_fn
+        greedy = self.config.greedy
+        temperature = self.config.temperature
+        top_k = self.config.top_k
+
+        def sample(logits, rng):
+            if greedy:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits = logits / jnp.maximum(temperature, 1e-6)
+            if top_k and top_k > 0:
+                kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+        def generate(params, tokens, cache, prompt_len, max_new, rng):
+            B, T = tokens.shape
+            logits, cache = prefill_fn(params, tokens, cache, None)
+            # last prompt logits
+            last = jnp.take_along_axis(
+                logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0, :]
+            first_tok = sample(last, rng)
+
+            def body(carry, i):
+                tok, pos, cache, rng = carry
+                rng, sub = jax.random.split(rng)
+                lg, cache = decode_fn(params, tok, pos, cache)
+                nxt = sample(lg, sub)
+                return (nxt, pos + 1, cache, rng), tok
+
+            (_, _, cache, _), toks = jax.lax.scan(
+                body, (first_tok, prompt_len, cache, rng), jnp.arange(max_new))
+            return jnp.moveaxis(toks, 0, 1)  # [B, max_new]
+
+        return jax.jit(generate, static_argnums=(4,))
+
+    def generate(self, tokens, max_new_tokens=32, rng=None):
+        """Greedy/sampled generation with a static-shape decode loop (lax.scan)."""
+        if self._generate_jit is None:
+            self._generate_jit = self._build_generate()
+        tokens = jnp.asarray(tokens)
+        B, T = tokens.shape
+        max_len = T + max_new_tokens
+        cache = self.model_spec.init_cache(B, max_len, jnp.dtype(self.config.kv_cache_dtype))
+        prompt_len = jnp.full((B,), T, jnp.int32)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        out = self._generate_jit(self.params, tokens, cache, prompt_len, max_new_tokens, rng)
+        return np.asarray(jax.device_get(out))
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Reference signature (`deepspeed/__init__.py:269`): accepts config dict/path +
+    kwargs overrides."""
+    if config is None:
+        config = {}
+    if isinstance(config, str):
+        import json
+        with open(config) as f:
+            config = json.load(f)
+    if isinstance(config, dict):
+        config = {**config, **kwargs}
+        cfg = TpuInferenceConfig.from_dict(config)
+    else:
+        cfg = config
+    assert isinstance(model, DecodeModelSpec), \
+        "init_inference expects a DecodeModelSpec (see deepspeed_tpu.models / inference.adapters)"
+    return InferenceEngine(model, cfg)

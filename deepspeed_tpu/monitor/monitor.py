@@ -1,0 +1,112 @@
+"""Experiment monitors — TensorBoard / WandB / CSV behind one interface.
+
+Reference: `deepspeed/monitor/monitor.py:29` (`MonitorMaster` fanning out to
+TensorBoardMonitor/WandbMonitor/csvMonitor, configs `monitor/config.py:15-63`).
+Events are `(tag, value, step)` tuples, written only from process 0.
+"""
+
+import csv
+import os
+import pathlib
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _rank():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+class Monitor:
+    def __init__(self, config):
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, event_list):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        if self.enabled and _rank() == 0:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                log_dir = os.path.join(config.output_path or "./runs", config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=log_dir)
+            except Exception as e:
+                logger.warning(f"tensorboard unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list, flush=True):
+        if self.summary_writer is None:
+            return
+        for name, value, step in event_list:
+            self.summary_writer.add_scalar(name, value, step)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.run = None
+        if self.enabled and _rank() == 0:
+            try:
+                import wandb
+                self.run = wandb.init(project=config.project, group=config.group,
+                                      entity=config.team)
+            except Exception as e:
+                logger.warning(f"wandb unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if self.run is None:
+            return
+        import wandb
+        for name, value, step in event_list:
+            wandb.log({name: value}, step=step)
+
+
+class CsvMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.filenames = {}
+        if self.enabled and _rank() == 0:
+            self.output_path = pathlib.Path(config.output_path or "./csv_monitor") / config.job_name
+            self.output_path.mkdir(parents=True, exist_ok=True)
+        else:
+            self.enabled = False
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            fname = self.output_path / (name.replace("/", "_") + ".csv")
+            new = not fname.exists()
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, value])
+
+
+class MonitorMaster(Monitor):
+    """Fans events out to every enabled monitor (reference same name)."""
+
+    def __init__(self, ds_config):
+        self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
+        self.wandb_monitor = WandbMonitor(ds_config.wandb)
+        self.csv_monitor = CsvMonitor(ds_config.csv_monitor)
+        self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled
+                        or self.csv_monitor.enabled)
+
+    def write_events(self, event_list):
+        if _rank() != 0:
+            return
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            if m.enabled:
+                m.write_events(event_list)

@@ -1,0 +1,5 @@
+from deepspeed_tpu.ops.adam import FusedAdam, DeepSpeedCPUAdam
+from deepspeed_tpu.ops.lamb import FusedLamb
+from deepspeed_tpu.ops.lion import FusedLion, DeepSpeedCPULion
+from deepspeed_tpu.ops.adagrad import DeepSpeedCPUAdagrad
+from deepspeed_tpu.ops.optim import build_optimizer, OPTIMIZER_REGISTRY

@@ -1,0 +1,11 @@
+"""Adagrad (reference `deepspeed/ops/adagrad/cpu_adagrad.py:11`)."""
+
+import optax
+
+
+def DeepSpeedCPUAdagrad(model_params=None, lr=1e-2, eps=1e-10, weight_decay=0.0):
+    from deepspeed_tpu.ops.optim import mark_host_offload
+    tx = optax.adagrad(lr, eps=eps)
+    if weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return mark_host_offload(tx)

@@ -1,0 +1,48 @@
+"""Adam variants with the reference's class names.
+
+`FusedAdam` (reference `deepspeed/ops/adam/fused_adam.py:18`) and
+`DeepSpeedCPUAdam` (`deepspeed/ops/adam/cpu_adam.py:13`) exposed as optax
+transformations. On TPU, "fused" means the whole multi-tensor update compiles into
+the jitted step (XLA does what `multi_tensor_adam.cu` does by hand); the CPU
+variant pins its state to host memory for ZeRO-Offload
+(analog of `csrc/adam/cpu_adam_impl.cpp` — the step runs on host while the TPU
+computes the next microbatch; see runtime/offload.py for the C++-accelerated path).
+"""
+
+import optax
+
+
+def FusedAdam(params=None,
+              lr=1e-3,
+              bias_correction=True,
+              betas=(0.9, 0.999),
+              eps=1e-8,
+              adam_w_mode=True,
+              weight_decay=0.0,
+              amsgrad=False,
+              set_grad_none=True):
+    """Returns an optax GradientTransformation. `params` accepted for signature parity."""
+    assert not amsgrad, "amsgrad not supported (matches reference FusedAdam)"
+    if adam_w_mode:
+        return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay)
+    tx = optax.adam(lr, b1=betas[0], b2=betas[1], eps=eps)
+    if weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx
+
+
+def DeepSpeedCPUAdam(model_params=None,
+                     lr=1e-3,
+                     bias_correction=True,
+                     betas=(0.9, 0.999),
+                     eps=1e-8,
+                     weight_decay=0.0,
+                     amsgrad=False,
+                     adamw_mode=True,
+                     fp32_optimizer_states=True):
+    """Host-offloaded Adam: identical math, state placed on host (wired by the engine
+    when zero_optimization.offload_optimizer.device == 'cpu')."""
+    from deepspeed_tpu.ops.optim import mark_host_offload
+    tx = FusedAdam(model_params, lr=lr, bias_correction=bias_correction, betas=betas,
+                   eps=eps, adam_w_mode=adamw_mode, weight_decay=weight_decay, amsgrad=amsgrad)
+    return mark_host_offload(tx)

@@ -1,0 +1,19 @@
+"""LAMB (reference `deepspeed/ops/lamb/fused_lamb.py:14` over
+`csrc/lamb/fused_lamb_cuda_kernel.cu`) as an optax transformation."""
+
+import optax
+
+
+def FusedLamb(params=None,
+              lr=1e-3,
+              bias_correction=True,
+              betas=(0.9, 0.999),
+              eps=1e-8,
+              weight_decay=0.0,
+              max_grad_norm=0.0,
+              max_coeff=10.0,
+              min_coeff=0.01):
+    tx = optax.lamb(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay)
+    if max_grad_norm and max_grad_norm > 0:
+        tx = optax.chain(optax.clip_by_global_norm(max_grad_norm), tx)
+    return tx

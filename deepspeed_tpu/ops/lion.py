@@ -1,0 +1,12 @@
+"""Lion (reference `deepspeed/ops/lion/fused_lion.py:17`, `cpu_lion.py:13`)."""
+
+import optax
+
+
+def FusedLion(params=None, lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0):
+    return optax.lion(lr, b1=betas[0], b2=betas[1], weight_decay=weight_decay)
+
+
+def DeepSpeedCPULion(model_params=None, lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0):
+    from deepspeed_tpu.ops.optim import mark_host_offload
+    return mark_host_offload(FusedLion(model_params, lr=lr, betas=betas, weight_decay=weight_decay))

@@ -1,0 +1,6 @@
+from deepspeed_tpu.platform.accelerator import (
+    TpuAccelerator,
+    CpuAccelerator,
+    get_accelerator,
+    set_accelerator,
+)

@@ -1,0 +1,178 @@
+"""Platform (accelerator) abstraction.
+
+TPU-native analog of the reference's `accelerator/abstract_accelerator.py:10`
+(`DeepSpeedAccelerator` ABC, ~80 methods) + `accelerator/real_accelerator.py:45`
+(env/auto probe). In JAX most of that surface collapses: streams/events are XLA's
+async dispatch, memory mgmt is the runtime's; what remains useful is device query,
+HBM stats, dtype support, platform naming, and the communication-backend name.
+
+Selection: `DSTPU_ACCELERATOR` env ("tpu" | "cpu" | "gpu") or auto-probe of
+`jax.default_backend()`.
+"""
+
+import os
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class BaseAccelerator:
+    """Shared implementation over jax.devices()."""
+
+    _name = "base"
+    _communication_backend = "xla"
+
+    # ---- identity ----
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return self._name
+        return f"{self._name}:{device_index}"
+
+    def is_available(self):
+        try:
+            return len(self.devices()) > 0
+        except RuntimeError:
+            return False
+
+    def device_count(self):
+        return len(self.devices())
+
+    def devices(self):
+        return [d for d in jax.devices() if self._matches(d)]
+
+    def _matches(self, d):
+        return True
+
+    def current_device(self):
+        return self.devices()[0]
+
+    def current_device_name(self):
+        return self.device_name(0)
+
+    def communication_backend_name(self):
+        # Reference: accelerator.communication_backend_name() picks nccl/ccl/hccl
+        # (`accelerator/cuda_accelerator.py`); on TPU there is a single answer: XLA
+        # collectives over ICI/DCN.
+        return self._communication_backend
+
+    # ---- memory ----
+    def memory_stats(self, device=None):
+        d = device or self.current_device()
+        try:
+            return d.memory_stats() or {}
+        except Exception:
+            return {}
+
+    def memory_allocated(self, device=None):
+        return self.memory_stats(device).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device=None):
+        return self.memory_stats(device).get("peak_bytes_in_use", 0)
+
+    def total_memory(self, device=None):
+        return self.memory_stats(device).get("bytes_limit", 0)
+
+    def available_memory(self, device=None):
+        s = self.memory_stats(device)
+        return max(s.get("bytes_limit", 0) - s.get("bytes_in_use", 0), 0)
+
+    def empty_cache(self):
+        # XLA owns allocation; provide GC-style hook for API parity.
+        import gc
+        gc.collect()
+
+    def reset_peak_memory_stats(self, device=None):
+        pass  # not exposed by the TPU runtime; kept for API parity
+
+    # ---- synchronization (streams/events collapse to dispatch barriers) ----
+    def synchronize(self, device=None):
+        jax.effects_barrier()
+
+    # ---- dtype support ----
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        return True
+
+    def supported_dtypes(self):
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8]
+
+    def preferred_dtype(self):
+        return jnp.bfloat16
+
+    # ---- profiling ranges (nvtx analog) ----
+    def range_push(self, msg):
+        self._trace = jax.profiler.TraceAnnotation(msg)
+        self._trace.__enter__()
+
+    def range_pop(self):
+        if getattr(self, "_trace", None) is not None:
+            self._trace.__exit__(None, None, None)
+            self._trace = None
+
+    # ---- misc parity ----
+    def lazy_call(self, callback):
+        callback()
+
+    def op_builder_dir(self):
+        return "deepspeed_tpu.ops"
+
+    def on_accelerator(self, tensor):
+        return hasattr(tensor, "devices") or hasattr(tensor, "device")
+
+
+class TpuAccelerator(BaseAccelerator):
+    _name = "tpu"
+    _communication_backend = "xla-ici"
+
+    def _matches(self, d):
+        return d.platform in ("tpu", "axon")
+
+    def preferred_dtype(self):
+        return jnp.bfloat16
+
+
+class CpuAccelerator(BaseAccelerator):
+    _name = "cpu"
+    _communication_backend = "xla-host"
+
+    def _matches(self, d):
+        return d.platform == "cpu"
+
+
+class GpuAccelerator(BaseAccelerator):
+    _name = "gpu"
+    _communication_backend = "xla-nccl"
+
+    def _matches(self, d):
+        return d.platform in ("gpu", "cuda", "rocm")
+
+
+_ACCELERATOR = None
+
+
+def set_accelerator(accel):
+    global _ACCELERATOR
+    _ACCELERATOR = accel
+
+
+@functools.lru_cache(None)
+def _probe():
+    env = os.environ.get("DSTPU_ACCELERATOR")
+    backend = env or jax.default_backend()
+    if backend in ("tpu", "axon"):
+        return TpuAccelerator()
+    if backend in ("gpu", "cuda", "rocm"):
+        return GpuAccelerator()
+    return CpuAccelerator()
+
+
+def get_accelerator():
+    global _ACCELERATOR
+    if _ACCELERATOR is None:
+        _ACCELERATOR = _probe()
+    return _ACCELERATOR

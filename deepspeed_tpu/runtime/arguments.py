@@ -1,0 +1,19 @@
+"""Argparse integration — analog of `deepspeed.add_config_arguments`
+(`deepspeed/__init__.py:246`, `runtime/config.py` `_add_core_arguments`)."""
+
+
+def add_config_arguments(parser):
+    group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed",
+                       default=False,
+                       action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag for config toggling)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the config JSON file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated alias of --deepspeed")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated alias of --deepspeed_config")
+    group.add_argument("--local_rank", type=int, default=-1,
+                       help="Accepted for launcher parity; unused (one process drives all local chips)")
+    return parser

@@ -1,0 +1,72 @@
+"""Data loading — analog of `runtime/dataloader.py` (`DeepSpeedDataLoader`,
+`RepeatingLoader`).
+
+The engine consumes batches of numpy/jax arrays (pytrees). `TpuDataLoader` slices
+an indexable dataset into global batches of `micro_batch_size × data_parallel_size`
+samples; in multi-host runs each process loads the full global batch and
+`jax.device_put` with a data-axis sharding keeps only the local shard resident
+(`jax.make_array_from_process_local_data` territory — single-host covers this
+round's scope).
+"""
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration (reference same name)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+def _default_collate(samples):
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(np.stack([s[i] for s in samples]) for i in range(len(first)))
+    return np.stack(samples)
+
+
+class TpuDataLoader:
+    """Batches an indexable dataset; drops the ragged tail (matching drop_last)."""
+
+    def __init__(self, dataset, batch_size, collate_fn=None, shuffle=False, seed=0, drop_last=True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or _default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+    def __len__(self):
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        self.epoch += 1
+        for start in range(0, n - (self.batch_size - 1 if self.drop_last else 0), self.batch_size):
+            idx = order[start:start + self.batch_size]
+            yield self.collate_fn([self.dataset[int(i)] for i in idx])

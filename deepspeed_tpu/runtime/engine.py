@@ -1,0 +1,682 @@
+"""Training engine.
+
+TPU-native analog of `DeepSpeedEngine` (`runtime/engine.py:175`, 3.5k LoC) and the
+top-level `deepspeed.initialize` (`deepspeed/__init__.py:64`). The reference wraps an
+eager nn.Module and orchestrates forward/backward/step with hooks; here the entire
+step — gradient-accumulation scan, loss scaling, ZeRO collectives, optimizer update,
+parameter re-materialization — is ONE compiled XLA program over the global mesh:
+
+    state' , metrics = train_step(state, batch, )     # jit, donated state
+
+ZeRO stages are sharding policies (see runtime/zero.py); fp16/bf16 master-weight
+handling mirrors `runtime/fp16/fused_optimizer.py:31` / `runtime/bf16_optimizer.py:30`;
+the overflow skip-step is a masked update instead of a host-side branch.
+
+API parity with the reference engine: `train_batch`, `forward`, `backward`, `step`,
+`eval_batch`, `save_checkpoint`/`load_checkpoint`, `global_steps`, `get_lr`,
+`cur_scale` (loss scale), `set_dataloader` etc.
+"""
+
+import dataclasses
+import inspect
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.config.core import TpuTrainConfig
+from deepspeed_tpu.ops.optim import build_optimizer
+from deepspeed_tpu.runtime import lr_schedules
+from deepspeed_tpu.runtime.dataloader import TpuDataLoader, RepeatingLoader
+from deepspeed_tpu.runtime.precision import LossScaler, LossScaleState, masked_update
+from deepspeed_tpu.runtime.zero import ZeroShardingPolicy
+from deepspeed_tpu.utils.logging import logger, log_dist
+from deepspeed_tpu.utils.timer import (SynchronizedWallClockTimer, ThroughputTimer,
+                                       FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
+                                       STEP_GLOBAL_TIMER, TRAIN_BATCH_TIMER)
+from deepspeed_tpu.utils.tree import tree_cast, tree_num_params
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """What the engine needs from a model.
+
+    `loss_fn(params, batch[, rng]) -> loss` or `(loss, aux)`. The reference takes an
+    nn.Module; in functional JAX the (pure) loss function + params pytree is the
+    model. `param_specs` optionally carries tensor-parallel PartitionSpecs per leaf
+    (the TP planner in parallel/tp.py produces them).
+    """
+    loss_fn: Callable
+    params: Any
+    param_specs: Any = None
+    apply_fn: Optional[Callable] = None   # raw forward (for inference/eval use)
+    has_aux: bool = False
+    name: str = "model"
+
+
+class TrainState(NamedTuple):
+    params: Any                  # compute-dtype parameters
+    master: Any                  # fp32 master copy (None if params are fp32)
+    opt_state: Any
+    scaler: LossScaleState
+    step: jnp.ndarray            # i32 global step counter
+    rng: jnp.ndarray             # PRNG key
+
+
+def _wrap_loss_fn(loss_fn, has_aux):
+    """Normalize to loss_fn(params, batch, rng) -> (loss, aux)."""
+    sig_params = None
+    try:
+        sig_params = list(inspect.signature(loss_fn).parameters)
+    except (TypeError, ValueError):
+        pass
+    takes_rng = sig_params is None or len(sig_params) >= 3
+
+    def wrapped(params, batch, rng):
+        out = loss_fn(params, batch, rng) if takes_rng else loss_fn(params, batch)
+        if has_aux:
+            return out[0], out[1]
+        if isinstance(out, tuple):
+            return out[0], (out[1] if len(out) > 1 else None)
+        return out, None
+
+    return wrapped
+
+
+class Engine:
+    """See module docstring. Constructed via `deepspeed_tpu.initialize()`."""
+
+    def __init__(self,
+                 model: ModelSpec,
+                 config: TpuTrainConfig,
+                 optimizer=None,
+                 lr_scheduler=None,
+                 training_data=None,
+                 collate_fn=None,
+                 mesh=None,
+                 dont_change_device=False):
+        self.config = config
+        self.model_spec = model
+
+        # ---- mesh / distributed (reference: init_distributed + groups, engine.py:1063)
+        if mesh is not None:
+            mesh_mod.set_mesh(mesh)
+        elif not mesh_mod.has_mesh():
+            comm.init_distributed(mesh_config=config.mesh)
+        self.mesh = mesh_mod.get_mesh()
+        self.spec = mesh_mod.get_spec()
+
+        # ---- batch triad over the data axis (reference config.py batch arithmetic)
+        self.dp_world_size = self.spec.data
+        (self.train_batch_size_value, self.micro_batch_size,
+         self.gradient_accumulation_steps_value) = config.resolve_batch_sizes(self.dp_world_size)
+
+        # ---- precision policy
+        self.compute_dtype = config.compute_dtype()
+        self.fp16_enabled = config.fp16_enabled
+        self.bf16_enabled = config.bf16_enabled
+        keep_master = (self.compute_dtype != jnp.float32) and (
+            not self.bf16_enabled or config.bf16.master_weights)
+        self.keep_master = keep_master
+
+        self.scaler = LossScaler(
+            static_scale=(None if config.fp16.dynamic else config.fp16.loss_scale),
+            initial_scale_power=config.fp16.initial_scale_power,
+            loss_scale_window=config.fp16.loss_scale_window,
+            hysteresis=config.fp16.hysteresis,
+            consecutive_hysteresis=config.fp16.consecutive_hysteresis,
+            min_loss_scale=config.fp16.min_loss_scale,
+            enabled=self.fp16_enabled,
+        )
+
+        # ---- ZeRO sharding policy
+        self.zero_policy = ZeroShardingPolicy(config.zero_optimization, self.mesh)
+        self.zero_stage = config.zero_optimization.stage
+
+        # ---- LR schedule + optimizer
+        self.schedule_fn = None
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is None:
+            self.schedule_fn = lr_schedules.build_schedule(config.scheduler)
+            if self.schedule_fn is not None:
+                self.lr_scheduler = lr_schedules.LRScheduler(self.schedule_fn)
+        elif isinstance(lr_scheduler, lr_schedules.LRScheduler):
+            self.schedule_fn = lr_scheduler.schedule_fn
+
+        if optimizer is None:
+            if config.optimizer is None:
+                raise ValueError("No optimizer: pass one to initialize() or set the "
+                                 "'optimizer' config block")
+            optimizer = build_optimizer(config.optimizer, self.schedule_fn)
+        self.optimizer = optimizer  # optax GradientTransformation
+        self.offload_optimizer_states = bool(
+            getattr(optimizer, "offload_to_host", False)
+            or (config.zero_optimization.offload_optimizer is not None
+                and config.zero_optimization.offload_optimizer.device == "cpu"))
+
+        # ---- loss fn
+        self._loss_fn = _wrap_loss_fn(model.loss_fn, model.has_aux)
+
+        # ---- state init (sharded placement)
+        self.state = self._init_state(model.params, model.param_specs)
+        n_params = tree_num_params(self.state.params)
+        log_dist(f"engine: {model.name} | params={n_params/1e6:.2f}M | "
+                 f"dtype={jnp.dtype(self.compute_dtype).name} | zero_stage={self.zero_stage} | "
+                 f"mesh={self.spec} | micro_bs={self.micro_batch_size} | "
+                 f"gas={self.gradient_accumulation_steps_value} | "
+                 f"global_bs={self.train_batch_size_value}", ranks=[0])
+
+        # ---- jitted programs
+        self._train_step = self._build_train_step()
+        self._eval_step = self._build_eval_step()
+        self._grad_step = None        # built lazily for forward/backward/step API
+        self._apply_step = None
+        self._pending = []            # accumulated micro-batch grads (parity API)
+
+        # ---- dataloader
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
+
+        # ---- bookkeeping / monitoring
+        self.global_steps = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(batch_size=self.train_batch_size_value,
+                                          steps_per_output=config.steps_per_print)
+        self.monitor = self._build_monitor()
+        self.losses = None
+        self._last_metrics = {}
+
+        # flops profiler (lazy)
+        self._flops_profiler = None
+
+    # ------------------------------------------------------------------
+    # state construction
+    # ------------------------------------------------------------------
+
+    def _init_state(self, params, param_specs):
+        policy = self.zero_policy
+        self.param_shardings = policy.param_shardings(params, param_specs)
+
+        # place params (compute dtype)
+        params_c = tree_cast(params, self.compute_dtype)
+        params_c = jax.device_put(params_c, self.param_shardings)
+
+        # fp32 master (ZeRO-partitioned — reference stage_1_and_2.py:630)
+        if self.keep_master:
+            master_shapes = jax.eval_shape(lambda p: tree_cast(p, jnp.float32), params_c)
+            self.master_shardings = policy.state_shardings(master_shapes)
+            master = jax.jit(lambda p: tree_cast(p, jnp.float32),
+                             out_shardings=self.master_shardings)(params_c)
+        else:
+            master = None
+            self.master_shardings = policy.state_shardings(
+                jax.eval_shape(lambda p: p, params_c))
+            # fp32 params themselves take the master sharding for stages 1/2? No:
+            # params keep param_shardings; opt state gets state shardings below.
+
+        opt_target = master if master is not None else params_c
+        opt_shapes = jax.eval_shape(self.optimizer.init, opt_target)
+        self.opt_shardings = policy.state_shardings(opt_shapes)
+        opt_state = jax.jit(self.optimizer.init, out_shardings=self.opt_shardings)(opt_target)
+        if self.offload_optimizer_states:
+            opt_state = self._to_host(opt_state)
+
+        rep = NamedSharding(self.mesh, P())
+        scaler_state = jax.device_put(self.scaler.init(), rep)
+        step = jax.device_put(jnp.asarray(0, jnp.int32), rep)
+        rng = jax.device_put(jax.random.PRNGKey(self.config.seed), rep)
+
+        self.state_shardings = TrainState(
+            params=self.param_shardings,
+            master=self.master_shardings if master is not None else None,
+            opt_state=self.opt_shardings,
+            scaler=LossScaleState(rep, rep, rep, rep),
+            step=rep,
+            rng=rep,
+        )
+        return TrainState(params=params_c, master=master, opt_state=opt_state,
+                          scaler=scaler_state, step=step, rng=rng)
+
+    def _to_host(self, tree):
+        """Move a pytree to pinned host memory (ZeRO-Offload optimizer states)."""
+        def host_shard(s):
+            return s.with_memory_kind("pinned_host")
+        host_shardings = jax.tree_util.tree_map(host_shard, self.opt_shardings)
+        try:
+            return jax.device_put(tree, host_shardings)
+        except Exception as e:  # CPU backend has no pinned_host memory space
+            logger.warning(f"optimizer-state host offload unavailable on this platform ({e}); "
+                           "keeping states in device memory")
+            self.offload_optimizer_states = False
+            return tree
+
+    # ------------------------------------------------------------------
+    # compiled step programs
+    # ------------------------------------------------------------------
+
+    def _grad_shardings(self):
+        master_like = self.master_shardings
+        return self.zero_policy.grad_shardings(None, self.param_shardings, master_like)
+
+    def _micro_grad_fn(self):
+        loss_fn = self._loss_fn
+        scaler = self.scaler
+
+        def compute(params, micro_batch, rng, scale_state):
+            def scaled(p):
+                loss, aux = loss_fn(p, micro_batch, rng)
+                return scaler.scale_loss(loss, scale_state), (loss, aux)
+
+            grads, (loss, _aux) = jax.grad(scaled, has_aux=True)(params)
+            return grads, loss
+
+        return compute
+
+    def _apply_grads_fn(self):
+        """(state, fp32 grads, mean loss) -> (new_state, metrics). Shared by the
+        fused train step and the forward/backward/step parity path."""
+        scaler = self.scaler
+        optimizer = self.optimizer
+        clip = self.config.gradient_clipping
+        keep_master = self.keep_master
+        compute_dtype = self.compute_dtype
+        grad_shardings = self._grad_shardings()
+        param_shardings = self.param_shardings
+        schedule_fn = self.schedule_fn
+
+        def apply_grads(state, grads, loss):
+            # ZeRO: constrain grads → reduce-scatter (stage>=2) or allreduce layout
+            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
+            grads = scaler.unscale_grads(grads, state.scaler)
+
+            finite = scaler.check_overflow(grads)
+            grad_norm = optax.global_norm(grads)
+            if clip and clip > 0:
+                factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+                grads = jax.tree_util.tree_map(lambda g: g * factor.astype(g.dtype), grads)
+
+            target = state.master if keep_master else state.params
+            updates, new_opt = optimizer.update(grads, state.opt_state, target)
+            new_target = optax.apply_updates(target, updates)
+
+            # masked skip-step on overflow (reference: FP16_Optimizer.step overflow path)
+            new_target = masked_update(new_target, target, finite)
+            new_opt = masked_update(new_opt, state.opt_state, finite)
+
+            if keep_master:
+                new_params = tree_cast(new_target, compute_dtype)
+                new_master = new_target
+            else:
+                new_params = new_target
+                new_master = None
+            # re-materialize params in their (replicated or fsdp) layout → all-gather
+            new_params = jax.lax.with_sharding_constraint(new_params, param_shardings)
+
+            new_scaler = scaler.update(state.scaler, finite)
+            new_step = state.step + jnp.where(finite, 1, 0).astype(jnp.int32)
+            rng, _ = jax.random.split(state.rng)
+
+            lr = (schedule_fn(state.step) if schedule_fn is not None
+                  else jnp.asarray(0.0, jnp.float32))
+            metrics = {
+                "loss": loss.astype(jnp.float32),
+                "grad_norm": grad_norm.astype(jnp.float32),
+                "overflow": ~finite,
+                "loss_scale": state.scaler.scale,
+                "lr": jnp.asarray(lr, jnp.float32),
+            }
+            new_state = TrainState(params=new_params, master=new_master, opt_state=new_opt,
+                                   scaler=new_scaler, step=new_step, rng=rng)
+            return new_state, metrics
+
+        return apply_grads
+
+    def _build_train_step(self):
+        gas = self.gradient_accumulation_steps_value
+        micro_grad = self._micro_grad_fn()
+        apply_grads = self._apply_grads_fn()
+        grad_shardings = self._grad_shardings()
+        predivide = self.config.gradient_predivide_factor or 1.0
+
+        def train_step(state, batch):
+            params = state.params
+            rng = jax.random.fold_in(state.rng, state.step)
+
+            if gas > 1:
+                def body(carry, micro_batch):
+                    g_acc, loss_acc, i = carry
+                    g, l = micro_grad(params, micro_batch, jax.random.fold_in(rng, i),
+                                      state.scaler)
+                    g_acc = jax.tree_util.tree_map(
+                        lambda a, b: a + b.astype(jnp.float32) / predivide, g_acc, g)
+                    return (g_acc, loss_acc + l.astype(jnp.float32), i + 1), None
+
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                zeros = jax.lax.with_sharding_constraint(zeros, grad_shardings)
+                (grads, loss_sum, _), _ = jax.lax.scan(
+                    body, (zeros, jnp.asarray(0.0, jnp.float32), 0), batch)
+                grads = jax.tree_util.tree_map(lambda g: g * (predivide / gas), grads)
+                loss = loss_sum / gas
+            else:
+                grads, loss = micro_grad(params, batch, rng, state.scaler)
+                grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+
+            return apply_grads(state, grads, loss)
+
+        return jax.jit(train_step,
+                       donate_argnums=(0,),
+                       out_shardings=(self.state_shardings, None))
+
+    def _build_eval_step(self):
+        loss_fn = self._loss_fn
+
+        def eval_step(params, batch, rng):
+            loss, aux = loss_fn(params, batch, rng)
+            return loss
+
+        return jax.jit(eval_step)
+
+    def _build_grad_and_apply(self):
+        """Separate grad / apply programs for the forward/backward/step parity API."""
+        micro_grad = self._micro_grad_fn()
+        apply_grads = self._apply_grads_fn()
+
+        def grad_step(state, batch, micro_idx):
+            rng = jax.random.fold_in(state.rng, state.step * 131071 + micro_idx)
+            grads, loss = micro_grad(state.params, batch, rng, state.scaler)
+            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+            return grads, loss
+
+        def accumulate(acc, grads):
+            return jax.tree_util.tree_map(lambda a, g: a + g, acc, grads)
+
+        self._grad_step = jax.jit(grad_step)
+        self._acc_step = jax.jit(accumulate, donate_argnums=(0,))
+
+        def apply(state, grads, loss, n):
+            grads = jax.tree_util.tree_map(lambda g: g / n, grads)
+            return apply_grads(state, grads, loss / n)
+
+        self._apply_step = jax.jit(apply, donate_argnums=(0, 1),
+                                   out_shardings=(self.state_shardings, None))
+
+    # ------------------------------------------------------------------
+    # batch placement
+    # ------------------------------------------------------------------
+
+    def _batch_sharding(self, for_scan):
+        lead = (None, mesh_mod.DATA_AXIS) if for_scan else (mesh_mod.DATA_AXIS,)
+        return NamedSharding(self.mesh, P(*lead))
+
+    def _shard_batch(self, batch, for_scan):
+        sharding = self._batch_sharding(for_scan)
+
+        def place(x):
+            x = np.asarray(x) if not isinstance(x, (jnp.ndarray, jax.Array)) else x
+            return jax.device_put(x, sharding)
+
+        return jax.tree_util.tree_map(place, batch)
+
+    def _maybe_split_gas(self, batch):
+        """[gas*micro*dp, ...] -> [gas, micro*dp, ...] for the scan."""
+        gas = self.gradient_accumulation_steps_value
+        if gas == 1:
+            return self._shard_batch(batch, for_scan=False)
+
+        def split(x):
+            x = np.asarray(x)
+            assert x.shape[0] % gas == 0, (
+                f"batch dim {x.shape[0]} not divisible by gradient_accumulation_steps={gas}")
+            return x.reshape(gas, x.shape[0] // gas, *x.shape[1:])
+
+        return self._shard_batch(jax.tree_util.tree_map(split, batch), for_scan=True)
+
+    # ------------------------------------------------------------------
+    # public API (reference parity)
+    # ------------------------------------------------------------------
+
+    def train_batch(self, batch=None, data_iter=None):
+        """One full optimizer step: GAS micro-batches fused into one XLA program.
+
+        Analog of `PipelineEngine.train_batch` / the forward-backward-step loop of
+        the reference engine. `batch` leading dim must be gas × micro × dp_data.
+        """
+        if batch is None:
+            it = data_iter
+            if it is None and self.training_dataloader is not None:
+                # persistent repeating iterator (reference RepeatingLoader semantics)
+                if getattr(self, "_data_iterator", None) is None:
+                    self._data_iterator = iter(RepeatingLoader(self.training_dataloader))
+                it = self._data_iterator
+            assert it is not None, "train_batch needs a batch or data_iter/training_data"
+            batch = next(it)
+        self.tput_timer.start()
+        self.timers(TRAIN_BATCH_TIMER).start()
+        placed = self._maybe_split_gas(batch)
+        self.state, metrics = self._train_step(self.state, placed)
+        self.timers(TRAIN_BATCH_TIMER).stop()
+        self.tput_timer.stop(global_step=True)
+        self._after_step(metrics, count_micro=True)
+        return metrics["loss"]
+
+    def eval_batch(self, batch, rng=None):
+        placed = self._shard_batch(batch, for_scan=False)
+        rng = rng if rng is not None else jax.random.fold_in(self.state.rng, 0x7FFFFFFF)
+        return self._eval_step(self.state.params, placed, rng)
+
+    # --- forward/backward/step parity triplet -------------------------------
+    # In functional JAX the loss is produced inside grad; `forward` therefore
+    # computes loss AND per-microbatch grads in one compiled call, `backward`
+    # accumulates them, `step` applies at the GAS boundary — semantically identical
+    # to the reference's autograd flow (engine.py:1753,1894,2092).
+
+    def forward(self, batch):
+        if self._grad_step is None:
+            self._build_grad_and_apply()
+        placed = self._shard_batch(batch, for_scan=False)
+        grads, loss = self._grad_step(self.state, placed,
+                                      jnp.asarray(len(self._pending), jnp.int32))
+        self._forward_cache = (grads, loss)
+        return loss
+
+    def backward(self, loss=None, allreduce_gradients=True):
+        assert getattr(self, "_forward_cache", None) is not None, \
+            "backward() must follow forward()"
+        grads, loss_v = self._forward_cache
+        self._forward_cache = None
+        if not self._pending:
+            self._grad_acc, self._loss_acc = grads, loss_v
+        else:
+            self._grad_acc = self._acc_step(self._grad_acc, grads)
+            self._loss_acc = self._loss_acc + loss_v
+        self._pending.append(1)
+        self.micro_steps += 1
+        return loss_v
+
+    def step(self):
+        assert self._pending, "step() must follow backward()"
+        n = float(len(self._pending))
+        self.state, metrics = self._apply_step(self.state, self._grad_acc,
+                                               self._loss_acc, n)
+        self._pending = []
+        self._grad_acc = None
+        self._after_step(metrics)
+        return metrics
+
+    def _after_step(self, metrics, count_micro=False):
+        self.global_steps += 1
+        if count_micro:
+            self.micro_steps += self.gradient_accumulation_steps_value
+        self._last_metrics = metrics
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        # overflow can only occur under fp16; avoid a host sync otherwise
+        if self.fp16_enabled and bool(metrics.get("overflow", False)):
+            self.skipped_steps += 1
+            log_dist(f"step {self.global_steps}: grad overflow — step skipped "
+                     f"(loss scale -> {float(self.state.scaler.scale):.1f})", ranks=[0])
+        if self.monitor is not None and self.monitor.enabled:
+            if self.global_steps % self.config.steps_per_print == 0:
+                self.monitor.write_events([
+                    ("Train/loss", float(metrics["loss"]), self.global_steps),
+                    ("Train/lr", float(metrics["lr"]), self.global_steps),
+                    ("Train/loss_scale", float(metrics["loss_scale"]), self.global_steps),
+                    ("Train/grad_norm", float(metrics["grad_norm"]), self.global_steps),
+                ])
+        if self.config.wall_clock_breakdown and \
+                self.global_steps % self.config.steps_per_print == 0:
+            self.timers.log([TRAIN_BATCH_TIMER])
+
+    # ------------------------------------------------------------------
+    # properties / getters (reference engine surface)
+    # ------------------------------------------------------------------
+
+    @property
+    def module(self):
+        return self.model_spec
+
+    @property
+    def params(self):
+        return self.state.params
+
+    def get_lr(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler.get_lr()
+        lr = self.config.optimizer.params.get("lr", 0.0) if self.config.optimizer else 0.0
+        return [lr]
+
+    @property
+    def cur_scale(self):
+        return float(self.state.scaler.scale)
+
+    def loss_scale(self):
+        return self.cur_scale
+
+    @property
+    def global_step(self):
+        return int(self.state.step)
+
+    def gradient_accumulation_steps(self):
+        return self.gradient_accumulation_steps_value
+
+    def train_micro_batch_size_per_gpu(self):
+        return self.micro_batch_size
+
+    def train_batch_size(self):
+        return self.train_batch_size_value
+
+    def zero_optimization_stage(self):
+        return self.zero_stage
+
+    def get_global_grad_norm(self):
+        m = self._last_metrics
+        return float(m["grad_norm"]) if "grad_norm" in m else None
+
+    def deepspeed_io(self, dataset, batch_size=None, collate_fn=None, shuffle=True):
+        """Build the training dataloader (reference `engine.deepspeed_io`,
+        engine.py:1661): global batch = micro_bs × dp × gas per train_batch call."""
+        bs = batch_size or (self.micro_batch_size * self.spec.data *
+                            self.gradient_accumulation_steps_value)
+        return TpuDataLoader(dataset, bs, collate_fn=collate_fn, shuffle=shuffle,
+                             seed=self.config.seed)
+
+    def _build_monitor(self):
+        try:
+            from deepspeed_tpu.monitor.monitor import MonitorMaster
+            return MonitorMaster(self.config)
+        except Exception as e:
+            logger.warning(f"monitor unavailable: {e}")
+            return None
+
+    # ------------------------------------------------------------------
+    # checkpointing (delegates to deepspeed_tpu.checkpoint)
+    # ------------------------------------------------------------------
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
+                        exclude_frozen_parameters=False):
+        from deepspeed_tpu.checkpoint.saver import save_checkpoint as _save
+        client_state = dict(client_state or {})
+        client_state.update({
+            "global_steps": self.global_steps,
+            "skipped_steps": self.skipped_steps,
+            "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler else None,
+        })
+        return _save(self, save_dir, tag=tag, client_state=client_state, save_latest=save_latest)
+
+    def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
+                        load_optimizer_states=True, load_lr_scheduler_states=True,
+                        load_module_only=False):
+        from deepspeed_tpu.checkpoint.saver import load_checkpoint as _load
+        path, client_state = _load(self, load_dir, tag=tag,
+                                   load_optimizer_states=load_optimizer_states,
+                                   load_module_only=load_module_only)
+        if client_state:
+            self.global_steps = client_state.get("global_steps", self.global_steps)
+            self.skipped_steps = client_state.get("skipped_steps", self.skipped_steps)
+            sd = client_state.get("lr_scheduler")
+            if sd and self.lr_scheduler is not None and load_lr_scheduler_states:
+                self.lr_scheduler.load_state_dict(sd)
+        return path, client_state
+
+    def get_fp32_state_dict(self):
+        """Gathered fp32 params (analog of `_zero3_consolidated_16bit_state_dict` +
+        zero_to_fp32, reference engine.py:3395)."""
+        source = self.state.master if self.keep_master else self.state.params
+        rep = jax.tree_util.tree_map(lambda _: NamedSharding(self.mesh, P()), source)
+        gathered = jax.jit(lambda p: tree_cast(p, jnp.float32), out_shardings=rep)(source)
+        return jax.device_get(gathered)
+
+
+# ----------------------------------------------------------------------
+# top-level initialize (reference deepspeed/__init__.py:64)
+# ----------------------------------------------------------------------
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mesh=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None):
+    """Returns (engine, optimizer, training_dataloader, lr_scheduler) — same tuple as
+    the reference.
+
+    `model`: a ModelSpec, or a loss callable (then `model_parameters` is the params
+    pytree). `config`: dict / JSON path / TpuTrainConfig (falls back to
+    `args.deepspeed_config`).
+    """
+    assert model is not None, "deepspeed_tpu.initialize: model is required"
+    if config is None and config_params is not None:
+        config = config_params
+    if config is None and args is not None:
+        config = getattr(args, "deepspeed_config", None) or getattr(args, "deepscale_config", None)
+    cfg = TpuTrainConfig.load(config)
+
+    if not isinstance(model, ModelSpec):
+        assert callable(model), "model must be a ModelSpec or a loss callable"
+        assert model_parameters is not None, \
+            "when model is a callable, pass model_parameters (the params pytree)"
+        model = ModelSpec(loss_fn=model, params=model_parameters)
+
+    engine = Engine(model=model,
+                    config=cfg,
+                    optimizer=optimizer,
+                    lr_scheduler=lr_scheduler,
+                    training_data=training_data,
+                    collate_fn=collate_fn,
+                    mesh=mesh)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler

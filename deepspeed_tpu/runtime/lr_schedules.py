@@ -1,0 +1,158 @@
+"""LR schedules with the reference's names and semantics.
+
+Reference: `runtime/lr_schedules.py` (763 LoC) — WarmupLR, WarmupDecayLR,
+WarmupCosineLR, OneCycle, LRRangeTest. Each is a pure, **jnp-traceable** function
+`step -> lr` (optax-schedule style) so it folds into the jitted train step; a thin
+stateful wrapper preserves the torch-scheduler-like `step()/get_lr()` API the
+engine exposes.
+"""
+
+import math
+from typing import Any
+
+import jax.numpy as jnp
+
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+WARMUP_COSINE_LR = "WarmupCosineLR"
+ONE_CYCLE = "OneCycle"
+LR_RANGE_TEST = "LRRangeTest"
+
+
+def warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.001, warmup_num_steps=1000, warmup_type="log", **_):
+    """WarmupLR: warm from min→max then hold (reference WarmupLR)."""
+    wn = max(warmup_num_steps, 1)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        if warmup_type == "log":
+            frac = jnp.log(step + 1.0) / math.log(wn + 1.0)
+        else:
+            frac = step / wn
+        frac = jnp.clip(frac, 0.0, 1.0)
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * frac
+
+    return schedule
+
+
+def warmup_decay_lr(total_num_steps,
+                    warmup_min_lr=0.0,
+                    warmup_max_lr=0.001,
+                    warmup_num_steps=1000,
+                    warmup_type="log",
+                    **_):
+    """WarmupDecayLR: warmup then linear decay to 0 at total_num_steps."""
+    wl = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+    wn = max(warmup_num_steps, 1)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        decay = jnp.clip((total_num_steps - step) / max(total_num_steps - wn, 1), 0.0, 1.0)
+        return jnp.where(step < wn, wl(step), warmup_max_lr * decay)
+
+    return schedule
+
+
+def warmup_cosine_lr(total_num_steps,
+                     warmup_min_ratio=0.0,
+                     warmup_num_steps=1000,
+                     cos_min_ratio=0.0001,
+                     warmup_max_lr=0.001,
+                     **_):
+    wn = max(warmup_num_steps, 1)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = warmup_max_lr * (warmup_min_ratio + (1 - warmup_min_ratio) * jnp.clip(step / wn, 0.0, 1.0))
+        progress = jnp.clip((step - wn) / max(total_num_steps - wn, 1), 0.0, 1.0)
+        cos = 0.5 * (1 + jnp.cos(jnp.pi * progress))
+        decay = warmup_max_lr * (cos_min_ratio + (1 - cos_min_ratio) * cos)
+        return jnp.where(step < wn, warm, decay)
+
+    return schedule
+
+
+def one_cycle(cycle_min_lr,
+              cycle_max_lr,
+              decay_lr_rate=0.0,
+              cycle_first_step_size=2000,
+              cycle_second_step_size=None,
+              cycle_first_stair_count=0,
+              cycle_second_stair_count=None,
+              decay_step_size=0,
+              **_):
+    """OneCycle: min→max over first phase, max→min over second, then decay."""
+    first = max(cycle_first_step_size, 1)
+    second = max(cycle_second_step_size if cycle_second_step_size is not None else first, 1)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * jnp.clip(step / first, 0.0, 1.0)
+        down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * jnp.clip((step - first) / second, 0.0, 1.0)
+        post = jnp.maximum(step - first - second, 0.0)
+        if decay_step_size > 0:
+            decayed = cycle_min_lr * (1.0 - decay_lr_rate)**jnp.floor(post / decay_step_size)
+        else:
+            decayed = jnp.asarray(cycle_min_lr, jnp.float32)
+        return jnp.where(step <= first, up, jnp.where(step <= first + second, down, decayed))
+
+    return schedule
+
+
+def lr_range_test(lr_range_test_min_lr=1e-3,
+                  lr_range_test_step_size=2000,
+                  lr_range_test_step_rate=1.0,
+                  lr_range_test_staircase=False,
+                  **_):
+    size = max(lr_range_test_step_size, 1)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = step / size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1 + interval * lr_range_test_step_rate)
+
+    return schedule
+
+
+SCHEDULE_REGISTRY = {
+    WARMUP_LR: warmup_lr,
+    WARMUP_DECAY_LR: warmup_decay_lr,
+    WARMUP_COSINE_LR: warmup_cosine_lr,
+    ONE_CYCLE: one_cycle,
+    LR_RANGE_TEST: lr_range_test,
+}
+
+
+def build_schedule(scheduler_config) -> Any:
+    if scheduler_config is None or scheduler_config.type is None:
+        return None
+    name = scheduler_config.type
+    if name not in SCHEDULE_REGISTRY:
+        raise ValueError(f"Unknown scheduler '{name}'. Known: {sorted(SCHEDULE_REGISTRY)}")
+    return SCHEDULE_REGISTRY[name](**scheduler_config.params)
+
+
+class LRScheduler:
+    """Stateful wrapper with the torch-like API the reference engine exposes
+    (`engine.lr_scheduler.step()`, `.get_lr()`)."""
+
+    def __init__(self, schedule_fn, last_step=0):
+        self.schedule_fn = schedule_fn
+        self.last_step = last_step
+
+    def step(self, increment=1):
+        self.last_step += increment
+
+    def get_lr(self):
+        return [float(self.schedule_fn(self.last_step))]
+
+    def get_last_lr(self):
+        return self.get_lr()
+
+    def state_dict(self):
+        return {"last_step": self.last_step}
+
+    def load_state_dict(self, sd):
+        self.last_step = sd["last_step"]

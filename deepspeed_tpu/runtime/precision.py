@@ -1,0 +1,106 @@
+"""Mixed precision: dynamic loss scaling + master-weight policy.
+
+Reference: `runtime/fp16/loss_scaler.py` (LossScaler/DynamicLossScaler),
+`runtime/fp16/fused_optimizer.py:31` (fp32 master copy + overflow-check + skip step),
+`runtime/bf16_optimizer.py:30` (bf16 params + fp32 master).
+
+TPU-native formulation: the scaler is a tiny pytree threaded through the jitted
+train step; overflow-skip is a `jnp.where` masked update (no Python branch, so the
+step stays a single compiled program — the reference re-runs the step eagerly).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.tree import tree_all_finite
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray          # f32 scalar
+    good_steps: jnp.ndarray     # i32 scalar — consecutive overflow-free steps
+    overflows: jnp.ndarray      # i32 scalar — total skipped steps (diagnostics)
+    hysteresis_left: jnp.ndarray  # i32 scalar — overflows tolerated before scale cut
+
+
+class LossScaler:
+    """Static or dynamic loss scaling as pure functions over LossScaleState."""
+
+    def __init__(self,
+                 static_scale=None,
+                 initial_scale_power=16,
+                 loss_scale_window=1000,
+                 hysteresis=2,
+                 consecutive_hysteresis=False,
+                 min_loss_scale=1.0,
+                 scale_factor=2.0,
+                 enabled=True):
+        self.enabled = enabled
+        self.dynamic = static_scale in (None, 0, 0.0)
+        self.static_scale = float(static_scale or 2.0**initial_scale_power)
+        self.initial_scale = float(2.0**initial_scale_power) if self.dynamic else self.static_scale
+        self.loss_scale_window = loss_scale_window
+        self.hysteresis = hysteresis
+        self.consecutive_hysteresis = consecutive_hysteresis
+        self.min_loss_scale = float(min_loss_scale)
+        self.scale_factor = float(scale_factor)
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(scale=jnp.asarray(self.initial_scale if self.enabled else 1.0, jnp.float32),
+                              good_steps=jnp.asarray(0, jnp.int32),
+                              overflows=jnp.asarray(0, jnp.int32),
+                              hysteresis_left=jnp.asarray(self.hysteresis, jnp.int32))
+
+    def scale_loss(self, loss, state: LossScaleState):
+        if not self.enabled:
+            return loss
+        return loss * state.scale.astype(loss.dtype)
+
+    def unscale_grads(self, grads, state: LossScaleState):
+        if not self.enabled:
+            return grads
+        inv = (1.0 / state.scale).astype(jnp.float32)
+        return jax.tree_util.tree_map(lambda g: g * inv.astype(g.dtype), grads)
+
+    def check_overflow(self, grads):
+        """True == all finite (no overflow)."""
+        if not self.enabled:
+            return jnp.asarray(True)
+        return tree_all_finite(grads)
+
+    def update(self, state: LossScaleState, grads_finite) -> LossScaleState:
+        """Dynamic scale update (jittable), matching reference DynamicLossScaler
+        semantics (`runtime/fp16/loss_scaler.py`): on overflow, decrement the
+        hysteresis budget and only cut the scale when it is exhausted; double after
+        `loss_scale_window` consecutive clean steps (which also refills hysteresis
+        unless `consecutive_hysteresis` tracking keeps it drained)."""
+        if not self.enabled or not self.dynamic:
+            return state._replace(
+                good_steps=state.good_steps + 1,
+                overflows=state.overflows + jnp.where(grads_finite, 0, 1),
+            )
+        new_good = jnp.where(grads_finite, state.good_steps + 1, 0)
+        grow = new_good >= self.loss_scale_window
+        scale_up = jnp.where(grow, state.scale * self.scale_factor, state.scale)
+
+        hyst_exhausted = state.hysteresis_left <= 1
+        cut_scale = jnp.maximum(state.scale / self.scale_factor, self.min_loss_scale)
+        new_scale = jnp.where(grads_finite,
+                              scale_up,
+                              jnp.where(hyst_exhausted, cut_scale, state.scale))
+        # refill hysteresis on a clean step unless consecutive_hysteresis is set
+        new_hyst = jnp.where(grads_finite,
+                             (state.hysteresis_left if self.consecutive_hysteresis
+                              else jnp.asarray(self.hysteresis, jnp.int32)),
+                             jnp.maximum(state.hysteresis_left - 1, 1))
+        return LossScaleState(scale=new_scale,
+                              good_steps=jnp.where(grow, 0, new_good).astype(jnp.int32),
+                              overflows=(state.overflows + jnp.where(grads_finite, 0, 1)).astype(jnp.int32),
+                              hysteresis_left=new_hyst.astype(jnp.int32))
+
+
+def masked_update(new_tree, old_tree, apply_mask):
+    """Elementwise select: apply_mask ? new : old — the jittable skip-step."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(apply_mask, n.astype(o.dtype), o), new_tree, old_tree)

@@ -1,0 +1,67 @@
+"""Rank-filtered logging.
+
+TPU-native analog of the reference's `deepspeed/utils/logging.py` (logger + `log_dist`
+which prints only on selected ranks). Process identity comes from `jax.process_index()`
+instead of torch.distributed ranks.
+"""
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVEL = os.environ.get("DSTPU_LOG_LEVEL", "INFO").upper()
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+@functools.lru_cache(None)
+def _create_logger(name="deepspeed_tpu", level=None):
+    lg = logging.getLogger(name)
+    lg.setLevel(level if level is not None else log_levels.get(LOG_LEVEL.lower(), logging.INFO))
+    lg.propagate = False
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(
+            logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s",
+                datefmt="%Y-%m-%d %H:%M:%S",
+            ))
+        lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger()
+
+
+def _process_index():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log `message` only if this process's index is in `ranks` (or ranks is None/[-1])."""
+    rank = _process_index()
+    my_turn = ranks is None or -1 in ranks or rank in ranks
+    if my_turn:
+        logger.log(level, f"[Rank {rank}] {message}")
+
+
+def print_rank_0(message):
+    if _process_index() == 0:
+        print(message, flush=True)
+
+
+def warning_once(message, _seen=set()):
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

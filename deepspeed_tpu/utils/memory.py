@@ -1,0 +1,56 @@
+"""Memory reporting.
+
+Analog of the reference's `see_memory_usage` (sprinkled through engine/ZeRO). On TPU we
+read per-device HBM stats from `device.memory_stats()` plus host RSS from /proc.
+"""
+
+import os
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _host_rss_gb():
+    try:
+        with open(f"/proc/{os.getpid()}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    return int(line.split()[1]) / (1024**2)
+    except Exception:
+        pass
+    return 0.0
+
+
+def device_memory_stats(device=None):
+    """Return dict of bytes_in_use / peak_bytes_in_use / bytes_limit for a device."""
+    import jax
+    if device is None:
+        device = jax.devices()[0]
+    stats = {}
+    try:
+        raw = device.memory_stats() or {}
+        stats["bytes_in_use"] = raw.get("bytes_in_use", 0)
+        stats["peak_bytes_in_use"] = raw.get("peak_bytes_in_use", 0)
+        stats["bytes_limit"] = raw.get("bytes_limit", 0)
+    except Exception:
+        pass
+    return stats
+
+
+def see_memory_usage(message, force=False, ranks=None):
+    """Log device HBM + host RSS. `force` gate mirrors the reference's signature."""
+    if not force:
+        return
+    import jax
+    if ranks is not None and jax.process_index() not in ranks:
+        return
+    stats = device_memory_stats()
+    gb = 1024**3
+    logger.info(
+        f"{message} | HBM in use: {stats.get('bytes_in_use', 0)/gb:.2f} GB | "
+        f"HBM peak: {stats.get('peak_bytes_in_use', 0)/gb:.2f} GB | "
+        f"HBM limit: {stats.get('bytes_limit', 0)/gb:.2f} GB | "
+        f"host RSS: {_host_rss_gb():.2f} GB")
+
+
+def get_hbm_capacity_bytes(device=None):
+    return device_memory_stats(device).get("bytes_limit", 0)

@@ -1,0 +1,170 @@
+"""Wall-clock + throughput timers.
+
+Analog of the reference's `deepspeed/utils/timer.py` (`SynchronizedWallClockTimer`,
+`ThroughputTimer`). "Synchronized" here means blocking on outstanding device work via
+`jax.block_until_ready`-style barriers rather than cuda events: on TPU the dispatch is
+async, so an honest timer must fence the device.
+"""
+
+import time
+
+from deepspeed_tpu.utils.logging import logger
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _device_sync():
+    try:
+        import jax
+        # Block on a trivial computation to drain the dispatch queue.
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+class Timer:
+    def __init__(self, name, synchronize=True):
+        self.name = name
+        self.synchronize = synchronize
+        self.started = False
+        self.start_time = 0.0
+        self.elapsed_total = 0.0
+        self.count = 0
+
+    def start(self):
+        if self.started:
+            return
+        if self.synchronize:
+            _device_sync()
+        self.start_time = time.perf_counter()
+        self.started = True
+
+    def stop(self, reset=False):
+        if not self.started:
+            return
+        if self.synchronize:
+            _device_sync()
+        self.elapsed_total += time.perf_counter() - self.start_time
+        self.count += 1
+        self.started = False
+
+    def elapsed(self, reset=True):
+        value = self.elapsed_total
+        if reset:
+            self.reset()
+        return value
+
+    def mean(self):
+        return self.elapsed_total / max(self.count, 1)
+
+    def reset(self):
+        self.elapsed_total = 0.0
+        self.count = 0
+        self.started = False
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry; `log()` prints ms per timer like the reference."""
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name):
+        return name in self.timers
+
+    def log(self, names=None, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        assert normalizer > 0.0
+        names = names if names is not None else list(self.timers.keys())
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed:.2f}"
+        logger.info(string)
+        return string
+
+    def get_mean(self, names, normalizer=1.0, reset=True):
+        assert normalizer > 0.0
+        means = {}
+        for name in names:
+            if name in self.timers:
+                means[name] = self.timers[name].mean() * 1000.0 / normalizer
+                if reset:
+                    self.timers[name].reset()
+        return means
+
+
+class ThroughputTimer:
+    """Tracks samples/sec across steps, skipping warmup steps.
+
+    Mirrors the reference `ThroughputTimer` (`utils/timer.py`): per-step latency,
+    global samples/sec, optional flops-per-sample -> TFLOPs report.
+    """
+
+    def __init__(self, batch_size, start_step=2, steps_per_output=50, monitor_memory=False, logging_fn=None):
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self.started = False
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or logger.info
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_sync()
+            self.start_time = time.perf_counter()
+
+    def stop(self, global_step=False, report_speed=True):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _device_sync()
+            self.end_time = time.perf_counter()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step:
+                if report_speed and self.global_step_count % self.steps_per_output == 0:
+                    self.logging(
+                        f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                        f"global_step={self.global_step_count}, RunningAvgSamplesPerSec={self.avg_samples_per_sec():.6g}, "
+                        f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.6g}")
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self):
+        if self.total_elapsed_time > 0:
+            samples = self.batch_size * max(self.global_step_count - self.start_step, 1)
+            return samples / self.total_elapsed_time
+        return float("-inf")

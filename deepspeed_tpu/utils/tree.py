@@ -1,0 +1,57 @@
+"""Pytree helpers used across the runtime (flat-buffer bookkeeping analogues).
+
+The reference flattens params into contiguous buffers (`csrc/utils/flatten_unflatten.cpp`,
+ZeRO flat fp32 groups); in JAX, pytrees + XLA buffer donation subsume that, so these are
+thin accounting/cast utilities.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def tree_num_params(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    return int(sum(np.prod(l.shape) if hasattr(l, "shape") else 1 for l in leaves))
+
+
+def tree_size_bytes(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    total = 0
+    for l in leaves:
+        if hasattr(l, "shape") and hasattr(l, "dtype"):
+            total += int(np.prod(l.shape)) * jnp.dtype(l.dtype).itemsize
+    return total
+
+def tree_cast(tree, dtype, only_float=True):
+    """Cast floating leaves of a pytree to `dtype` (non-float leaves untouched)."""
+
+    def cast(leaf):
+        if hasattr(leaf, "dtype") and (not only_float or jnp.issubdtype(leaf.dtype, jnp.floating)):
+            return leaf.astype(dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def tree_zeros_like(tree, dtype=None):
+    return jax.tree_util.tree_map(lambda l: jnp.zeros(l.shape, dtype or l.dtype), tree)
+
+
+def tree_global_norm(tree):
+    """L2 norm over all leaves (used for gradient clipping / grad-norm logging)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves))
+
+
+def tree_all_finite(tree):
+    """Scalar bool: every element of every leaf is finite (overflow check)."""
+    leaves = [l for l in jax.tree_util.tree_leaves(tree) if hasattr(l, "dtype")]
+    if not leaves:
+        return jnp.asarray(True)
+    finite = [jnp.all(jnp.isfinite(l)) for l in leaves if jnp.issubdtype(l.dtype, jnp.floating)]
+    if not finite:
+        return jnp.asarray(True)
+    return jnp.stack(finite).all()

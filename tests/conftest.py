@@ -1,0 +1,39 @@
+"""Test harness: 8 virtual CPU devices.
+
+Analog of the reference's in-process multi-rank harness (`tests/unit/common.py:102`
+DistributedTest — N forkserver processes on one box). On TPU the idiomatic
+equivalent is a single process with a virtual 8-device CPU mesh
+(`--xla_force_host_platform_device_count=8`): every sharding/collective code path
+is exercised exactly as on a pod slice, minus the wire.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = xla_flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+# A sitecustomize may have pinned jax_platforms to a hardware backend before this
+# conftest ran; re-pin to CPU for the virtual 8-device harness.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    """Each test starts without an installed global mesh."""
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    yield
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+
+
+@pytest.fixture
+def devices8():
+    ds = jax.devices()
+    assert len(ds) >= 8, f"expected 8 virtual devices, got {len(ds)}"
+    return ds[:8]

@@ -1,0 +1,56 @@
+"""Tiny model fixtures — analog of the reference's `tests/unit/simple_model.py:18`
+(SimpleModel + random_dataloader)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.engine import ModelSpec
+
+
+def make_simple_model(hidden_dim=16, n_layers=2, seed=0, dtype=jnp.float32):
+    """MLP regression model: loss = mse(x @ W... , y)."""
+    rng = np.random.default_rng(seed)
+    params = {
+        f"layer_{i}": {
+            "w": jnp.asarray(rng.normal(0, 0.1, (hidden_dim, hidden_dim)), dtype),
+            "b": jnp.zeros((hidden_dim,), dtype),
+        }
+        for i in range(n_layers)
+    }
+
+    def loss_fn(params, batch, rng=None):
+        x, y = batch["x"], batch["y"]
+        h = x
+        for i in range(n_layers):
+            p = params[f"layer_{i}"]
+            h = jnp.tanh(h @ p["w"] + p["b"])
+        return jnp.mean((h - y)**2)
+
+    return ModelSpec(loss_fn=loss_fn, params=params, name="simple")
+
+
+def random_batches(n, batch_size, hidden_dim=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{
+        "x": rng.normal(0, 1, (batch_size, hidden_dim)).astype(np.float32),
+        "y": rng.normal(0, 1, (batch_size, hidden_dim)).astype(np.float32),
+    } for _ in range(n)]
+
+
+def simple_config(stage=0, dtype="fp32", mesh=None, gas=1, micro=4, **overrides):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+        "steps_per_print": 1000,
+    }
+    if dtype == "fp16":
+        cfg["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    elif dtype == "bf16":
+        cfg["bf16"] = {"enabled": True}
+    if mesh:
+        cfg["mesh"] = mesh
+    cfg.update(overrides)
+    return cfg
