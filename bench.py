@@ -1,0 +1,109 @@
+"""Benchmark: GPT-2 bf16 training step throughput on the local chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+`vs_baseline` is our model-flops utilization (MFU) divided by the reference's
+best published GPT MFU on A100 — 204.49 TFLOPs/GPU of 312 peak = 0.655
+(`docs/_posts/2022-07-26-deepspeed-azure.md:97`, see BASELINE.md). That compares
+"how well each framework drives its own silicon", the only meaningful
+cross-hardware comparison available.
+
+Model size is chosen to fit the chip: gpt2-125m on a single v5e (16G HBM).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def peak_bf16_tflops():
+    """Peak bf16 TFLOPs of the local accelerator generation."""
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    table = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+    for key, val in table.items():
+        if key in gen:
+            return val
+    import jax
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197.0  # assume v5e
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt import GPT2_CONFIGS, make_gpt_model
+
+    model_name = os.environ.get("BENCH_MODEL", "gpt2-125m")
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+
+    cfg = GPT2_CONFIGS[model_name]
+    model = make_gpt_model(cfg=cfg, name=model_name)
+    n_chips = jax.device_count()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": batch,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": int(os.environ.get("BENCH_ZERO", "1"))},
+        "steps_per_print": 10**9,
+    })
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (engine.train_batch_size(), seq + 1)).astype(np.int32)
+    b = {"tokens": tokens}
+
+    for _ in range(warmup):
+        loss = engine.train_batch(b)
+    # NOTE: on tunneled backends block_until_ready can be a no-op; a scalar
+    # device_get is the only reliable completion fence.
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(b)
+    float(loss)  # sequential state dependency → fences all steps
+    dt = time.perf_counter() - t0
+
+    step_time = dt / steps
+    samples_per_sec = engine.train_batch_size() / step_time
+    samples_per_sec_chip = samples_per_sec / n_chips
+
+    # 6 * N * tokens flops per fwd+bwd (remat adds ~1 fwd → factor 8 if remat on;
+    # report standard 6N convention like the reference's flops profiler)
+    n_params = cfg.num_params()
+    flops_per_step = 6.0 * n_params * engine.train_batch_size() * seq
+    tflops_per_chip = flops_per_step / step_time / n_chips / 1e12
+    mfu = tflops_per_chip / peak_bf16_tflops()
+    vs_baseline = mfu / 0.655
+
+    print(json.dumps({
+        "metric": f"{model_name}_bf16_zero{engine.zero_stage}_train_samples_per_sec_per_chip",
+        "value": round(samples_per_sec_chip, 3),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(vs_baseline, 4),
+        "extra": {
+            "step_time_ms": round(step_time * 1e3, 2),
+            "tflops_per_chip": round(tflops_per_chip, 2),
+            "mfu": round(mfu, 4),
+            "seq_len": seq,
+            "global_batch": engine.train_batch_size(),
+            "n_chips": n_chips,
+            "loss": float(loss),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -203,7 +203,7 @@ def _make_all_reduce(mesh, axes, op, shape, dtype):
         return red(x, axes)
 
     spec = P(axes)  # input sharded on leading dim across the reduce axes
-    return jax.jit(shard_map(local, mesh=mesh, in_specs=(spec,), out_specs=spec))
+    return jax.jit(shard_map(local, mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False))
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, axis=None, group=None):
@@ -228,7 +228,7 @@ def _make_all_gather(mesh, axes):
     def local(x):
         return jax.lax.all_gather(x, axes, axis=0, tiled=True)
 
-    return jax.jit(shard_map(local, mesh=mesh, in_specs=(P(axes),), out_specs=P()))
+    return jax.jit(shard_map(local, mesh=mesh, in_specs=(P(axes),), out_specs=P(), check_vma=False))
 
 
 def all_gather(tensor, axis=None, tiled=True, group=None):
@@ -245,7 +245,7 @@ def _make_reduce_scatter(mesh, axes):
     def local(x):
         return jax.lax.psum_scatter(x, axes, scatter_dimension=0, tiled=True)
 
-    return jax.jit(shard_map(local, mesh=mesh, in_specs=(P(),), out_specs=P(axes)))
+    return jax.jit(shard_map(local, mesh=mesh, in_specs=(P(),), out_specs=P(axes), check_vma=False))
 
 
 def reduce_scatter(tensor, op=ReduceOp.SUM, axis=None, group=None):
@@ -271,7 +271,7 @@ def _make_all_to_all(mesh, axes, split_axis, concat_axis, ndim):
     spec_out = [None] * ndim
     spec_out[split_axis] = axes
     return jax.jit(shard_map(local, mesh=mesh, in_specs=(P(*spec_in),),
-                             out_specs=P(*spec_out)))
+                             out_specs=P(*spec_out), check_vma=False))
 
 
 def all_to_all(tensor, axis=None, split_axis=0, concat_axis=0, group=None):

@@ -184,3 +184,14 @@ def data_parallel_sharding(*per_axis) -> NamedSharding:
 
 def replicated_sharding() -> NamedSharding:
     return NamedSharding(get_mesh(), P())
+
+
+def shard_constraint(x, *spec_entries):
+    """`with_sharding_constraint` against the current global mesh; no-op when no
+    mesh is installed (lets model code run standalone). Axis entries naming axes
+    of size 1 are dropped automatically — XLA rejects size-1... no, size-1 axes are
+    fine; entries are kept as-is."""
+    if not has_mesh():
+        return x
+    spec = P(*spec_entries)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(get_mesh(), spec))

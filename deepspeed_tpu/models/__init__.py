@@ -1,0 +1,8 @@
+from deepspeed_tpu.models.gpt import (
+    GPTConfig,
+    init_gpt_params,
+    gpt_forward,
+    make_gpt_model,
+    make_gpt_decode_model,
+    GPT2_CONFIGS,
+)

@@ -1,0 +1,442 @@
+"""GPT family — the flagship model, TPU-first.
+
+The reference trains GPT through client Megatron models and serves it through
+injected containers (`module_inject/containers/gpt2.py`, `megatron_gpt.py`); its
+flagship benchmark is GPT ZeRO-3 (BASELINE.md). Here the model itself is part of
+the framework's zoo, written the TPU way:
+
+  * stacked block parameters + `lax.scan` over layers — one compiled block program,
+    O(1) compile time in depth;
+  * logical sharding via PartitionSpecs: batch on `data`, heads/ffn on `tensor`
+    (Megatron TP), sequence on `sequence` (Ulysses — see parallel/ulysses.py);
+  * `jax.checkpoint` (remat) policy per block for activation-memory control
+    (analog of `runtime/activation_checkpointing/`);
+  * bf16 activations, fp32 softmax/layernorm accumulation;
+  * a static-shape KV-cache decode path for the inference engine.
+
+Architecture: pre-LN GPT-2 (learned positions) with optional GPT-NeoX/LLaMA-style
+rotary embeddings and (Sw)iGLU — enough surface to cover the reference's
+gpt2/gptj/neox/llama containers with one implementation.
+"""
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, shard_constraint
+from deepspeed_tpu.runtime.engine import ModelSpec
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304          # padded to 128 multiple (MXU-friendly)
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: Optional[int] = None       # default 4*d_model (or 8/3 for swiglu)
+    max_seq_len: int = 1024
+    dropout: float = 0.0
+    use_rotary: bool = False         # False: learned positions (GPT-2); True: RoPE
+    rotary_pct: float = 1.0
+    use_swiglu: bool = False         # LLaMA-style gated MLP
+    use_rmsnorm: bool = False        # LLaMA-style RMSNorm
+    tie_embeddings: bool = True
+    remat: bool = True               # jax.checkpoint each block
+    remat_policy: str = "nothing_saveable"  # or "dots_with_no_batch_dims_saveable"
+    use_flash_attention: bool = False  # pallas kernel (ops/pallas/flash_attention.py)
+    dtype: Any = jnp.bfloat16        # activation dtype
+
+    def __post_init__(self):
+        if self.d_ff is None:
+            self.d_ff = int(8 * self.d_model / 3) if self.use_swiglu else 4 * self.d_model
+        assert self.d_model % self.n_head == 0
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_head
+
+    def num_params(self):
+        wpe = 0 if self.use_rotary else self.max_seq_len * self.d_model
+        per_block = (4 * self.d_model * self.d_model          # qkv + proj
+                     + (3 if self.use_swiglu else 2) * self.d_model * self.d_ff
+                     + 4 * self.d_model)                       # norms/biases approx
+        return self.vocab_size * self.d_model + wpe + self.n_layer * per_block
+
+
+# Reference model sizes used in the baseline ladder (BASELINE.md).
+GPT2_CONFIGS = {
+    "gpt2-tiny": GPTConfig(n_layer=2, n_head=4, d_model=128, max_seq_len=256, vocab_size=1024),
+    "gpt2-125m": GPTConfig(n_layer=12, n_head=12, d_model=768, max_seq_len=1024),
+    "gpt2-350m": GPTConfig(n_layer=24, n_head=16, d_model=1024, max_seq_len=1024),
+    "gpt2-760m": GPTConfig(n_layer=24, n_head=16, d_model=1536, max_seq_len=1024),
+    "gpt2-1.3b": GPTConfig(n_layer=24, n_head=32, d_model=2048, max_seq_len=1024),
+    "gpt2-2.7b": GPTConfig(n_layer=32, n_head=32, d_model=2560, max_seq_len=1024),
+    "gpt2-6.7b": GPTConfig(n_layer=32, n_head=32, d_model=4096, max_seq_len=1024),
+}
+
+
+# ----------------------------------------------------------------------
+# init
+# ----------------------------------------------------------------------
+
+
+def init_gpt_params(cfg: GPTConfig, seed: int = 0, dtype=jnp.float32):
+    """Stacked-block parameter pytree. Block leaves have leading dim n_layer."""
+    rng = np.random.default_rng(seed)
+    D, F, L, H = cfg.d_model, cfg.d_ff, cfg.n_layer, cfg.n_head
+
+    def norm(*shape, scale=0.02):
+        return jnp.asarray(rng.normal(0.0, scale, shape), dtype)
+
+    def zeros(*shape):
+        return jnp.zeros(shape, dtype)
+
+    def ones(*shape):
+        return jnp.ones(shape, dtype)
+
+    proj_scale = 0.02 / math.sqrt(2 * L)  # GPT-2 residual-proj init
+    block = {
+        "ln1_scale": ones(L, D),
+        "ln2_scale": ones(L, D),
+        "attn_qkv_w": norm(L, D, 3 * D),
+        "attn_qkv_b": zeros(L, 3 * D),
+        "attn_out_w": jnp.asarray(rng.normal(0.0, proj_scale, (L, D, D)), dtype),
+        "attn_out_b": zeros(L, D),
+        "mlp_out_b": zeros(L, D),
+    }
+    if not cfg.use_rmsnorm:
+        block["ln1_bias"] = zeros(L, D)
+        block["ln2_bias"] = zeros(L, D)
+    if cfg.use_swiglu:
+        block["mlp_gate_w"] = norm(L, D, F)
+        block["mlp_up_w"] = norm(L, D, F)
+        block["mlp_down_w"] = jnp.asarray(rng.normal(0.0, proj_scale, (L, F, D)), dtype)
+    else:
+        block["mlp_up_w"] = norm(L, D, F)
+        block["mlp_up_b"] = zeros(L, F)
+        block["mlp_down_w"] = jnp.asarray(rng.normal(0.0, proj_scale, (L, F, D)), dtype)
+
+    params = {
+        "wte": norm(cfg.vocab_size, D, scale=0.02),
+        "blocks": block,
+        "lnf_scale": ones(D),
+    }
+    if not cfg.use_rmsnorm:
+        params["lnf_bias"] = zeros(D)
+    if not cfg.use_rotary:
+        params["wpe"] = norm(cfg.max_seq_len, D, scale=0.01)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(cfg.vocab_size, D, scale=0.02)
+    return params
+
+
+def gpt_param_specs(cfg: GPTConfig):
+    """Megatron-style TP PartitionSpecs (reference: AutoTP's shard plan,
+    `module_inject/auto_tp.py` — column-parallel qkv/up, row-parallel out/down).
+    ZeRO adds its axes orthogonally (runtime/zero.py)."""
+    t = TENSOR_AXIS
+    block = {
+        "ln1_scale": P(None, None),
+        "ln2_scale": P(None, None),
+        "attn_qkv_w": P(None, None, t),      # column parallel
+        "attn_qkv_b": P(None, t),
+        "attn_out_w": P(None, t, None),      # row parallel
+        "attn_out_b": P(None, None),
+        "mlp_out_b": P(None, None),
+    }
+    if not cfg.use_rmsnorm:
+        block["ln1_bias"] = P(None, None)
+        block["ln2_bias"] = P(None, None)
+    if cfg.use_swiglu:
+        block["mlp_gate_w"] = P(None, None, t)
+        block["mlp_up_w"] = P(None, None, t)
+        block["mlp_down_w"] = P(None, t, None)
+    else:
+        block["mlp_up_w"] = P(None, None, t)
+        block["mlp_up_b"] = P(None, t)
+        block["mlp_down_w"] = P(None, t, None)
+    specs = {
+        "wte": P(t, None),                   # vocab-parallel embedding
+        "blocks": block,
+        "lnf_scale": P(None),
+    }
+    if not cfg.use_rmsnorm:
+        specs["lnf_bias"] = P(None)
+    if not cfg.use_rotary:
+        specs["wpe"] = P(None, None)
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(t, None)
+    return specs
+
+
+# ----------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------
+
+
+def _norm(x, scale, bias, use_rms, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    if use_rms:
+        xf = xf * jax.lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1, keepdims=True) + eps)
+        return (xf * scale.astype(jnp.float32)).astype(x.dtype)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    xf = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (xf * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x, positions, rotary_dims):
+    """Rotary position embedding over the first `rotary_dims` of the head dim.
+    x: [B, T, H, hd]; positions: [B, T]."""
+    hd = x.shape[-1]
+    rd = rotary_dims
+    freqs = 1.0 / (10000.0**(jnp.arange(0, rd, 2, dtype=jnp.float32) / rd))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,rd/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x_rot, x_pass = x[..., :rd], x[..., rd:]
+    x1, x2 = x_rot[..., ::2], x_rot[..., 1::2]
+    out1 = x1 * cos - x2 * sin
+    out2 = x1 * sin + x2 * cos
+    rotated = jnp.stack([out1, out2], axis=-1).reshape(x_rot.shape)
+    return jnp.concatenate([rotated, x_pass], axis=-1).astype(x.dtype) if rd < hd \
+        else rotated.astype(x.dtype)
+
+
+def _attention(q, k, v, causal_mask, cfg, attn_fn=None):
+    """q,k,v: [B, T, H, hd] → [B, T, H, hd]. fp32 softmax."""
+    if attn_fn is not None:
+        return attn_fn(q, k, v)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    logits = jnp.where(causal_mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def _block(x, p, cfg: GPTConfig, positions, dropout_rng=None, attn_fn=None):
+    """One transformer block. x: [B, T, D]."""
+    B, T, D = x.shape
+    H, hd = cfg.n_head, cfg.head_dim
+    use_rms = cfg.use_rmsnorm
+
+    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), use_rms)
+    qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, hd)
+    k = k.reshape(B, T, H, hd)
+    v = v.reshape(B, T, H, hd)
+    # activations: heads on tensor axis (Megatron), seq on sequence axis
+    q = shard_constraint(q, DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, None)
+    k = shard_constraint(k, DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, None)
+    v = shard_constraint(v, DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, None)
+    if cfg.use_rotary:
+        rd = int(cfg.rotary_pct * hd) // 2 * 2
+        q = _rope(q, positions, rd)
+        k = _rope(k, positions, rd)
+    causal = jnp.tril(jnp.ones((T, T), bool))[None, None, :, :]
+    attn = _attention(q, k, v, causal, cfg, attn_fn=attn_fn)
+    attn = attn.reshape(B, T, D)
+    x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
+
+    h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms)
+    if cfg.use_swiglu:
+        up = jax.nn.silu(h @ p["mlp_gate_w"]) * (h @ p["mlp_up_w"])
+    else:
+        up = jax.nn.gelu(h @ p["mlp_up_w"] + p["mlp_up_b"])
+    up = shard_constraint(up, DATA_AXIS, SEQ_AXIS, TENSOR_AXIS)
+    x = x + up @ p["mlp_down_w"] + p["mlp_out_b"]
+    return shard_constraint(x, DATA_AXIS, SEQ_AXIS, None)
+
+
+def gpt_forward(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None):
+    """tokens: [B, T] int32 → logits [B, T, vocab]."""
+    B, T = tokens.shape
+    dtype = cfg.dtype
+    x = jnp.take(params["wte"], tokens, axis=0).astype(dtype)
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    if not cfg.use_rotary:
+        x = x + jnp.take(params["wpe"], positions, axis=0).astype(dtype)
+    x = shard_constraint(x, DATA_AXIS, SEQ_AXIS, None)
+
+    block_fn = partial(_block, cfg=cfg, positions=positions, attn_fn=attn_fn)
+    if cfg.remat:
+        policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+        block_fn = jax.checkpoint(block_fn, policy=policy)
+
+    def scan_body(x, layer_params):
+        return block_fn(x, layer_params), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+
+    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm)
+    head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
+    logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+    return logits
+
+
+def gpt_loss(params, batch, rng, cfg: GPTConfig, attn_fn=None):
+    """Causal-LM cross entropy. batch: {"tokens": [B,T]} or {"input_ids", "labels"}."""
+    tokens = batch.get("tokens", batch.get("input_ids"))
+    labels = batch.get("labels")
+    if labels is None:
+        inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    else:
+        inputs = tokens
+    logits = gpt_forward(params, inputs, cfg, attn_fn=attn_fn)
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    safe_labels = jnp.maximum(labels, 0)  # ignore-index (<0) must not wrap
+    gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    mask = (labels >= 0).astype(jnp.float32)
+    nll = (logz - gold) * mask
+    return nll.sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_gpt_model(cfg: GPTConfig = None, name="gpt2-125m", seed=0, attn_fn=None) -> ModelSpec:
+    """ModelSpec for the training engine."""
+    cfg = cfg or GPT2_CONFIGS[name]
+    params = init_gpt_params(cfg, seed=seed)
+    return ModelSpec(
+        loss_fn=partial(gpt_loss, cfg=cfg, attn_fn=attn_fn),
+        params=params,
+        param_specs=gpt_param_specs(cfg),
+        apply_fn=partial(gpt_forward, cfg=cfg),
+        name=name,
+    )
+
+
+# ----------------------------------------------------------------------
+# decode path (KV cache) — for the inference engine
+# ----------------------------------------------------------------------
+
+
+def init_kv_cache(cfg: GPTConfig, batch_size, max_len, dtype=jnp.bfloat16):
+    """[L, B, max_len, H, hd] stacked cache (reference: InferenceContext workspace,
+    `csrc/transformer/inference/includes/inference_context.h:49`)."""
+    shape = (cfg.n_layer, batch_size, max_len, cfg.n_head, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+            "length": jnp.zeros((batch_size,), jnp.int32)}
+
+
+def _block_decode(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
+    """Single-token decode for one block. x: [B, 1, D]; cache_[kv]: [B, M, H, hd];
+    pos: [B] current position."""
+    B, _, D = x.shape
+    H, hd = cfg.n_head, cfg.head_dim
+    M = cache_k.shape[1]
+    use_rms = cfg.use_rmsnorm
+
+    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), use_rms)
+    qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, 1, H, hd)
+    k = k.reshape(B, 1, H, hd)
+    v = v.reshape(B, 1, H, hd)
+    if cfg.use_rotary:
+        rd = int(cfg.rotary_pct * hd) // 2 * 2
+        q = _rope(q, pos[:, None], rd)
+        k = _rope(k, pos[:, None], rd)
+
+    # scatter k,v at pos
+    onehot = jax.nn.one_hot(pos, M, dtype=k.dtype)            # [B, M]
+    cache_k = cache_k * (1 - onehot)[..., None, None] + onehot[..., None, None] * k
+    cache_v = cache_v * (1 - onehot)[..., None, None] + onehot[..., None, None] * v
+
+    scale = 1.0 / math.sqrt(hd)
+    logits = jnp.einsum("bohd,bmhd->bhom", q, cache_k).astype(jnp.float32) * scale
+    valid = (jnp.arange(M)[None, :] <= pos[:, None])[:, None, None, :]
+    logits = jnp.where(valid, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    attn = jnp.einsum("bhom,bmhd->bohd", probs, cache_v).reshape(B, 1, D)
+    x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
+
+    h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms)
+    if cfg.use_swiglu:
+        up = jax.nn.silu(h @ p["mlp_gate_w"]) * (h @ p["mlp_up_w"])
+    else:
+        up = jax.nn.gelu(h @ p["mlp_up_w"] + p["mlp_up_b"])
+    x = x + up @ p["mlp_down_w"] + p["mlp_out_b"]
+    return x, cache_k, cache_v
+
+
+def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, seed=0):
+    """DecodeModelSpec for the inference engine (prefill + per-token decode)."""
+    from deepspeed_tpu.inference.engine import DecodeModelSpec
+    cfg = cfg or GPT2_CONFIGS[name]
+    if params is None:
+        params = init_gpt_params(cfg, seed=seed)
+
+    def prefill_fn(params, tokens, cache, pad_mask):
+        B, T = tokens.shape
+        # single pass: compute activations AND populate the KV cache in one scan
+        x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+        if not cfg.use_rotary:
+            x = x + jnp.take(params["wpe"], positions, axis=0).astype(cfg.dtype)
+
+        def body(x, inputs):
+            p, ck, cv = inputs
+            h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm)
+            qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            H, hd = cfg.n_head, cfg.head_dim
+            k = k.reshape(B, T, H, hd)
+            v = v.reshape(B, T, H, hd)
+            q = q.reshape(B, T, H, hd)
+            if cfg.use_rotary:
+                rd = int(cfg.rotary_pct * hd) // 2 * 2
+                q = _rope(q, positions, rd)
+                k = _rope(k, positions, rd)
+            ck = ck.at[:, :T].set(k.astype(ck.dtype))
+            cv = cv.at[:, :T].set(v.astype(cv.dtype))
+            causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
+            attn = _attention(q, k, v, causal, cfg).reshape(B, T, cfg.d_model)
+            x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
+            h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.use_rmsnorm)
+            if cfg.use_swiglu:
+                up = jax.nn.silu(h @ p["mlp_gate_w"]) * (h @ p["mlp_up_w"])
+            else:
+                up = jax.nn.gelu(h @ p["mlp_up_w"] + p["mlp_up_b"])
+            x = x + up @ p["mlp_down_w"] + p["mlp_out_b"]
+            return x, (ck, cv)
+
+        x, (ks, vs) = jax.lax.scan(
+            lambda c, inp: body(c, inp), x,
+            (params["blocks"], cache["k"], cache["v"]))
+        x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm)
+        head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
+        logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+        cache = {"k": ks, "v": vs, "length": jnp.full((B,), T, jnp.int32)}
+        return logits, cache
+
+    def decode_fn(params, token, pos, cache):
+        B = token.shape[0]
+        x = jnp.take(params["wte"], token[:, None], axis=0).astype(cfg.dtype)
+        if not cfg.use_rotary:
+            x = x + jnp.take(params["wpe"], pos[:, None], axis=0).astype(cfg.dtype)
+
+        def body(x, inputs):
+            p, ck, cv = inputs
+            x, ck, cv = _block_decode(x, p, ck, cv, pos, cfg)
+            return x, (ck, cv)
+
+        x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+        x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm)
+        head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
+        logits = jnp.einsum("bod,vd->bov", x, head.astype(x.dtype))[:, 0]
+        cache = {"k": ks, "v": vs, "length": cache["length"] + 1}
+        return logits, cache
+
+    def init_cache(batch_size, max_len, dtype=jnp.bfloat16):
+        return init_kv_cache(cfg, batch_size, max_len, dtype)
+
+    return DecodeModelSpec(prefill_fn=prefill_fn, decode_fn=decode_fn,
+                           init_cache=init_cache, params=params, name=name)

@@ -1,0 +1,3 @@
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+from deepspeed_tpu.ops.pallas.norms import fused_layer_norm, fused_rms_norm
+from deepspeed_tpu.ops.pallas.quant import quantize_int8, dequantize_int8

@@ -1,0 +1,286 @@
+"""Flash attention (Pallas, TPU).
+
+The training-attention hot op — replaces the reference's fused softmax CUDA
+kernels (`csrc/transformer/softmax_kernels.cu`, sparse/triton attention
+`ops/sparse_attention/matmul.py`) with the memory-optimal streaming formulation:
+online softmax over KV blocks, O(T) memory, fp32 accumulation, causal masking,
+custom VJP with the standard recomputation backward.
+
+Layout: [B, H, T, D] (wrapper transposes from the zoo's [B, T, H, D]).
+K/V live whole per (batch, head) in VMEM — right up to ~8k sequence on v5e;
+longer sequences go through ring attention (parallel/ring.py) on top of this
+kernel per step.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _use_interpret():
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+# ----------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_k):
+    # q_ref: [block_q, D]; k_ref/v_ref: [T, D]; o_ref: [block_q, D]; lse_ref: [block_q]
+    qi = pl.program_id(1)
+    block_q, D = q_ref.shape
+    T = k_ref.shape[0]
+    q = q_ref[:, :].astype(jnp.float32) * sm_scale
+
+    nblocks = T // block_k
+    if causal:
+        # only kv blocks whose start <= q block end
+        nblocks_dyn = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, nblocks)
+    else:
+        nblocks_dyn = nblocks
+
+    def body(j, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nblocks_dyn, body, (acc0, m0, l0))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[:, :] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    B, H, T, D = q.shape
+    BH = B * H
+    q2 = q.reshape(BH, T, D)
+    k2 = k.reshape(BH, T, D)
+    v2 = v.reshape(BH, T, D)
+    grid = (BH, T // block_q)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q2, k2, v2)
+    return out.reshape(B, H, T, D), lse.reshape(B, H, T)
+
+
+# ----------------------------------------------------------------------
+# backward
+# ----------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, sm_scale, causal, block_k):
+    qi = pl.program_id(1)
+    block_q, D = q_ref.shape
+    T = k_ref.shape[0]
+    q = q_ref[:, :].astype(jnp.float32) * sm_scale
+    do = do_ref[:, :].astype(jnp.float32)
+    lse = lse_ref[:]
+    delta = delta_ref[:]
+
+    nblocks = T // block_k
+    nblocks_dyn = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, nblocks) \
+        if causal else nblocks
+
+    def body(j, dq):
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nblocks_dyn, body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[:, :] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                    *, sm_scale, causal, block_q):
+    ki = pl.program_id(1)
+    block_k, D = k_ref.shape
+    T = q_ref.shape[0]
+    k = k_ref[:, :].astype(jnp.float32)
+    v = v_ref[:, :].astype(jnp.float32)
+
+    nblocks = T // block_q
+    start = (ki * block_k) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32) * sm_scale
+        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(i * block_q, block_q)]
+        delta = delta_ref[pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                                 # [bq, bk]
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, D), jnp.float32)
+    dv0 = jnp.zeros((block_k, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, nblocks, body, (dk0, dv0))
+    dk_ref[:, :] = dk.astype(dk_ref.dtype)
+    dv_ref[:, :] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
+    q, k, v, o, lse = res
+    do = g
+    B, H, T, D = q.shape
+    BH = B * H
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [B,H,T]
+
+    q2, k2, v2 = (x.reshape(BH, T, D) for x in (q, k, v))
+    do2 = do.reshape(BH, T, D)
+    lse2 = lse.reshape(BH, T)
+    delta2 = delta.reshape(BH, T)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k),
+        grid=(BH, T // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        interpret=interpret,
+    )(q2, k2, v2, do2, lse2, delta2)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q),
+        grid=(BH, T // block_k),
+        in_specs=[
+            pl.BlockSpec((None, T, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((None, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, T, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((None, T), lambda bh, ki: (bh, 0)),
+            pl.BlockSpec((None, T), lambda bh, ki: (bh, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k, D), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        ],
+        interpret=interpret,
+    )(q2, k2, v2, do2, lse2, delta2)
+
+    return (dq.reshape(B, H, T, D), dk.reshape(B, H, T, D), dv.reshape(B, H, T, D))
+
+
+# ----------------------------------------------------------------------
+# public op
+# ----------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    return _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal=True, sm_scale=None, block_q=DEFAULT_BLOCK_Q,
+                    block_k=DEFAULT_BLOCK_K, layout="BTHD", interpret=None):
+    """Flash attention. q,k,v: [B,T,H,D] ("BTHD", zoo layout) or [B,H,T,D].
+
+    Sequence length must be a multiple of the block size (the zoo pads to 128
+    multiples; MXU-friendly anyway).
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    if layout == "BTHD":
+        q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    B, H, T, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    assert T % block_q == 0 and T % block_k == 0, \
+        f"seq len {T} must be a multiple of block sizes ({block_q},{block_k})"
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    out = _flash(q, k, v, float(sm_scale), bool(causal), int(block_q), int(block_k),
+                 bool(interpret))
+    if layout == "BTHD":
+        out = jnp.swapaxes(out, 1, 2)
+    return out

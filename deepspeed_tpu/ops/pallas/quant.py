@@ -1,0 +1,101 @@
+"""Groupwise int8 quantization (Pallas).
+
+Analog of the reference's `csrc/quantization/` suite (quantize.cu, swizzled
+quant, quant_reduce) powering ZeRO++ qwZ/qgZ and weight-only inference quant.
+Symmetric per-group int8: scale = max|x| / 127 per group of `group_size`
+contiguous elements along the last dim.
+
+These ops are the building blocks for quantized collectives: all-gather/reduce
+run over the int8 payload + f32 scales, dequantize after (runtime path in
+runtime/quantized_collectives.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _use_interpret():
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _quant_kernel(x_ref, q_ref, s_ref, *, group_size):
+    x = x_ref[:, :].astype(jnp.float32)            # [rows, D]
+    rows, D = x.shape
+    g = D // group_size
+    xg = x.reshape(rows, g, group_size)
+    amax = jnp.max(jnp.abs(xg), axis=-1)           # [rows, g]
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xg / scale[..., None]), -127, 127).astype(jnp.int8)
+    q_ref[:, :] = q.reshape(rows, D)
+    s_ref[:, :] = scale
+
+
+def _dequant_kernel(q_ref, s_ref, o_ref, *, group_size):
+    q = q_ref[:, :].astype(jnp.float32)
+    rows, D = q.shape
+    g = D // group_size
+    s = s_ref[:, :]
+    x = q.reshape(rows, g, group_size) * s[..., None]
+    o_ref[:, :] = x.reshape(rows, D).astype(o_ref.dtype)
+
+
+def _block_rows(n):
+    for b in (128, 64, 32, 16, 8, 4, 2, 1):
+        if n % b == 0:
+            return b
+    return 1
+
+
+def quantize_int8(x, group_size=128, interpret=None):
+    """x: [..., D] → (q int8 [..., D], scales f32 [..., D//group_size])."""
+    if interpret is None:
+        interpret = _use_interpret()
+    orig = x.shape
+    D = orig[-1]
+    assert D % group_size == 0, f"last dim {D} not divisible by group_size {group_size}"
+    x2 = x.reshape(-1, D)
+    N = x2.shape[0]
+    bn = _block_rows(N)
+    g = D // group_size
+    q, s = pl.pallas_call(
+        functools.partial(_quant_kernel, group_size=group_size),
+        grid=(N // bn,),
+        in_specs=[pl.BlockSpec((bn, D), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((bn, D), lambda i: (i, 0)),
+            pl.BlockSpec((bn, g), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, D), jnp.int8),
+            jax.ShapeDtypeStruct((N, g), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2)
+    return q.reshape(orig), s.reshape(orig[:-1] + (g,))
+
+
+def dequantize_int8(q, scales, dtype=jnp.bfloat16, group_size=128, interpret=None):
+    if interpret is None:
+        interpret = _use_interpret()
+    orig = q.shape
+    D = orig[-1]
+    q2 = q.reshape(-1, D)
+    s2 = scales.reshape(-1, D // group_size)
+    N = q2.shape[0]
+    bn = _block_rows(N)
+    out = pl.pallas_call(
+        functools.partial(_dequant_kernel, group_size=group_size),
+        grid=(N // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, D), lambda i: (i, 0)),
+            pl.BlockSpec((bn, D // group_size), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, D), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, D), dtype),
+        interpret=interpret,
+    )(q2, s2)
+    return out.reshape(orig)

@@ -1,0 +1,8 @@
+from deepspeed_tpu.parallel.ulysses import DistributedAttention, ulysses_attention
+from deepspeed_tpu.parallel.moe import MoE, MoELayer, top1_gating, top2_gating
+from deepspeed_tpu.parallel.tp import (
+    column_parallel_spec,
+    row_parallel_spec,
+    plan_tp_specs,
+    TiledLinear,
+)

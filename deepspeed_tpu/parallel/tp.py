@@ -1,0 +1,133 @@
+"""Tensor parallelism: sharding rules + automatic planning.
+
+Reference surfaces covered:
+  * Megatron-style layer helpers — the reference delegates training TP to the
+    client via `mpu` (`deepspeed/__init__.py:94`); here we make it first-class
+    with PartitionSpec helpers.
+  * AutoTP (`module_inject/auto_tp.py:175` + `tp_shard.py`, `fusedqkv_utils.py`):
+    policy-free sharding of an arbitrary transformer param tree. The reference
+    walks the module graph looking for all-reduce points; we classify 2-D weight
+    leaves by name/shape heuristics into column-parallel (shard output dim),
+    row-parallel (shard input dim) or replicated — under SPMD the all-reduce
+    points then fall out of XLA's partitioner instead of being patched in.
+  * TiledLinear (`runtime/zero/tiling.py:32`): activation-memory capping by
+    splitting a big matmul — on TPU a lax.map over column tiles.
+"""
+
+import re
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import TENSOR_AXIS
+from deepspeed_tpu.utils.logging import logger
+
+
+def column_parallel_spec(ndim=2):
+    """Shard the output (last) dim: Y = X @ W, W: [in, out@tp]."""
+    return P(*([None] * (ndim - 1) + [TENSOR_AXIS]))
+
+
+def row_parallel_spec(ndim=2):
+    """Shard the input (second-to-last) dim: W: [in@tp, out] — XLA inserts the
+    all-reduce after the partial matmul."""
+    if ndim == 1:
+        return P(None)
+    return P(*([None] * (ndim - 2) + [TENSOR_AXIS, None]))
+
+
+# name patterns → parallel style (covers HF gpt2/llama/opt/bloom/neox naming and
+# our zoo; mirrors the module lists AutoTP builds per policy)
+_COLUMN_PATTERNS = [
+    r"qkv", r"q_proj", r"k_proj", r"v_proj", r"query", r"key", r"value",
+    r"wi\b", r"up_proj", r"gate_proj", r"fc_in", r"c_fc", r"mlp_up", r"mlp_gate",
+    r"intermediate", r"dense_h_to_4h",
+]
+_ROW_PATTERNS = [
+    r"o_proj", r"out_proj", r"attn_out", r"c_proj", r"wo\b", r"down_proj",
+    r"fc_out", r"mlp_down", r"dense_4h_to_h", r"attention\.dense",
+]
+_EMBED_PATTERNS = [r"wte", r"embed_tokens", r"word_embeddings", r"lm_head", r"embed_out"]
+
+
+def _classify(path: str):
+    low = path.lower()
+    for pat in _ROW_PATTERNS:
+        if re.search(pat, low):
+            return "row"
+    for pat in _COLUMN_PATTERNS:
+        if re.search(pat, low):
+            return "column"
+    for pat in _EMBED_PATTERNS:
+        if re.search(pat, low):
+            return "embed"
+    return "replicate"
+
+
+def plan_tp_specs(params, tp_size: Optional[int] = None, overrides: Dict[str, P] = None,
+                  stacked_layers: bool = False, verbose=False):
+    """AutoTP analog: produce a PartitionSpec pytree for an arbitrary param tree.
+
+    `stacked_layers`: leaves carry a leading layer dim (scan-over-layers zoo
+    models) — specs get a leading None. `overrides`: regex → PartitionSpec.
+    """
+    overrides = overrides or {}
+
+    def leaf_spec(path_parts, leaf):
+        path = "/".join(str(p) for p in path_parts)
+        for pat, spec in overrides.items():
+            if re.search(pat, path):
+                return spec
+        ndim = getattr(leaf, "ndim", 0)
+        eff_ndim = ndim - (1 if stacked_layers else 0)
+        kind = _classify(path)
+        if eff_ndim < 1 or kind == "replicate":
+            spec = P(*([None] * ndim))
+        elif kind == "embed":
+            # vocab-parallel embedding: shard vocab (first effective) dim
+            spec = P(*([None] * (1 if stacked_layers else 0) + [TENSOR_AXIS]
+                       + [None] * (eff_ndim - 1)))
+        elif kind == "column":
+            base = [None] * (eff_ndim - 1) + [TENSOR_AXIS]
+            spec = P(*(([None] if stacked_layers else []) + base))
+        else:  # row
+            if eff_ndim == 1:
+                spec = P(*([None] * ndim))
+            else:
+                base = [None] * (eff_ndim - 2) + [TENSOR_AXIS, None]
+                spec = P(*(([None] if stacked_layers else []) + base))
+        if verbose:
+            logger.info(f"AutoTP: {path} [{getattr(leaf, 'shape', ())}] -> {kind} {spec}")
+        return spec
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: leaf_spec([getattr(k, 'key', getattr(k, 'name', getattr(k, 'idx', k)))
+                                      for k in path], leaf),
+        params)
+
+
+def tiled_linear(x, w, b=None, splits=4):
+    """TiledLinear (`runtime/zero/tiling.py:32`): compute X @ W in column tiles to
+    cap peak activation memory; XLA keeps tiles in sequence."""
+    out_dim = w.shape[-1]
+    assert out_dim % splits == 0, f"out dim {out_dim} not divisible into {splits} tiles"
+    tiles = jnp.split(w, splits, axis=-1)
+    outs = [x @ t for t in tiles]
+    y = jnp.concatenate(outs, axis=-1)
+    if b is not None:
+        y = y + b
+    return y
+
+
+class TiledLinear:
+    """Class-form parity wrapper over `tiled_linear`."""
+
+    def __init__(self, in_features, out_features, in_splits=1, out_splits=4, bias=True):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.out_splits = out_splits
+
+    def __call__(self, params, x):
+        return tiled_linear(x, params["w"], params.get("b"), splits=self.out_splits)

@@ -1,0 +1,120 @@
+"""GPT model tests: training convergence, TP/ZeRO sharding, decode-vs-forward parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt import (GPTConfig, GPT2_CONFIGS, init_gpt_params,
+                                      gpt_forward, make_gpt_model, make_gpt_decode_model)
+
+TINY = GPTConfig(n_layer=2, n_head=4, d_model=64, max_seq_len=64, vocab_size=256,
+                 dtype=jnp.float32, remat=False)
+
+
+def _tokens(batch, T, vocab, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, vocab, (batch, T)).astype(np.int32)
+
+
+def test_forward_shapes():
+    params = init_gpt_params(TINY)
+    toks = _tokens(2, 16, TINY.vocab_size)
+    logits = gpt_forward(params, jnp.asarray(toks), TINY)
+    assert logits.shape == (2, 16, TINY.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+@pytest.mark.parametrize("stage", [0, 3])
+def test_gpt_trains(stage):
+    model = make_gpt_model(cfg=TINY, name="tiny")
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage, "stage3_param_persistence_threshold": 0},
+        "mesh": {"data": 8},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    batch = {"tokens": _tokens(8, 32, TINY.vocab_size)}
+    losses = [float(engine.train_batch(batch)) for _ in range(10)]
+    assert losses[-1] < losses[0], losses
+    # sanity: initial loss ~ log(vocab)
+    assert abs(losses[0] - np.log(TINY.vocab_size)) < 1.0
+
+
+def test_gpt_tp_zero_combined():
+    """TP=2 × data=4, ZeRO-3: must train and shard both ways."""
+    model = make_gpt_model(cfg=TINY, name="tiny")
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 0},
+        "mesh": {"data": 4, "tensor": 2},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    qkv = engine.state.params["blocks"]["attn_qkv_w"]
+    spec = qkv.sharding.spec
+    # TP axis present on last dim, ZeRO domain somewhere else
+    assert "tensor" in str(spec), spec
+    batch = {"tokens": _tokens(8, 32, TINY.vocab_size)}
+    losses = [float(engine.train_batch(batch)) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_tp_matches_single_device():
+    """Same seed: TP=4 run must match mesh=1 run numerically (fp32)."""
+    batch = {"tokens": _tokens(4, 16, TINY.vocab_size)}
+    cfg_base = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000,
+    }
+    from deepspeed_tpu.comm import mesh as mm
+    e1, *_ = deepspeed_tpu.initialize(model=make_gpt_model(cfg=TINY, name="t1"),
+                                      config={**cfg_base, "mesh": {"data": 1}})
+    l1 = [float(e1.train_batch(batch)) for _ in range(3)]
+    mm._CURRENT_MESH = None
+    mm._CURRENT_SPEC = None
+    e2, *_ = deepspeed_tpu.initialize(model=make_gpt_model(cfg=TINY, name="t4"),
+                                      config={**cfg_base, "mesh": {"data": 1, "tensor": 4}})
+    l2 = [float(e2.train_batch(batch)) for _ in range(3)]
+    np.testing.assert_allclose(l1, l2, rtol=2e-4)
+
+
+def test_decode_matches_forward():
+    """KV-cache decode logits must match full forward logits."""
+    spec = make_gpt_decode_model(cfg=TINY, name="tiny")
+    toks = jnp.asarray(_tokens(2, 12, TINY.vocab_size))
+    cache = spec.init_cache(2, 24, jnp.float32)
+    logits_prefill, cache = spec.prefill_fn(spec.params, toks, cache, None)
+    full = gpt_forward(spec.params, toks, TINY)
+    np.testing.assert_allclose(np.asarray(logits_prefill), np.asarray(full),
+                               rtol=1e-4, atol=1e-4)
+    # decode one more token and compare against forward on extended sequence
+    nxt = jnp.asarray(_tokens(2, 1, TINY.vocab_size, seed=7)[:, 0])
+    pos = jnp.full((2,), 12, jnp.int32)
+    dec_logits, cache = spec.decode_fn(spec.params, nxt, pos, cache)
+    ext = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    full_ext = gpt_forward(spec.params, ext, TINY)
+    np.testing.assert_allclose(np.asarray(dec_logits), np.asarray(full_ext[:, -1]),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_rotary_swiglu_rmsnorm_variant():
+    """LLaMA-style config must also train."""
+    cfg = GPTConfig(n_layer=2, n_head=4, d_model=64, max_seq_len=64, vocab_size=256,
+                    use_rotary=True, use_swiglu=True, use_rmsnorm=True,
+                    dtype=jnp.float32, remat=False)
+    model = make_gpt_model(cfg=cfg, name="llama-tiny")
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "mesh": {"data": 8},
+        "steps_per_print": 1000,
+    })
+    batch = {"tokens": _tokens(8, 32, cfg.vocab_size)}
+    losses = [float(engine.train_batch(batch)) for _ in range(8)]
+    assert losses[-1] < losses[0], losses

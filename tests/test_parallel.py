@@ -1,0 +1,180 @@
+"""Ulysses SP, MoE EP, and AutoTP planner tests (reference gap: Ulysses had no
+unit tests in the snapshot — SURVEY §4 says don't copy that omission)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.config.core import MeshConfig
+
+
+def _mk_mesh(**axes):
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    return mesh_mod.init_mesh(MeshConfig(data=axes.get("data", 1),
+                                         tensor=axes.get("tensor", 1),
+                                         sequence=axes.get("sequence", 1),
+                                         expert=axes.get("expert", 1),
+                                         pipe=axes.get("pipe", 1)))
+
+
+def _ref_attention(q, k, v):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+    T = q.shape[1]
+    mask = jnp.tril(jnp.ones((T, T), bool))[None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+class TestUlysses:
+    def test_constraint_form_matches_local(self):
+        mesh = _mk_mesh(data=2, sequence=4)
+        from deepspeed_tpu.parallel.ulysses import DistributedAttention
+        rng = np.random.default_rng(0)
+        q, k, v = (jnp.asarray(rng.normal(0, 1, (2, 16, 8, 4)), jnp.float32) for _ in range(3))
+        dist_attn = DistributedAttention(_ref_attention)
+        out = jax.jit(dist_attn)(q, k, v)
+        ref = _ref_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_shard_map_form_matches_local(self):
+        mesh = _mk_mesh(sequence=4)
+        from deepspeed_tpu.parallel.ulysses import ulysses_shard_map_attention
+
+        def plain_attn(q, k, v):  # non-causal for the shard_map form
+            scale = 1.0 / np.sqrt(q.shape[-1])
+            logits = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+            probs = jax.nn.softmax(logits, axis=-1)
+            return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+        rng = np.random.default_rng(1)
+        q, k, v = (jnp.asarray(rng.normal(0, 1, (2, 16, 8, 4)), jnp.float32) for _ in range(3))
+        fn = ulysses_shard_map_attention(plain_attn, mesh=mesh)
+        out = jax.jit(fn)(q, k, v)
+        ref = plain_attn(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+class TestMoE:
+    def test_top1_gating_shapes_and_capacity(self):
+        from deepspeed_tpu.parallel.moe import top1_gating
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(0, 1, (32, 4)), jnp.float32)
+        l_aux, dispatch, combine, counts = top1_gating(logits, capacity_factor=1.0, min_capacity=4)
+        N, E, C = dispatch.shape
+        assert (N, E) == (32, 4) and C == 8
+        # every slot holds at most one token
+        assert np.asarray(dispatch.sum(axis=0).max()) <= 1
+        # each token dispatched at most once
+        assert np.asarray(dispatch.sum(axis=(1, 2)).max()) <= 1
+        assert float(l_aux) > 0
+
+    def test_top2_gating(self):
+        from deepspeed_tpu.parallel.moe import top2_gating
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(0, 1, (32, 4)), jnp.float32)
+        l_aux, dispatch, combine, counts = top2_gating(logits)
+        assert np.asarray(dispatch.sum(axis=(1, 2)).max()) <= 2
+        # combine weights for a token sum to ~1 when both experts kept
+        s = np.asarray(combine.sum(axis=(1, 2)))
+        assert (s <= 1.0 + 1e-5).all()
+
+    def test_moe_layer_forward_backward(self):
+        mesh = _mk_mesh(data=2, expert=4)
+        from deepspeed_tpu.parallel.moe import MoELayer
+        layer = MoELayer(num_experts=4, k=1, capacity_factor=2.0)
+        params = layer.init_params(16, 32)
+        x = jnp.asarray(np.random.default_rng(0).normal(0, 1, (2, 8, 16)), jnp.float32)
+
+        def loss(p):
+            y, l_aux, _ = layer(p, x)
+            return jnp.mean(y**2) + 0.01 * l_aux
+
+        g = jax.jit(jax.grad(loss))(params)
+        assert np.isfinite(np.asarray(jax.flatten_util.ravel_pytree(g)[0])).all()
+
+    def test_moe_in_engine(self):
+        """MoE transformer-ish model trains under the engine with expert axis."""
+        mesh = _mk_mesh(data=2, expert=4)
+        from deepspeed_tpu.parallel.moe import MoELayer
+        from deepspeed_tpu.runtime.engine import ModelSpec
+        layer = MoELayer(num_experts=4, k=2, capacity_factor=2.0)
+        rng = np.random.default_rng(0)
+        params = {
+            "proj_in": jnp.asarray(rng.normal(0, 0.1, (8, 16)), jnp.float32),
+            "moe": layer.init_params(16, 32),
+            "proj_out": jnp.asarray(rng.normal(0, 0.1, (16, 8)), jnp.float32),
+        }
+        specs = {"proj_in": P(None, None), "moe": layer.param_specs(),
+                 "proj_out": P(None, None)}
+
+        def loss_fn(p, batch, rng=None):
+            h = batch["x"] @ p["proj_in"]
+            h = h[:, None, :]  # [B,1,D]
+            y, l_aux, _ = layer(p["moe"], h)
+            out = y[:, 0, :] @ p["proj_out"]
+            return jnp.mean((out - batch["y"])**2) + 0.01 * l_aux
+
+        model = ModelSpec(loss_fn=loss_fn, params=params, param_specs=specs)
+        engine, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "mesh": {"data": 2, "expert": 4},
+            "steps_per_print": 1000,
+        }, mesh=mesh)
+        batch = {"x": rng.normal(0, 1, (16, 8)).astype(np.float32),
+                 "y": rng.normal(0, 1, (16, 8)).astype(np.float32)}
+        losses = [float(engine.train_batch(batch)) for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+
+
+class TestAutoTP:
+    def test_plan_classifies(self):
+        from deepspeed_tpu.parallel.tp import plan_tp_specs
+        params = {
+            "attn": {"q_proj": jnp.zeros((8, 8)), "out_proj": jnp.zeros((8, 8))},
+            "mlp": {"up_proj": jnp.zeros((8, 32)), "down_proj": jnp.zeros((32, 8))},
+            "ln": {"scale": jnp.ones((8,))},
+            "embed_tokens": jnp.zeros((100, 8)),
+        }
+        specs = plan_tp_specs(params)
+        assert specs["attn"]["q_proj"] == P(None, "tensor")
+        assert specs["attn"]["out_proj"] == P("tensor", None)
+        assert specs["mlp"]["up_proj"] == P(None, "tensor")
+        assert specs["mlp"]["down_proj"] == P("tensor", None)
+        assert specs["ln"]["scale"] == P(None)
+        assert specs["embed_tokens"] == P("tensor", None)
+
+    def test_tp_sharded_mlp_matches_dense(self):
+        mesh = _mk_mesh(tensor=4)
+        from deepspeed_tpu.parallel.tp import plan_tp_specs
+        from jax.sharding import NamedSharding
+        rng = np.random.default_rng(0)
+        params = {"up_proj": jnp.asarray(rng.normal(0, 0.1, (16, 64)), jnp.float32),
+                  "down_proj": jnp.asarray(rng.normal(0, 0.1, (64, 16)), jnp.float32)}
+        specs = plan_tp_specs(params)
+        sharded = jax.device_put(params, jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), specs))
+        x = jnp.asarray(rng.normal(0, 1, (4, 16)), jnp.float32)
+
+        def f(p, x):
+            return jax.nn.gelu(x @ p["up_proj"]) @ p["down_proj"]
+
+        ref = f(params, x)
+        out = jax.jit(f)(sharded, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+    def test_tiled_linear(self):
+        from deepspeed_tpu.parallel.tp import tiled_linear
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(0, 1, (4, 16)), jnp.float32)
+        w = jnp.asarray(rng.normal(0, 1, (16, 32)), jnp.float32)
+        b = jnp.asarray(rng.normal(0, 1, (32,)), jnp.float32)
+        np.testing.assert_allclose(np.asarray(tiled_linear(x, w, b, splits=4)),
+                                   np.asarray(x @ w + b), rtol=1e-5, atol=1e-5)
