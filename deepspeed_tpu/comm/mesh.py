@@ -186,12 +186,28 @@ def replicated_sharding() -> NamedSharding:
     return NamedSharding(get_mesh(), P())
 
 
+_CONSTRAINTS_DISABLED = False
+
+
+class constraints_disabled:
+    """Context manager: make shard_constraint a no-op while tracing code that runs
+    inside a shard_map body (where outer-mesh constraints are not applicable)."""
+
+    def __enter__(self):
+        global _CONSTRAINTS_DISABLED
+        self._prev = _CONSTRAINTS_DISABLED
+        _CONSTRAINTS_DISABLED = True
+
+    def __exit__(self, *exc):
+        global _CONSTRAINTS_DISABLED
+        _CONSTRAINTS_DISABLED = self._prev
+
+
 def shard_constraint(x, *spec_entries):
     """`with_sharding_constraint` against the current global mesh; no-op when no
-    mesh is installed (lets model code run standalone). Axis entries naming axes
-    of size 1 are dropped automatically — XLA rejects size-1... no, size-1 axes are
-    fine; entries are kept as-is."""
-    if not has_mesh():
+    mesh is installed (lets model code run standalone) or inside
+    `constraints_disabled()` (shard_map bodies)."""
+    if not has_mesh() or _CONSTRAINTS_DISABLED:
         return x
     spec = P(*spec_entries)
     return jax.lax.with_sharding_constraint(x, NamedSharding(get_mesh(), spec))

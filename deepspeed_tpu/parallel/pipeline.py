@@ -1,0 +1,258 @@
+"""Pipeline parallelism — looped SPMD pipelining over the `pipe` mesh axis.
+
+Reference: `runtime/pipe/` (3.1k LoC) — `PipelineModule` (`pipe/module.py:130`,
+LayerSpec list partitioned by parameters/uniform), `PipelineEngine`
+(`pipe/engine.py:55`) interpreting instruction schedules (`pipe/schedule.py:189`
+TrainSchedule/1F1B) with explicit P2P (`pipe/p2p.py`).
+
+TPU-native formulation: ONE compiled SPMD program. Stage parameters are stacked
+[PP, layers_per_stage, ...] and sharded on `pipe`; the fill-drain (GPipe) schedule
+is a `lax.scan` of M + PP - 1 ticks inside `shard_map`; stage handoff is a
+`ppermute` shift — the instruction stream, P2P meta exchange and schedule
+interpreter of the reference collapse into this loop. Backward falls out of
+autodiff through the scan (activations rematerialized per-stage via
+`jax.checkpoint`), giving 1F1B-like memory behavior without hand-written
+instruction scheduling.
+
+Embedding lives on stage 0, LM head + loss on the last stage; both are computed
+masked on every rank (SPMD) with their parameters replicated over `pipe` — the
+bubble overhead is the standard (PP-1)/M fill-drain cost.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.comm.mesh import DATA_AXIS, PIPE_AXIS, SEQ_AXIS
+from deepspeed_tpu.utils.logging import logger
+
+
+# ----------------------------------------------------------------------
+# LayerSpec-style container (API parity with deepspeed.pipe)
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LayerSpec:
+    """Deferred layer (reference `deepspeed/pipe` LayerSpec): builds params lazily
+    so each stage only materializes its own layers."""
+    init_fn: Callable[..., Any]       # () -> params
+    apply_fn: Callable[..., Any]      # (params, x) -> x
+    name: str = "layer"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Weight tying across stages (reference TiedLayerSpec) — realized here by
+    replicating the tied params over `pipe` and psum-ing their grads, which is
+    what the reference's tied-weight allreduce does (`pipe/engine.py:266`)."""
+
+    def __init__(self, key, init_fn, apply_fn, name="tied"):
+        super().__init__(init_fn, apply_fn, name)
+        self.key = key
+
+
+def partition_layers(n_layers, n_stages, method="uniform", costs=None):
+    """Layer → stage assignment (reference `PipelineModule` partition methods
+    `module.py:370-386`): 'uniform' (equal counts) or 'parameters' (balance by
+    per-layer cost)."""
+    if method.startswith("type:"):
+        raise NotImplementedError("type: regex partitioning needs named layers")
+    if method == "parameters" and costs is not None:
+        costs = np.asarray(costs, dtype=np.float64)
+        target = costs.sum() / n_stages
+        bounds = [0]
+        acc = 0.0
+        for i, c in enumerate(costs):
+            acc += c
+            if acc >= target * len(bounds) and len(bounds) < n_stages:
+                bounds.append(i + 1)
+        while len(bounds) < n_stages:
+            bounds.append(n_layers)
+        bounds.append(n_layers)
+        return [(bounds[i], bounds[i + 1]) for i in range(n_stages)]
+    per = n_layers // n_stages
+    rem = n_layers % n_stages
+    out, start = [], 0
+    for s in range(n_stages):
+        n = per + (1 if s < rem else 0)
+        out.append((start, start + n))
+        start += n
+    return out
+
+
+# ----------------------------------------------------------------------
+# the looped pipeline program
+# ----------------------------------------------------------------------
+
+
+def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
+                     num_microbatches, remat_blocks=True):
+    """Builds loss_fn(params, batch, rng) running the pipelined schedule.
+
+    params = {"embed": <replicated>, "blocks": <stacked [PP*Lp, ...] leaves,
+    sharded on pipe via leading dim>, "head": <replicated>}
+
+    * embed_fn(embed_params, micro_batch, rng) -> activation [mb, ...]
+    * block_fn(layer_params, activation, rng) -> activation  (applied per layer)
+    * head_loss_fn(full_params, activation, micro_batch, rng) -> scalar loss
+      (gets the FULL params dict so tied embeddings read the single "embed" leaf —
+      reference TiedLayerSpec semantics with one parameter instead of a
+      replicate+allreduce pair)
+    batch: pytree with leading dim M*mb (microbatch-major).
+    """
+    PP = num_stages
+    M = num_microbatches
+    if remat_blocks:
+        block_fn = jax.checkpoint(block_fn)
+
+    def local(params, batch, rng):
+        # inside shard_map over ('pipe',): blocks leaf leading dim = layers/stage
+        p_idx = jax.lax.axis_index(PIPE_AXIS)
+        blocks = params["blocks"]
+
+        def stage_apply(x, rng):
+            def layer_body(h, lp):
+                return block_fn(lp, h, rng), None
+            out, _ = jax.lax.scan(layer_body, x, blocks)
+            return out
+
+        # micro-batch views
+        def mb_view(i):
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, i * (a.shape[0] // M),
+                                                       a.shape[0] // M, axis=0),
+                batch)
+
+        mb0 = mb_view(0)
+        act0 = embed_fn(params["embed"], mb0, rng)
+        zeros_act = jnp.zeros_like(act0)
+
+        n_ticks = M + PP - 1
+        perm_fwd = [(j, j + 1) for j in range(PP - 1)]
+
+        def tick(carry, t):
+            buf, loss_sum, n_done = carry
+            mb_idx = t - p_idx
+            active = (mb_idx >= 0) & (mb_idx < M)
+            # stage 0 reads its microbatch; others read the handed-off activation.
+            # (masked select, not cond: divergent-per-rank cond around code the
+            # partitioner may weave collectives into deadlocks the SPMD program)
+            mb_i = jnp.clip(t, 0, M - 1)
+            embedded = embed_fn(params["embed"], mb_view(mb_i), rng)
+            x_in = jnp.where(p_idx == 0, embedded, buf)
+            y = stage_apply(x_in, rng)
+            y = jnp.where(active, y, zeros_act)
+            # last stage: loss of its active microbatch
+            out_idx = jnp.clip(t - (PP - 1), 0, M - 1)
+            take = active & (p_idx == PP - 1)
+            mb_loss = head_loss_fn(params, y, mb_view(out_idx), rng)
+            loss_sum = loss_sum + jnp.where(take, mb_loss.astype(jnp.float32), 0.0)
+            n_done = n_done + jnp.where(take, 1, 0)
+            buf = jax.lax.ppermute(y, PIPE_AXIS, perm_fwd)
+            return (buf, loss_sum, n_done), None
+
+        (buf, loss_sum, n_done), _ = jax.lax.scan(
+            tick, (zeros_act, jnp.asarray(0.0, jnp.float32), jnp.asarray(0, jnp.int32)),
+            jnp.arange(n_ticks))
+        # broadcast the mean loss to every pipe rank (reference _aggregate_total_loss)
+        total = jax.lax.psum(loss_sum, PIPE_AXIS)
+        count = jax.lax.psum(n_done, PIPE_AXIS)
+        loss = total / jnp.maximum(count, 1)
+        # mean over the data domain so grads of pipe-replicated leaves come out as
+        # global-batch means
+        return jax.lax.pmean(loss, (DATA_AXIS, SEQ_AXIS))
+
+    def loss_fn(params, batch, rng):
+        mesh = mesh_mod.get_mesh()
+        param_specs = {
+            "embed": jax.tree_util.tree_map(lambda _: P(), params["embed"]),
+            "blocks": jax.tree_util.tree_map(
+                lambda l: P(*([PIPE_AXIS] + [None] * (l.ndim - 1))), params["blocks"]),
+            "head": jax.tree_util.tree_map(lambda _: P(), params["head"]),
+        }
+        # batch stays data-sharded on its leading dim (composes PP × DP)
+        batch_spec = jax.tree_util.tree_map(lambda _: P(DATA_AXIS), batch)
+        with mesh_mod.constraints_disabled():
+            fn = shard_map(local, mesh=mesh,
+                           in_specs=(param_specs, batch_spec, P()),
+                           out_specs=P(), check_vma=False)
+            return fn(params, batch, rng)
+
+    return loss_fn
+
+
+def pipeline_param_specs(params):
+    """PartitionSpecs matching pipeline_loss_fn's layout."""
+    return {
+        "embed": jax.tree_util.tree_map(lambda l: P(*([None] * l.ndim)), params["embed"]),
+        "blocks": jax.tree_util.tree_map(
+            lambda l: P(*([PIPE_AXIS] + [None] * (l.ndim - 1))), params["blocks"]),
+        "head": jax.tree_util.tree_map(lambda l: P(*([None] * l.ndim)), params["head"]),
+    }
+
+
+# ----------------------------------------------------------------------
+# pipelined GPT (zoo integration)
+# ----------------------------------------------------------------------
+
+
+def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
+                            num_microbatches=4, seed=0):
+    """Pipeline-parallel GPT ModelSpec: blocks stacked [PP*Lp, ...] on `pipe`."""
+    from deepspeed_tpu.models.gpt import (GPTConfig, GPT2_CONFIGS, init_gpt_params,
+                                          _block, _norm)
+    from deepspeed_tpu.runtime.engine import ModelSpec
+
+    cfg = cfg or GPT2_CONFIGS.get(name) or GPTConfig()
+    assert cfg.n_layer % num_stages == 0, \
+        f"n_layer {cfg.n_layer} must divide evenly into {num_stages} stages"
+    raw = init_gpt_params(cfg, seed=seed)
+
+    params = {
+        "embed": {"wte": raw["wte"], **({"wpe": raw["wpe"]} if not cfg.use_rotary else {})},
+        "blocks": raw["blocks"],
+        "head": {"lnf_scale": raw["lnf_scale"],
+                 **({"lnf_bias": raw["lnf_bias"]} if not cfg.use_rmsnorm else {})},
+    }
+    if not cfg.tie_embeddings:
+        params["head"]["lm_head"] = raw["lm_head"]
+
+    def embed_fn(ep, micro_batch, rng):
+        tokens = micro_batch["tokens"][:, :-1]
+        B, T = tokens.shape
+        x = jnp.take(ep["wte"], tokens, axis=0).astype(cfg.dtype)
+        if not cfg.use_rotary:
+            pos = jnp.arange(T, dtype=jnp.int32)[None]
+            x = x + jnp.take(ep["wpe"], pos, axis=0).astype(cfg.dtype)
+        return x
+
+    def block_fn(lp, x, rng):
+        B, T, D = x.shape
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+        return _block(x, lp, cfg=cfg, positions=positions)
+
+    def head_loss_fn(full_params, x, micro_batch, rng):
+        hp = full_params["head"]
+        head_w = hp.get("lm_head", full_params["embed"]["wte"])  # tied by default
+        labels = micro_batch["tokens"][:, 1:]
+        x = _norm(x, hp["lnf_scale"], hp.get("lnf_bias"), cfg.use_rmsnorm)
+        logits = jnp.einsum("btd,vd->btv", x, head_w.astype(x.dtype)).astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        safe = jnp.maximum(labels, 0)
+        gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        mask = (labels >= 0).astype(jnp.float32)
+        return jnp.sum((logz - gold) * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    loss_fn = pipeline_loss_fn(embed_fn, block_fn, head_loss_fn,
+                               num_stages=num_stages,
+                               num_microbatches=num_microbatches,
+                               remat_blocks=cfg.remat)
+    return ModelSpec(loss_fn=loss_fn, params=params,
+                     param_specs=pipeline_param_specs(params), name=name)

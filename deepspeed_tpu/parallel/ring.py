@@ -1,0 +1,98 @@
+"""Ring attention — context parallelism for long sequences.
+
+The reference snapshot has NO context parallelism (SURVEY §2.3: "Ring attention /
+context parallel — absent"); its long-sequence story is Ulysses + block-sparse
+attention. On TPU, ring attention is the idiomatic long-context mechanism: each
+sequence rank holds a KV shard, KV blocks rotate around the `sequence` ICI ring via
+`ppermute` while every rank accumulates online-softmax partials of its Q shard —
+compute and transfer overlap, memory stays O(T/sp).
+
+Built from differentiable pieces (block attention + lax.scan + ppermute), so the
+backward pass falls out of autodiff with rematerialization; the per-block inner
+attention can be swapped for the Pallas flash kernel once its lse output is
+threaded through (ops/pallas/flash_attention.py).
+"""
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.comm.mesh import DATA_AXIS, SEQ_AXIS, TENSOR_AXIS
+
+NEG_INF = -1e30
+
+
+def _block_attn_partial(q, k, v, q_offset, k_offset, causal, sm_scale):
+    """Unnormalized block attention with running-max bookkeeping.
+    q: [B, Tq, H, hd]; k,v: [B, Tk, H, hd] → (scores_max [B,H,Tq],
+    exp-sum [B,H,Tq], weighted values [B,Tq,H,hd])."""
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(Tq)[:, None]
+        k_pos = k_offset + jnp.arange(Tk)[None, :]
+        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # [B,H,Tq]
+    p = jnp.exp(s - m[..., None])
+    # fully-masked rows: m = -inf → p = exp(-inf - -inf) = nan; guard
+    p = jnp.where(jnp.isfinite(m)[..., None], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32))
+    return m, l, o
+
+
+def _ring_attention_local(q, k, v, axis_name, sp, causal, sm_scale):
+    """Runs inside shard_map. q,k,v local: [B, Tl, H, hd]."""
+    B, Tl, H, hd = q.shape
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def step(carry, i):
+        acc, m_run, l_run, kv = carry
+        k_blk, v_blk = kv
+        src = (my_idx - i) % sp       # owner of the block we currently hold
+        m_blk, l_blk, o_blk = _block_attn_partial(
+            q, k_blk, v_blk, my_idx * Tl, src * Tl, causal, sm_scale)
+        m_new = jnp.maximum(m_run, m_blk)
+        # guard: rows where both are -inf stay -inf
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        alpha = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - safe_m), 0.0)
+        beta = jnp.where(jnp.isfinite(m_blk), jnp.exp(m_blk - safe_m), 0.0)
+        l_new = l_run * alpha + l_blk * beta
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + \
+            o_blk * beta.transpose(0, 2, 1)[..., None]
+        kv = jax.tree_util.tree_map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm), kv)
+        return (acc, m_new, l_new, kv), None
+
+    acc0 = jnp.zeros((B, Tl, H, hd), jnp.float32)
+    m0 = jnp.full((B, H, Tl), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl), jnp.float32)
+    (acc, m, l, _), _ = jax.lax.scan(step, (acc0, m0, l0, (k, v)), jnp.arange(sp))
+    l_safe = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (acc / l_safe).astype(q.dtype)
+
+
+def ring_attention(q, k, v, causal=True, sm_scale=None, axis_name=SEQ_AXIS, mesh=None):
+    """Global-array entry: q,k,v [B, T, H, hd] sharded (data, sequence, tensor).
+    Returns attention output with the same layout/sharding."""
+    mesh = mesh or mesh_mod.get_mesh()
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    sp = sizes.get(axis_name, 1)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if sp == 1:
+        m, l, o = _block_attn_partial(q, k, v, 0, 0, causal, sm_scale)
+        return (o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+    spec = P(DATA_AXIS, axis_name, TENSOR_AXIS, None)
+    fn = shard_map(
+        partial(_ring_attention_local, axis_name=axis_name, sp=sp, causal=causal,
+                sm_scale=sm_scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    return fn(q, k, v)

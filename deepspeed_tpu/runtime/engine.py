@@ -209,22 +209,22 @@ class Engine:
         params_c = tree_cast(params, self.compute_dtype)
         params_c = jax.device_put(params_c, self.param_shardings)
 
-        # fp32 master (ZeRO-partitioned — reference stage_1_and_2.py:630)
+        # fp32 master (ZeRO-partitioned — reference stage_1_and_2.py:630).
+        # base_specs carry the model's TP/PP axes so master/opt shards inherit them.
         if self.keep_master:
             master_shapes = jax.eval_shape(lambda p: tree_cast(p, jnp.float32), params_c)
-            self.master_shardings = policy.state_shardings(master_shapes)
+            self.master_shardings = policy.state_shardings(master_shapes,
+                                                           base_specs=param_specs)
             master = jax.jit(lambda p: tree_cast(p, jnp.float32),
                              out_shardings=self.master_shardings)(params_c)
         else:
             master = None
             self.master_shardings = policy.state_shardings(
-                jax.eval_shape(lambda p: p, params_c))
-            # fp32 params themselves take the master sharding for stages 1/2? No:
-            # params keep param_shardings; opt state gets state shardings below.
+                jax.eval_shape(lambda p: p, params_c), base_specs=param_specs)
 
         opt_target = master if master is not None else params_c
         opt_shapes = jax.eval_shape(self.optimizer.init, opt_target)
-        self.opt_shardings = policy.state_shardings(opt_shapes)
+        self.opt_shardings = policy.state_shardings(opt_shapes, base_specs=param_specs)
         opt_state = jax.jit(self.optimizer.init, out_shardings=self.opt_shardings)(opt_target)
         if self.offload_optimizer_states:
             opt_state = self._to_host(opt_state)

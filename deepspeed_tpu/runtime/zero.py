@@ -171,13 +171,19 @@ def _tree_map_with_path(fn, tree):
     return jax.tree_util.tree_map_with_path(lambda path, leaf: fn(path, leaf), tree)
 
 
-def _get_path(tree, path):
-    """Fetch same-path leaf from a parallel tree (returns None when absent)."""
+def _get_path(tree, path, _suffix_retry=True):
+    """Fetch same-path leaf from a parallel tree (returns None when absent).
+
+    Falls back to suffix matching: optimizer state wraps the param tree in extra
+    levels (e.g. (0, 'mu', <param path...>)), so we retry after dropping leading
+    path components until the param-spec tree resolves.
+    """
     if tree is None:
         return None
-    node = tree
-    try:
-        for key in path:
+
+    def resolve(p):
+        node = tree
+        for key in p:
             if hasattr(key, "key"):
                 node = node[key.key]
             elif hasattr(key, "idx"):
@@ -187,5 +193,16 @@ def _get_path(tree, path):
             else:
                 return None
         return node
-    except (KeyError, IndexError, TypeError, AttributeError):
-        return None
+
+    for start in range(len(path) + 1 if _suffix_retry else 1):
+        try:
+            node = resolve(path[start:])
+        except (KeyError, IndexError, TypeError, AttributeError):
+            continue
+        # only accept leaves (PartitionSpec), not subtrees
+        from jax.sharding import PartitionSpec
+        if isinstance(node, PartitionSpec):
+            return node
+        if start == 0 and node is None:
+            return None
+    return None
