@@ -1,0 +1,128 @@
+"""Universal checkpoints — topology-independent fp32 state.
+
+Reference: `deepspeed/checkpoint/ds_to_universal.py:254` (offline converter:
+ZeRO shards → per-param fp32 slices reshardable to new TP/PP/DP) +
+`universal_checkpoint.py:12` (loader) + `utils/zero_to_fp32.py` (offline fp32
+reconstruction shipped into every checkpoint dir).
+
+On TPU, *mesh-shape* resharding is free (orbax restores to any mesh), so the
+universal format's remaining jobs are: (1) parallelism-*form* conversion —
+pipeline-stacked vs plain layer layouts, TP-fused vs split qkv; (2) a plain
+interoperable artifact (flat name → fp32 array .npz + metadata) that any
+engine, any topology, or external tooling can consume.
+"""
+
+import json
+import pathlib
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger, log_dist
+
+UNIVERSAL_FILE = "universal_fp32.npz"
+META_FILE = "universal_meta.json"
+
+
+def _flatten(tree, prefix=()):
+    import jax
+    out = {}
+
+    def rec(node, path):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                rec(v, path + (str(k),))
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                rec(v, path + (str(i),))
+        elif node is None:
+            pass
+        else:
+            out["/".join(path)] = node
+
+    rec(tree, prefix)
+    return out
+
+
+def _unflatten_into(template, flat):
+    """Place flat name→array entries into a params-like template pytree."""
+    def rec(node, path):
+        if isinstance(node, dict):
+            return {k: rec(v, path + (str(k),)) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(rec(v, path + (str(i),)) for i, v in enumerate(node))
+        key = "/".join(path)
+        if key not in flat:
+            raise KeyError(f"universal checkpoint missing param '{key}'")
+        return flat[key]
+
+    return rec(template, ())
+
+
+def save_universal_checkpoint(engine, save_dir, tag="universal"):
+    """Gather full fp32 weights from the engine (whatever its ZeRO/TP/PP layout)
+    and write the flat npz artifact."""
+    out_dir = pathlib.Path(save_dir) / tag
+    out_dir.mkdir(parents=True, exist_ok=True)
+    fp32 = engine.get_fp32_state_dict()
+    flat = {k: np.asarray(v, np.float32) for k, v in _flatten(fp32).items()}
+    np.savez(out_dir / UNIVERSAL_FILE, **flat)
+    meta = {
+        "format_version": 1,
+        "param_shapes": {k: list(v.shape) for k, v in flat.items()},
+        "global_steps": engine.global_steps,
+        "zero_stage": engine.zero_stage,
+        "mesh": str(engine.spec),
+    }
+    with open(out_dir / META_FILE, "w") as f:
+        json.dump(meta, f, indent=2)
+    log_dist(f"universal checkpoint -> {out_dir} ({len(flat)} tensors)", ranks=[0])
+    return str(out_dir)
+
+
+def load_universal_checkpoint(engine, load_dir, tag="universal", strict=True):
+    """Load a universal artifact into an engine of ANY topology: arrays are cast
+    to the compute dtype and placed with the engine's own shardings; fp32 master
+    rebuilt; optimizer state reset (reference loads fresh states too unless the
+    optimizer slices were converted)."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.utils.tree import tree_cast
+
+    in_dir = pathlib.Path(load_dir) / tag
+    with np.load(in_dir / UNIVERSAL_FILE) as data:
+        flat = {k: data[k] for k in data.files}
+    params_np = _unflatten_into(engine.state.params, flat)
+    # place with engine shardings in compute dtype
+    params = jax.tree_util.tree_map(
+        lambda leaf, arr: jax.device_put(jnp.asarray(arr, leaf.dtype), leaf.sharding),
+        engine.state.params, params_np)
+    state = engine.state._replace(params=params)
+    if engine.keep_master:
+        master = jax.tree_util.tree_map(
+            lambda leaf, arr: jax.device_put(jnp.asarray(arr, jnp.float32), leaf.sharding),
+            engine.state.master, params_np)
+        state = state._replace(master=master)
+    engine.state = state
+    meta = {}
+    meta_file = in_dir / META_FILE
+    if meta_file.exists():
+        with open(meta_file) as f:
+            meta = json.load(f)
+    log_dist(f"loaded universal checkpoint from {in_dir}", ranks=[0])
+    return meta
+
+
+def convert_to_universal(ckpt_dir, out_dir, engine):
+    """Offline `ds_to_universal` analog: load a tagged checkpoint into `engine`,
+    then emit the universal artifact."""
+    from deepspeed_tpu.checkpoint.saver import load_checkpoint
+    path, _ = load_checkpoint(engine, ckpt_dir)
+    assert path is not None, f"no checkpoint found in {ckpt_dir}"
+    return save_universal_checkpoint(engine, out_dir)
+
+
+def get_fp32_state_dict_from_universal(load_dir, tag="universal"):
+    """zero_to_fp32-style accessor: plain dict of fp32 numpy arrays."""
+    in_dir = pathlib.Path(load_dir) / tag
+    with np.load(in_dir / UNIVERSAL_FILE) as data:
+        return {k: data[k] for k in data.files}

@@ -1,0 +1,5 @@
+from deepspeed_tpu.elasticity.elasticity import (
+    compute_elastic_config,
+    get_compatible_gpus,
+    ElasticityError,
+)

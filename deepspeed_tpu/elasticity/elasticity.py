@@ -1,0 +1,110 @@
+"""Elastic training — admissible world-size math + restart-based recovery.
+
+Reference: `elasticity/elasticity.py:233` (`compute_elastic_config`: which chip
+counts keep the global batch compatible with micro-batch × GAS divisibility) and
+`elasticity/elastic_agent.py:28` (torch-elastic agent).
+
+The batch-compatibility math is framework-agnostic and ported semantically.
+The recovery mechanism on TPU is restart-based: pod-slice membership changes
+restart the job, `init_distributed` re-forms the mesh, and resume comes from the
+(reshardable) checkpoint — orbax restores to whatever new mesh exists, which is
+what the reference needs the universal checkpoint for.
+"""
+
+from deepspeed_tpu.utils.logging import logger
+
+LATEST_ELASTICITY_VERSION = 0.2
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+def get_valid_gpus(batch_size, micro_batches, min_valid_gpus, max_valid_gpus):
+    """Chip counts g such that batch_size % (mb * g) == 0 for some micro-batch."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb != 0:
+            continue
+        max_gpus = batch_size // mb
+        for g in range(1, max_gpus + 1):
+            if batch_size % (mb * g) == 0 and min_valid_gpus <= g <= max_valid_gpus:
+                valid.add(g)
+    return sorted(valid)
+
+
+def get_best_candidates(max_acceptable_batch_size, micro_batches, min_gpus, max_gpus,
+                        prefer_larger):
+    """Search batch sizes downward for the one admitting the most chip counts
+    (reference `_get_compatible_gpus_v01`)."""
+    base = min(micro_batches)
+    best = (0, None, [])  # (n_valid, batch, gpus)
+    for batch_size in range(max_acceptable_batch_size, base - 1, -1):
+        if batch_size % base != 0:
+            continue
+        valid = get_valid_gpus(batch_size, micro_batches, min_gpus, max_gpus)
+        if len(valid) > best[0] or (len(valid) == best[0] and prefer_larger
+                                    and best[1] is not None and batch_size > best[1]):
+            best = (len(valid), batch_size, valid)
+        if best[0] and batch_size < max_acceptable_batch_size // 2:
+            break
+    return best[1], best[2]
+
+
+def get_compatible_gpus(max_acceptable_batch_size, micro_batches, min_gpus=1,
+                        max_gpus=10000, prefer_larger=True):
+    final_batch, valid_gpus = get_best_candidates(
+        max_acceptable_batch_size, micro_batches, min_gpus, max_gpus, prefer_larger)
+    if final_batch is None:
+        raise ElasticityError(
+            f"no batch size <= {max_acceptable_batch_size} works with micro-batches "
+            f"{micro_batches}")
+    return final_batch, valid_gpus
+
+
+def compute_elastic_config(ds_config, target_deepspeed_version=None, world_size=0,
+                           return_microbatch=False):
+    """Reference signature (`elasticity.py:233`): returns (final_batch_size,
+    valid_gpus[, micro_batch]) and validates the actual world size."""
+    if hasattr(ds_config, "elasticity"):
+        e = ds_config.elasticity
+        max_batch = e.max_train_batch_size
+        micro_batches = list(e.micro_batch_sizes)
+        min_gpus, max_gpus = e.min_gpus, e.max_gpus
+        prefer_larger = e.prefer_larger_batch
+        enabled = e.enabled
+    else:
+        e = ds_config.get("elasticity", {})
+        max_batch = e.get("max_train_batch_size", 2000)
+        micro_batches = e.get("micro_batch_sizes", [2, 4, 6])
+        min_gpus, max_gpus = e.get("min_gpus", 1), e.get("max_gpus", 10000)
+        prefer_larger = e.get("prefer_larger_batch", True)
+        enabled = e.get("enabled", False)
+    if not enabled:
+        raise ElasticityConfigError("elasticity not enabled in config")
+
+    final_batch_size, valid_gpus = get_compatible_gpus(
+        max_batch, micro_batches, min_gpus, max_gpus, prefer_larger)
+
+    if world_size > 0 and world_size not in valid_gpus:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {world_size} not in valid set {valid_gpus}")
+
+    if return_microbatch:
+        if world_size > 0:
+            candidates = sorted((mb for mb in micro_batches
+                                 if final_batch_size % (mb * world_size) == 0),
+                                reverse=prefer_larger)
+            if not candidates:
+                raise ElasticityError("no compatible micro batch for world size")
+            return final_batch_size, valid_gpus, candidates[0]
+        return final_batch_size, valid_gpus, micro_batches[0]
+    return final_batch_size, valid_gpus

@@ -1,0 +1,39 @@
+"""Environment report — `ds_report` analog (reference `deepspeed/env_report.py`)."""
+
+import importlib
+import sys
+
+
+def main(args=None):
+    import deepspeed_tpu
+    print("-" * 70)
+    print("DeepSpeed-TPU environment report")
+    print("-" * 70)
+    print(f"deepspeed_tpu version ... {deepspeed_tpu.__version__}")
+    print(f"python version .......... {sys.version.split()[0]}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint", "numpy"):
+        try:
+            m = importlib.import_module(mod)
+            ver = getattr(m, "__version__", "?")
+            print(f"{mod:<22}... {ver}")
+        except Exception:
+            print(f"{mod:<22}... not installed")
+    try:
+        import jax
+        print(f"default backend ......... {jax.default_backend()}")
+        devs = jax.devices()
+        print(f"devices ................. {len(devs)} x {getattr(devs[0], 'device_kind', '?')}")
+        from deepspeed_tpu.platform import get_accelerator
+        acc = get_accelerator()
+        stats = acc.memory_stats()
+        if stats.get("bytes_limit"):
+            print(f"HBM per device .......... {stats['bytes_limit']/2**30:.1f} GiB")
+        print(f"comm backend ............ {acc.communication_backend_name()}")
+    except Exception as e:
+        print(f"jax devices ............. unavailable ({e})")
+    print("-" * 70)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,157 @@
+"""Launcher CLI — `deepspeed-tpu` entry point.
+
+Reference: `bin/deepspeed` → `launcher/runner.py:389` (hostfile parsing,
+include/exclude filters, world-info b64, multinode runners) +
+`launcher/launch.py:132` (per-rank fork with RANK/WORLD_SIZE env).
+
+TPU launch model differs fundamentally: ONE process per host drives all local
+chips (no per-device fork), and multi-host rendezvous is
+`jax.distributed.initialize` against a coordinator. So the launcher:
+
+  * single host: exec the script directly (sets JAX env);
+  * multi host: ssh fanout (PDSH-style) running one process per host with
+    RANK/WORLD_SIZE/MASTER_ADDR exported — the same env contract the reference's
+    node launcher uses, consumed by our comm.init_distributed;
+  * GKE/pod-slice: honored via env passthrough (TPU runtime sets topology).
+
+Hostfile format is the reference's: `hostname slots=N` per line.
+"""
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ("JAX_", "XLA_", "TPU_", "LIBTPU_", "PYTHON", "PATH", "LD_LIBRARY_PATH",
+               "DSTPU_")
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed-tpu launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="Hostfile: lines of `hostname slots=N`")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="Host filter, e.g. 'host1,host2' or 'host1@host2'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="Hosts to exclude")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--launcher", type=str, default="ssh",
+                        choices=["ssh", "pdsh", "local"])
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--autotuning", type=str, default="",
+                        choices=["", "tune", "run"])
+    parser.add_argument("user_script", type=str, help="training script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def fetch_hostfile(path):
+    """Reference `fetch_hostfile` (`runner.py:201`)."""
+    if not os.path.isfile(path):
+        return {}
+    resources = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=")[1])
+            resources[host] = slots
+    return resources
+
+
+def filter_resources(resources, include, exclude):
+    hosts = dict(resources)
+    if include:
+        keep = set(h.split(":")[0] for h in include.replace("@", ",").split(",") if h)
+        hosts = {h: s for h, s in hosts.items() if h in keep}
+    if exclude:
+        drop = set(h.split(":")[0] for h in exclude.replace("@", ",").split(",") if h)
+        hosts = {h: s for h, s in hosts.items() if h not in drop}
+    return hosts
+
+
+def encode_world_info(resources):
+    data = json.dumps(resources).encode()
+    return base64.urlsafe_b64encode(data).decode()
+
+
+def _build_env_exports():
+    exports = []
+    for key, val in os.environ.items():
+        if any(key.startswith(p) for p in EXPORT_ENVS):
+            exports.append(f"export {key}={shlex.quote(val)}")
+    return "; ".join(exports)
+
+
+def main(args=None):
+    args = parse_args(args)
+    resources = fetch_hostfile(args.hostfile)
+    resources = filter_resources(resources, args.include, args.exclude)
+    if args.num_nodes > 0:
+        resources = dict(list(resources.items())[:args.num_nodes])
+
+    cmd_tail = [args.user_script] + args.user_args
+
+    if not resources or (len(resources) == 1 and not args.force_multi) \
+            or args.launcher == "local":
+        # single host: exec in-place (one process drives all chips)
+        env = dict(os.environ)
+        env.setdefault("WORLD_SIZE", "1")
+        env.setdefault("RANK", "0")
+        logger.info(f"launching single-host: {' '.join(cmd_tail)}")
+        proc = subprocess.Popen([sys.executable] + cmd_tail, env=env)
+        _forward_signals(proc)
+        return proc.wait()
+
+    # multi-host ssh fanout: rank i on host i
+    hosts = list(resources.keys())
+    master = args.master_addr or hosts[0]
+    world = len(hosts)
+    procs = []
+    exports = _build_env_exports()
+    for rank, host in enumerate(hosts):
+        remote_env = (f"{exports}; export RANK={rank} WORLD_SIZE={world} "
+                      f"MASTER_ADDR={master} MASTER_PORT={args.master_port}")
+        remote_cmd = f"{remote_env}; cd {shlex.quote(os.getcwd())}; " \
+                     f"{sys.executable} {' '.join(shlex.quote(c) for c in cmd_tail)}"
+        full = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote_cmd]
+        logger.info(f"rank {rank} -> {host}")
+        procs.append(subprocess.Popen(full))
+    rc = 0
+    try:
+        for p in procs:
+            rc |= p.wait()
+    except KeyboardInterrupt:
+        for p in procs:
+            p.terminate()
+        rc = 1
+    return rc
+
+
+def _forward_signals(proc):
+    def handler(signum, frame):
+        proc.send_signal(signum)
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, handler)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

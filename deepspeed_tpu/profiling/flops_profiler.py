@@ -1,0 +1,162 @@
+"""Flops profiler.
+
+Reference: `profiling/flops_profiler/profiler.py:28` — module hooks + patched
+torch.nn.functional counting MACs/latency per module, tree report, auto-invoked
+from the engine at `flops_profiler_profile_step`.
+
+TPU-native: XLA already knows the exact flop count of the compiled program —
+`jitted.lower(...).compile().cost_analysis()` exposes `flops`,
+`bytes accessed`, and `optimal_seconds`. The profiler wraps any jitted callable
+(or the engine's train step), reports program-level numbers, and derives
+utilization against the chip's peak. Per-module breakdown comes from
+`jax.named_scope` annotations surfaced in the xprof trace rather than hooks.
+"""
+
+import time
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _peak_flops():
+    import os
+    table = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    for k, v in table.items():
+        if k in gen:
+            return v
+    try:
+        import jax
+        kind = getattr(jax.devices()[0], "device_kind", "").lower()
+        for k, v in table.items():
+            if k in kind:
+                return v
+    except Exception:
+        pass
+    return 197e12
+
+
+def cost_analysis(fn, *args, **kwargs):
+    """Compile `fn` for the given args and return XLA's cost analysis dict."""
+    import jax
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    compiled = jitted.lower(*args, **kwargs).compile()
+    try:
+        analyses = compiled.cost_analysis()
+        analysis = analyses[0] if isinstance(analyses, (list, tuple)) else analyses
+    except Exception as e:
+        logger.warning(f"cost_analysis unavailable: {e}")
+        analysis = {}
+    return dict(analysis or {})
+
+
+class FlopsProfiler:
+    """Program-level flops/latency profiler (reference class name/API subset:
+    start_profile / stop_profile / get_total_flops / print_model_profile)."""
+
+    def __init__(self, model=None, ds_engine=None):
+        self.engine = ds_engine
+        self.analysis = {}
+        self.measured_seconds = None
+        self.started = False
+
+    def start_profile(self, ignore_list=None):
+        self.started = True
+        self._t0 = time.perf_counter()
+
+    def stop_profile(self):
+        if self.started:
+            self.measured_seconds = time.perf_counter() - self._t0
+            self.started = False
+
+    def profile_fn(self, fn, *args, n_timing_runs=3, **kwargs):
+        """Cost-analyze + wall-clock a jitted callable."""
+        import jax
+        self.analysis = cost_analysis(fn, *args, **kwargs)
+        jitted = fn if callable(getattr(fn, "lower", None)) else jax.jit(fn)
+        out = jitted(*args, **kwargs)          # compile+warm
+        jax.tree_util.tree_map(lambda x: None, out)
+        t0 = time.perf_counter()
+        for _ in range(n_timing_runs):
+            out = jitted(*args, **kwargs)
+        flat = jax.tree_util.tree_leaves(out)
+        if flat:
+            np.asarray(jax.device_get(flat[0])).sum()  # completion fence
+        self.measured_seconds = (time.perf_counter() - t0) / n_timing_runs
+        return out
+
+    def get_total_flops(self, as_string=False):
+        f = self.analysis.get("flops", 0.0)
+        return _num_to_string(f) + "FLOPS" if as_string else f
+
+    def get_total_macs(self, as_string=False):
+        f = self.get_total_flops() / 2
+        return _num_to_string(f) + "MACs" if as_string else f
+
+    def get_total_duration(self, as_string=False):
+        d = self.measured_seconds or self.analysis.get("optimal_seconds", 0.0)
+        return f"{d*1e3:.2f} ms" if as_string else d
+
+    def get_total_params(self, as_string=False):
+        n = 0
+        if self.engine is not None:
+            from deepspeed_tpu.utils.tree import tree_num_params
+            n = tree_num_params(self.engine.state.params)
+        return _num_to_string(n) if as_string else n
+
+    def print_model_profile(self, profile_step=1, module_depth=-1, top_modules=1,
+                            detailed=True, output_file=None):
+        flops = self.get_total_flops()
+        dur = self.get_total_duration()
+        peak = _peak_flops()
+        achieved = flops / dur if dur else 0.0
+        lines = [
+            "-------------------------- DeepSpeed-TPU Flops Profiler --------------------------",
+            f"profile step:                   {profile_step}",
+            f"params:                         {self.get_total_params(as_string=True)}",
+            f"flops per step:                 {_num_to_string(flops)}FLOPS",
+            f"step latency:                   {dur*1e3:.2f} ms",
+            f"achieved:                       {achieved/1e12:.2f} TFLOPS "
+            f"({100*achieved/peak:.1f}% of peak)",
+            f"bytes accessed:                 {_num_to_string(self.analysis.get('bytes accessed', 0))}B",
+            "----------------------------------------------------------------------------------",
+        ]
+        report = "\n".join(lines)
+        if output_file:
+            with open(output_file, "w") as f:
+                f.write(report)
+        else:
+            logger.info("\n" + report)
+        return report
+
+    def end_profile(self):
+        self.stop_profile()
+
+
+def get_model_profile(model, input_shape=None, args=(), kwargs=None, print_profile=True,
+                      detailed=True, module_depth=-1, top_modules=1, warm_up=1,
+                      as_string=True, output_file=None, ignore_modules=None):
+    """Reference `get_model_profile` — profile a callable outside the engine.
+    `model` is a jittable fn; `args` its example inputs."""
+    prof = FlopsProfiler()
+    prof.profile_fn(model, *args, **(kwargs or {}))
+    if print_profile:
+        prof.print_model_profile(detailed=detailed, module_depth=module_depth,
+                                 top_modules=top_modules, output_file=output_file)
+    flops = prof.get_total_flops(as_string=as_string)
+    macs = prof.get_total_macs(as_string=as_string)
+    params = prof.get_total_params(as_string=as_string)
+    return flops, macs, params
+
+
+def _num_to_string(num, precision=2):
+    if num >= 1e12:
+        return f"{num/1e12:.{precision}f} T"
+    if num >= 1e9:
+        return f"{num/1e9:.{precision}f} G"
+    if num >= 1e6:
+        return f"{num/1e6:.{precision}f} M"
+    if num >= 1e3:
+        return f"{num/1e3:.{precision}f} K"
+    return f"{num:.{precision}f} "

@@ -1,0 +1,112 @@
+"""Access to partitioned optimizer/master state — the `safe_get_full_*` API.
+
+Reference: `deepspeed/utils/tensor_fragment.py:101-190` — public helpers that
+reassemble a full fp32 param / gradient / optimizer-state tensor from its ZeRO
+shards so user code can inspect or edit them mid-training.
+
+On TPU the shards are global arrays with NamedShardings, so "gathering" is a
+resharding to replicated + device_get; editing is a functional update + re-placement.
+The engine is passed explicitly (no hidden registry): these helpers take
+(engine, path) where path is a tuple of pytree keys, or a '/'-joined string.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _resolve(tree, path):
+    if isinstance(path, str):
+        path = tuple(path.split("/"))
+    node = tree
+    for k in path:
+        if isinstance(node, (list, tuple)):
+            node = node[int(k)]
+        else:
+            node = node[k]
+    return node
+
+
+def _set(tree, path, value):
+    """Functional set returning a new pytree."""
+    if isinstance(path, str):
+        path = tuple(path.split("/"))
+
+    def rec(node, keys):
+        if not keys:
+            return value
+        k = keys[0]
+        if isinstance(node, dict):
+            return {**node, k: rec(node[k], keys[1:])}
+        if isinstance(node, (list, tuple)):
+            i = int(k)
+            items = list(node)
+            items[i] = rec(items[i], keys[1:])
+            return type(node)(items)
+        raise TypeError(f"cannot descend into {type(node)}")
+
+    return rec(tree, path)
+
+
+def _gather(arr):
+    mesh = arr.sharding.mesh if hasattr(arr.sharding, "mesh") else None
+    if mesh is not None:
+        arr = jax.device_put(arr, NamedSharding(mesh, P()))
+    return np.asarray(jax.device_get(arr))
+
+
+def safe_get_full_fp32_param(engine, path):
+    """Full fp32 master weight for a param (reference same name)."""
+    source = engine.state.master if engine.keep_master else engine.state.params
+    return _gather(_resolve(source, path)).astype(np.float32)
+
+
+def safe_set_full_fp32_param(engine, path, value):
+    source_name = "master" if engine.keep_master else "params"
+    source = getattr(engine.state, source_name)
+    leaf = _resolve(source, path)
+    new_leaf = jax.device_put(jnp.asarray(value, leaf.dtype), leaf.sharding)
+    new_source = _set(source, path, new_leaf)
+    engine.state = engine.state._replace(**{source_name: new_source})
+    if engine.keep_master:
+        # propagate to the compute-dtype copy
+        params_leaf = _resolve(engine.state.params, path)
+        new_params = _set(engine.state.params, path,
+                          jax.device_put(jnp.asarray(value, params_leaf.dtype),
+                                         params_leaf.sharding))
+        engine.state = engine.state._replace(params=new_params)
+
+
+def safe_get_full_optimizer_state(engine, path, optim_state_key):
+    """Full fp32 optimizer state (e.g. optim_state_key='mu'/'nu' ~ exp_avg/exp_avg_sq)."""
+    alias = {"exp_avg": "mu", "exp_avg_sq": "nu"}
+    key = alias.get(optim_state_key, optim_state_key)
+
+    # walk the optax state tuple looking for a field named `key`
+    def find(node):
+        if hasattr(node, "_fields") and key in getattr(node, "_fields", ()):
+            return getattr(node, key)
+        if isinstance(node, (tuple, list)):
+            for child in node:
+                r = find(child)
+                if r is not None:
+                    return r
+        return None
+
+    sub = find(engine.state.opt_state)
+    if sub is None:
+        raise KeyError(f"optimizer state '{optim_state_key}' not found")
+    return _gather(_resolve(sub, path)).astype(np.float32)
+
+
+def safe_get_full_grad(engine, path):
+    """Last accumulated full gradient (only available between backward() and step()
+    on the parity API — the fused train_batch consumes grads inside one program)."""
+    acc = getattr(engine, "_grad_acc", None)
+    if acc is None:
+        logger.warning("no pending gradients: call after forward/backward, before step")
+        return None
+    return _gather(_resolve(acc, path))
