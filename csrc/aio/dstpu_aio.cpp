@@ -1,0 +1,176 @@
+// Async file I/O library for NVMe tensor swapping.
+//
+// TPU-native analog of the reference's AIO op (`csrc/aio/py_lib/
+// deepspeed_py_aio_handle.cpp`, `deepspeed_aio_thread.cpp`): a pthread pool
+// serving pread/pwrite requests against O_DIRECT-capable files, with a
+// completion-wait API. Powers ZeRO-Infinity-style optimizer/param spill
+// (deepspeed_tpu/runtime/swap_tensor.py drives it over ctypes).
+//
+// Design notes vs the reference:
+//  * POSIX pread/pwrite + thread pool instead of libaio: no external dep,
+//    portable, and with queue depth == thread count it saturates NVMe the same
+//    way the reference's aio_thread pool does.
+//  * Buffers are caller-owned (numpy arrays pinned by Python); no torch tensors.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Request {
+    int64_t id;
+    bool is_write;
+    std::string path;
+    void* buffer;
+    int64_t num_bytes;
+    int64_t file_offset;
+};
+
+class AioHandle {
+  public:
+    AioHandle(int num_threads, int block_size)
+        : block_size_(block_size > 0 ? block_size : (1 << 20)), stop_(false),
+          next_id_(1), completed_(0), submitted_(0), errors_(0) {
+        if (num_threads <= 0) num_threads = 4;
+        for (int i = 0; i < num_threads; ++i) {
+            workers_.emplace_back([this] { this->worker(); });
+        }
+    }
+
+    ~AioHandle() {
+        {
+            std::lock_guard<std::mutex> lk(mu_);
+            stop_ = true;
+        }
+        cv_.notify_all();
+        for (auto& t : workers_) t.join();
+    }
+
+    int64_t submit(bool is_write, const char* path, void* buffer, int64_t num_bytes,
+                   int64_t file_offset) {
+        Request req;
+        {
+            std::lock_guard<std::mutex> lk(mu_);
+            req.id = next_id_++;
+            req.is_write = is_write;
+            req.path = path;
+            req.buffer = buffer;
+            req.num_bytes = num_bytes;
+            req.file_offset = file_offset;
+            queue_.push_back(req);
+            ++submitted_;
+        }
+        cv_.notify_one();
+        return req.id;
+    }
+
+    // Block until all submitted requests completed. Returns number of errors.
+    int64_t wait() {
+        std::unique_lock<std::mutex> lk(mu_);
+        done_cv_.wait(lk, [this] { return completed_ == submitted_; });
+        return errors_;
+    }
+
+    int64_t pending() {
+        std::lock_guard<std::mutex> lk(mu_);
+        return submitted_ - completed_;
+    }
+
+  private:
+    void worker() {
+        for (;;) {
+            Request req;
+            {
+                std::unique_lock<std::mutex> lk(mu_);
+                cv_.wait(lk, [this] { return stop_ || !queue_.empty(); });
+                if (stop_ && queue_.empty()) return;
+                req = queue_.front();
+                queue_.pop_front();
+            }
+            bool ok = run(req);
+            {
+                std::lock_guard<std::mutex> lk(mu_);
+                ++completed_;
+                if (!ok) ++errors_;
+                if (completed_ == submitted_) done_cv_.notify_all();
+            }
+        }
+    }
+
+    bool run(const Request& req) {
+        int flags = req.is_write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+        int fd = ::open(req.path.c_str(), flags, 0644);
+        if (fd < 0) return false;
+        char* buf = static_cast<char*>(req.buffer);
+        int64_t remaining = req.num_bytes;
+        int64_t offset = req.file_offset;
+        bool ok = true;
+        while (remaining > 0) {
+            int64_t chunk = remaining < block_size_ ? remaining : block_size_;
+            ssize_t n = req.is_write ? ::pwrite(fd, buf, chunk, offset)
+                                     : ::pread(fd, buf, chunk, offset);
+            if (n <= 0) {
+                ok = false;
+                break;
+            }
+            buf += n;
+            offset += n;
+            remaining -= n;
+        }
+        if (req.is_write && ok) ::fsync(fd);
+        ::close(fd);
+        return ok;
+    }
+
+    int64_t block_size_;
+    bool stop_;
+    int64_t next_id_;
+    int64_t completed_;
+    int64_t submitted_;
+    int64_t errors_;
+    std::deque<Request> queue_;
+    std::vector<std::thread> workers_;
+    std::mutex mu_;
+    std::condition_variable cv_, done_cv_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dstpu_aio_create(int num_threads, int block_size) {
+    return new AioHandle(num_threads, block_size);
+}
+
+void dstpu_aio_destroy(void* handle) { delete static_cast<AioHandle*>(handle); }
+
+int64_t dstpu_aio_pread(void* handle, const char* path, void* buffer,
+                        int64_t num_bytes, int64_t file_offset) {
+    return static_cast<AioHandle*>(handle)->submit(false, path, buffer, num_bytes,
+                                                   file_offset);
+}
+
+int64_t dstpu_aio_pwrite(void* handle, const char* path, void* buffer,
+                         int64_t num_bytes, int64_t file_offset) {
+    return static_cast<AioHandle*>(handle)->submit(true, path, buffer, num_bytes,
+                                                   file_offset);
+}
+
+int64_t dstpu_aio_wait(void* handle) { return static_cast<AioHandle*>(handle)->wait(); }
+
+int64_t dstpu_aio_pending(void* handle) {
+    return static_cast<AioHandle*>(handle)->pending();
+}
+
+}  // extern "C"

@@ -1,0 +1,126 @@
+"""Host-side optimizer step for ZeRO-Offload / ZeRO-Infinity.
+
+Reference: `deepspeed/ops/adam/cpu_adam.py:13` over `csrc/adam/cpu_adam_impl.cpp`
+— fp32 master weights + moments live on host (or NVMe), the step runs on CPU
+cores while the accelerator computes, and only bit16 params return to the device.
+
+`HostOffloadOptimizer` owns: fp32 master (numpy), moments (numpy or NVMe-swapped),
+the C++ step (OpenMP-SIMD), and the device push of updated compute-dtype params.
+The engine uses it when `zero_optimization.offload_optimizer.device == "nvme"`
+(state on disk) or `"cpu"` with `offload_optimizer.fast_init` … any config where
+the step itself must leave the device.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class HostOffloadOptimizer:
+    """Flat-leaf host Adam/AdamW (+Lion/Adagrad) with optional NVMe state tier."""
+
+    def __init__(self, params, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adamw_mode=True, bias_correction=True,
+                 optimizer="adam", nvme_folder=None, lr_schedule=None,
+                 aio_threads=4):
+        from deepspeed_tpu.ops.op_builder import CPUAdamBuilder
+        self.lib = CPUAdamBuilder().load()
+        self.lr = lr
+        self.lr_schedule = lr_schedule
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.bias_correction = bias_correction
+        self.optimizer = optimizer
+        self.step_count = 0
+
+        leaves, self.treedef = jax.tree_util.tree_flatten(params)
+        self.shapes = [l.shape for l in leaves]
+        self.master = [np.asarray(jax.device_get(l), np.float32).copy() for l in leaves]
+
+        self.nvme = None
+        if nvme_folder is not None:
+            from deepspeed_tpu.runtime.swap_tensor import OptimizerStateSwapper
+            self.nvme = OptimizerStateSwapper(nvme_folder, num_threads=aio_threads)
+            init = {}
+            for i, m in enumerate(self.master):
+                init[f"m_{i}"] = np.zeros_like(m)
+                if optimizer == "adam":
+                    init[f"v_{i}"] = np.zeros_like(m)
+            self.nvme.initialize(init)
+            self.exp_avg = None
+            self.exp_avg_sq = None
+        else:
+            self.exp_avg = [np.zeros_like(m) for m in self.master]
+            self.exp_avg_sq = ([np.zeros_like(m) for m in self.master]
+                               if optimizer == "adam" else None)
+
+    def _current_lr(self):
+        if self.lr_schedule is not None:
+            return float(self.lr_schedule(self.step_count))
+        return self.lr
+
+    def step(self, grads_tree):
+        """grads_tree: pytree of (device or numpy) fp32 grads. Returns updated
+        master params as a pytree of numpy fp32."""
+        self.step_count += 1
+        lr = self._current_lr()
+        grads = [np.asarray(jax.device_get(g), np.float32)
+                 for g in jax.tree_util.tree_flatten(grads_tree)[0]]
+
+        if self.nvme is not None:
+            states = self.nvme.swap_in_all()
+            exp_avg = [states[f"m_{i}"] for i in range(len(self.master))]
+            exp_avg_sq = [states.get(f"v_{i}") for i in range(len(self.master))]
+        else:
+            exp_avg, exp_avg_sq = self.exp_avg, self.exp_avg_sq or [None] * len(self.master)
+
+        for i, (p, g, m) in enumerate(zip(self.master, grads, exp_avg)):
+            n = p.size
+            if self.optimizer == "adam":
+                v = exp_avg_sq[i]
+                self.lib.dstpu_cpu_adam_step(
+                    p.ctypes.data, np.ascontiguousarray(g).ctypes.data,
+                    m.ctypes.data, v.ctypes.data, n, lr,
+                    self.betas[0], self.betas[1], self.eps, self.weight_decay,
+                    1 if self.adamw_mode else 0, self.step_count,
+                    1 if self.bias_correction else 0)
+            elif self.optimizer == "lion":
+                self.lib.dstpu_cpu_lion_step(
+                    p.ctypes.data, np.ascontiguousarray(g).ctypes.data,
+                    m.ctypes.data, n, lr, self.betas[0], self.betas[1],
+                    self.weight_decay)
+            else:
+                self.lib.dstpu_cpu_adagrad_step(
+                    p.ctypes.data, np.ascontiguousarray(g).ctypes.data,
+                    m.ctypes.data, n, lr, self.eps, self.weight_decay)
+
+        if self.nvme is not None:
+            out = {}
+            for i, m in enumerate(exp_avg):
+                out[f"m_{i}"] = m
+                if exp_avg_sq[i] is not None:
+                    out[f"v_{i}"] = exp_avg_sq[i]
+            self.nvme.swap_out_all(out)
+
+        return jax.tree_util.tree_unflatten(self.treedef, self.master)
+
+    def state_dict(self):
+        sd = {"step": self.step_count, "master": self.master}
+        if self.nvme is None:
+            sd["exp_avg"] = self.exp_avg
+            if self.exp_avg_sq is not None:
+                sd["exp_avg_sq"] = self.exp_avg_sq
+        return sd
+
+    def load_state_dict(self, sd):
+        self.step_count = sd["step"]
+        self.master = [np.asarray(m, np.float32) for m in sd["master"]]
+        if self.nvme is None and "exp_avg" in sd:
+            self.exp_avg = [np.asarray(m, np.float32) for m in sd["exp_avg"]]
+            if "exp_avg_sq" in sd:
+                self.exp_avg_sq = [np.asarray(m, np.float32) for m in sd["exp_avg_sq"]]

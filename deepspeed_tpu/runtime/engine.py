@@ -154,10 +154,15 @@ class Engine:
                                  "'optimizer' config block")
             optimizer = build_optimizer(config.optimizer, self.schedule_fn)
         self.optimizer = optimizer  # optax GradientTransformation
+        off_cfg = config.zero_optimization.offload_optimizer
+        # "cpu": optimizer state in pinned host memory, step stays compiled.
+        # "nvme": ZeRO-Infinity tier — fp32 master + moments on host/disk, the
+        # step runs in C++ (csrc/cpu_optim) while only bit16 params live on device.
         self.offload_optimizer_states = bool(
             getattr(optimizer, "offload_to_host", False)
-            or (config.zero_optimization.offload_optimizer is not None
-                and config.zero_optimization.offload_optimizer.device == "cpu"))
+            or (off_cfg is not None and off_cfg.device == "cpu"))
+        self.nvme_offload = off_cfg is not None and off_cfg.device == "nvme"
+        self.host_optimizer = None
 
         # ---- loss fn
         self._loss_fn = _wrap_loss_fn(model.loss_fn, model.has_aux)
@@ -172,7 +177,14 @@ class Engine:
                  f"global_bs={self.train_batch_size_value}", ranks=[0])
 
         # ---- jitted programs
-        self._train_step = self._build_train_step()
+        if self.host_optimizer is not None:
+            self._train_step = None
+            self._grad_program = self._build_grad_program()
+            self._push_params = jax.jit(
+                lambda m: tree_cast(m, self.compute_dtype),
+                out_shardings=self.param_shardings)
+        else:
+            self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
         self._grad_step = None        # built lazily for forward/backward/step API
         self._apply_step = None
@@ -209,6 +221,9 @@ class Engine:
         params_c = tree_cast(params, self.compute_dtype)
         params_c = jax.device_put(params_c, self.param_shardings)
 
+        if self.nvme_offload:
+            return self._init_state_host_offload(params, params_c)
+
         # fp32 master (ZeRO-partitioned — reference stage_1_and_2.py:630).
         # base_specs carry the model's TP/PP axes so master/opt shards inherit them.
         if self.keep_master:
@@ -244,6 +259,41 @@ class Engine:
         )
         return TrainState(params=params_c, master=master, opt_state=opt_state,
                           scaler=scaler_state, step=step, rng=rng)
+
+    def _init_state_host_offload(self, params, params_c):
+        """ZeRO-Infinity state: master + moments owned by HostOffloadOptimizer
+        (fp32 numpy, moments optionally NVMe-swapped); device holds only the
+        compute-dtype params and the loss-scaler scalars."""
+        from deepspeed_tpu.runtime.cpu_optimizer import HostOffloadOptimizer
+        off = self.config.zero_optimization.offload_optimizer
+        opt_cfg = self.config.optimizer
+        opt_params = dict(opt_cfg.params if opt_cfg else {})
+        opt_name = (opt_cfg.type.lower() if opt_cfg else "adam")
+        kind = ("lion" if "lion" in opt_name
+                else "adagrad" if "adagrad" in opt_name else "adam")
+        self.host_optimizer = HostOffloadOptimizer(
+            params,
+            lr=opt_params.get("lr", 1e-3),
+            betas=tuple(opt_params.get("betas", (0.9, 0.999))),
+            eps=opt_params.get("eps", 1e-8),
+            weight_decay=opt_params.get("weight_decay", 0.0),
+            adamw_mode="adamw" in opt_name or kind != "adam",
+            optimizer=kind,
+            nvme_folder=off.nvme_path,
+            lr_schedule=self.schedule_fn,
+            aio_threads=off.buffer_count,
+        )
+        rep = NamedSharding(self.mesh, P())
+        self.master_shardings = None
+        self.opt_shardings = None
+        self.state_shardings = TrainState(
+            params=self.param_shardings, master=None, opt_state=None,
+            scaler=LossScaleState(rep, rep, rep, rep), step=rep, rng=rep)
+        return TrainState(
+            params=params_c, master=None, opt_state=None,
+            scaler=jax.device_put(self.scaler.init(), rep),
+            step=jax.device_put(jnp.asarray(0, jnp.int32), rep),
+            rng=jax.device_put(jax.random.PRNGKey(self.config.seed), rep))
 
     def _to_host(self, tree):
         """Move a pytree to pinned host memory (ZeRO-Offload optimizer states)."""
@@ -376,6 +426,51 @@ class Engine:
                        donate_argnums=(0,),
                        out_shardings=(self.state_shardings, None))
 
+    def _build_grad_program(self):
+        """Device program for the host-offload step: grads + loss only."""
+        gas = self.gradient_accumulation_steps_value
+        micro_grad = self._micro_grad_fn()
+        grad_shardings = self.param_shardings
+
+        def grad_program(params, batch, rng, scaler_state):
+            if gas > 1:
+                def body(carry, mb):
+                    g_acc, loss_acc, i = carry
+                    g, l = micro_grad(params, mb, jax.random.fold_in(rng, i), scaler_state)
+                    g_acc = jax.tree_util.tree_map(
+                        lambda a, b: a + b.astype(jnp.float32), g_acc, g)
+                    return (g_acc, loss_acc + l.astype(jnp.float32), i + 1), None
+
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                (grads, loss_sum, _), _ = jax.lax.scan(
+                    body, (zeros, jnp.asarray(0.0, jnp.float32), 0), batch)
+                grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
+                loss = loss_sum / gas
+            else:
+                grads, loss = micro_grad(params, batch, rng, scaler_state)
+                grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
+            return grads, loss
+
+        return jax.jit(grad_program)
+
+    def _host_train_batch(self, batch):
+        """ZeRO-Infinity step: device grads -> C++ host optimizer -> params push."""
+        placed = self._maybe_split_gas(batch)
+        rng = jax.random.fold_in(self.state.rng, self.state.step)
+        grads, loss = self._grad_program(self.state.params, placed, rng,
+                                         self.state.scaler)
+        master = self.host_optimizer.step(grads)
+        params = self._push_params(master)
+        self.state = self.state._replace(params=params, step=self.state.step + 1)
+        metrics = {"loss": loss.astype(jnp.float32),
+                   "grad_norm": jnp.asarray(0.0),
+                   "overflow": jnp.asarray(False),
+                   "loss_scale": self.state.scaler.scale,
+                   "lr": jnp.asarray(self.host_optimizer._current_lr(), jnp.float32)}
+        return metrics
+
     def _build_eval_step(self):
         loss_fn = self._loss_fn
 
@@ -461,8 +556,11 @@ class Engine:
             batch = next(it)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
-        placed = self._maybe_split_gas(batch)
-        self.state, metrics = self._train_step(self.state, placed)
+        if self.host_optimizer is not None:
+            metrics = self._host_train_batch(batch)
+        else:
+            placed = self._maybe_split_gas(batch)
+            self.state, metrics = self._train_step(self.state, placed)
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
         self._after_step(metrics, count_micro=True)

@@ -1,0 +1,83 @@
+"""Native C++ ops: AIO swap roundtrip, CPU Adam numerics vs optax, NVMe-offload
+engine training (reference: tests/unit/ops/aio, ops/adam)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from tests.simple_model import make_simple_model, random_batches, simple_config
+
+
+@pytest.fixture(scope="module")
+def native_available():
+    import shutil
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    return True
+
+
+def test_aio_roundtrip(tmp_path, native_available):
+    from deepspeed_tpu.runtime.swap_tensor import AsyncTensorSwapper
+    sw = AsyncTensorSwapper(str(tmp_path), num_threads=2)
+    rng = np.random.default_rng(0)
+    a = rng.normal(0, 1, (1024, 128)).astype(np.float32)
+    b = rng.normal(0, 1, (257,)).astype(np.float32)
+    sw.swap_out("a", a)
+    sw.swap_out("nested/b", b)
+    sw.wait()
+    a2 = sw.swap_in("a", a.shape, a.dtype)
+    b2 = sw.swap_in("nested/b", b.shape, b.dtype)
+    sw.wait()
+    np.testing.assert_array_equal(a, a2)
+    np.testing.assert_array_equal(b, b2)
+    sw.release()
+
+
+def test_cpu_adam_matches_optax(native_available):
+    from deepspeed_tpu.runtime.cpu_optimizer import HostOffloadOptimizer
+    import optax
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.normal(0, 1, (64, 32)), jnp.float32),
+              "b": jnp.asarray(rng.normal(0, 1, (32,)), jnp.float32)}
+    host = HostOffloadOptimizer(params, lr=1e-2, betas=(0.9, 0.999), eps=1e-8,
+                                weight_decay=0.01, adamw_mode=True)
+    tx = optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+    opt_state = tx.init(params)
+    ref = params
+    for step in range(5):
+        grads = {"w": jnp.asarray(rng.normal(0, 1, (64, 32)), jnp.float32),
+                 "b": jnp.asarray(rng.normal(0, 1, (32,)), jnp.float32)}
+        new_master = host.step(grads)
+        updates, opt_state = tx.update(grads, opt_state, ref)
+        ref = optax.apply_updates(ref, updates)
+        np.testing.assert_allclose(np.asarray(new_master["w"]), np.asarray(ref["w"]),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_cpu_lion_runs(native_available):
+    from deepspeed_tpu.runtime.cpu_optimizer import HostOffloadOptimizer
+    params = {"w": jnp.ones((16, 16), jnp.float32)}
+    host = HostOffloadOptimizer(params, lr=1e-2, betas=(0.9, 0.99), optimizer="lion")
+    out = host.step({"w": jnp.ones((16, 16), jnp.float32)})
+    assert np.isfinite(np.asarray(out["w"])).all()
+    assert not np.allclose(np.asarray(out["w"]), 1.0)
+
+
+def test_nvme_offload_engine_trains(tmp_path, native_available):
+    """ZeRO-Infinity path: moments on disk, C++ host step, loss must drop."""
+    cfg = simple_config(stage=2, mesh={"data": 8})
+    cfg["zero_optimization"]["offload_optimizer"] = {
+        "device": "nvme", "nvme_path": str(tmp_path), "buffer_count": 2}
+    model = make_simple_model()
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    assert engine.host_optimizer is not None
+    batch = random_batches(1, engine.train_batch_size())[0]
+    losses = [float(engine.train_batch(batch)) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+    # moment files exist on "NVMe"
+    import pathlib
+    swp = list(pathlib.Path(tmp_path).glob("*.swp"))
+    assert len(swp) >= 2
