@@ -1,0 +1,142 @@
+"""Autotuning — ZeRO-stage / micro-batch search.
+
+Reference: `deepspeed/autotuning/` (2.7k LoC): model-info profile run, max-mbs
+binary search, per-stage experiment grid over a resource pool, xgboost cost
+model.
+
+TPU-native: experiments run in-process (no multi-node scheduler needed — one
+process drives the chips): for each candidate (zero_stage, micro_batch), build
+an engine, time a few steps (honest scalar-readback fence), tear down. Memory
+feasibility is probed by compile+run inside try/except (XLA OOMs deterministically
+at allocation). Search: binary-search max mbs per stage, then pick by
+throughput (metric="throughput") or latency.
+"""
+
+import copy
+import gc
+import json
+import pathlib
+import time
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_STAGES = (0, 1, 2, 3)
+
+
+class Autotuner:
+    """Reference class name; `tune()` returns (best_config_dict, results)."""
+
+    def __init__(self, model_factory, base_config, batch_factory,
+                 stages=DEFAULT_STAGES, max_micro_batch=1024, steps=4, warmup=2,
+                 results_dir=None, metric="throughput"):
+        """model_factory() -> ModelSpec (fresh params per experiment);
+        batch_factory(global_batch_size) -> batch pytree."""
+        self.model_factory = model_factory
+        self.base_config = copy.deepcopy(base_config)
+        self.batch_factory = batch_factory
+        self.stages = stages
+        self.max_micro_batch = max_micro_batch
+        self.steps = steps
+        self.warmup = warmup
+        self.metric = metric
+        self.results_dir = results_dir
+        self.results = []
+
+    # ---- single experiment ----
+
+    def _run_experiment(self, stage, micro_batch):
+        import jax
+        import deepspeed_tpu
+        from deepspeed_tpu.comm import mesh as mesh_mod
+        mesh_mod._CURRENT_MESH = None
+        mesh_mod._CURRENT_SPEC = None
+        cfg = copy.deepcopy(self.base_config)
+        cfg["train_micro_batch_size_per_gpu"] = micro_batch
+        cfg.setdefault("zero_optimization", {})["stage"] = stage
+        cfg["gradient_accumulation_steps"] = 1
+        engine = None
+        try:
+            model = self.model_factory()
+            engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+            batch = self.batch_factory(engine.train_batch_size())
+            for _ in range(self.warmup):
+                loss = engine.train_batch(batch)
+            float(loss)
+            t0 = time.perf_counter()
+            for _ in range(self.steps):
+                loss = engine.train_batch(batch)
+            float(loss)
+            dt = (time.perf_counter() - t0) / self.steps
+            tput = engine.train_batch_size() / dt
+            rec = {"stage": stage, "micro_batch": micro_batch, "step_ms": dt * 1e3,
+                   "samples_per_sec": tput, "status": "ok"}
+        except Exception as e:
+            rec = {"stage": stage, "micro_batch": micro_batch, "status": "fail",
+                   "error": str(e)[:200]}
+        finally:
+            del engine
+            gc.collect()
+        self.results.append(rec)
+        logger.info(f"autotune experiment: {rec}")
+        return rec
+
+    # ---- search ----
+
+    def _max_feasible_mbs(self, stage):
+        """Binary search the largest runnable micro-batch (reference mbs search)."""
+        lo, hi = 1, self.max_micro_batch
+        best = None
+        # fast doubling first
+        mb = 1
+        while mb <= hi:
+            rec = self._run_experiment(stage, mb)
+            if rec["status"] == "ok":
+                best = rec
+                mb *= 2
+            else:
+                hi = mb - 1
+                break
+        if best is None:
+            return None
+        lo = best["micro_batch"]
+        # binary refine between lo and hi
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if mid == best["micro_batch"]:
+                break
+            rec = self._run_experiment(stage, mid)
+            if rec["status"] == "ok":
+                best = rec
+                lo = mid
+            else:
+                hi = mid - 1
+        return best
+
+    def tune(self):
+        """Reference `Autotuner.tune` (`autotuner.py:404`)."""
+        best = None
+        for stage in self.stages:
+            rec = self._max_feasible_mbs(stage)
+            if rec is None:
+                continue
+            if best is None:
+                best = rec
+            elif self.metric == "throughput" and rec["samples_per_sec"] > best["samples_per_sec"]:
+                best = rec
+            elif self.metric == "latency" and rec["step_ms"] < best["step_ms"]:
+                best = rec
+        if self.results_dir:
+            out = pathlib.Path(self.results_dir)
+            out.mkdir(parents=True, exist_ok=True)
+            with open(out / "autotuning_results.json", "w") as f:
+                json.dump(self.results, f, indent=2)
+        if best is None:
+            raise RuntimeError("autotuning: no feasible configuration found")
+        tuned = copy.deepcopy(self.base_config)
+        tuned["train_micro_batch_size_per_gpu"] = best["micro_batch"]
+        tuned.setdefault("zero_optimization", {})["stage"] = best["stage"]
+        logger.info(f"autotune best: stage={best['stage']} mbs={best['micro_batch']} "
+                    f"({best['samples_per_sec']:.1f} samples/s)")
+        return tuned, best

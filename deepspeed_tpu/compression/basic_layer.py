@@ -1,0 +1,71 @@
+"""Compression primitives: fake quantization (QAT) and pruning masks.
+
+Reference: `deepspeed/compression/basic_layer.py` (LinearLayer_Compress with
+weight/activation quantization and sparse/row/head/channel pruning) +
+`compression/utils.py`. Functional form: transforms applied to params inside the
+loss (straight-through estimator keeps them differentiable).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def fake_quantize(w, bits=8, symmetric=True, per_channel=True, axis=-1):
+    """QAT fake-quant with straight-through estimator (reference
+    `Quantizer`/`fake_quantizer.cu` semantics)."""
+    if per_channel and w.ndim >= 2:
+        reduce_axes = tuple(i for i in range(w.ndim) if i != (axis % w.ndim))
+    else:
+        reduce_axes = tuple(range(w.ndim))
+    qmax = 2.0**(bits - 1) - 1
+    amax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / qmax
+    q = jnp.round(w / scale)
+    q = jnp.clip(q, -qmax - 1, qmax)
+    dequant = q * scale
+    # STE: forward quantized, backward identity
+    return w + jax.lax.stop_gradient(dequant - w)
+
+
+def prune_magnitude(w, sparsity_ratio, method="l1", dim=None):
+    """Magnitude pruning mask (reference sparse/row pruning): zero the smallest
+    `sparsity_ratio` fraction — unstructured (dim=None) or whole rows/cols."""
+    if sparsity_ratio <= 0:
+        return w
+    if dim is None:
+        score = jnp.abs(w)
+        k = int(score.size * sparsity_ratio)
+        if k == 0:
+            return w
+        threshold = jnp.sort(score.reshape(-1))[k - 1]
+        mask = (score > threshold).astype(w.dtype)
+    else:
+        score = jnp.sum(jnp.abs(w), axis=tuple(i for i in range(w.ndim) if i != dim))
+        k = int(score.size * sparsity_ratio)
+        if k == 0:
+            return w
+        threshold = jnp.sort(score)[k - 1]
+        keep = (score > threshold).astype(w.dtype)
+        shape = [1] * w.ndim
+        shape[dim] = w.shape[dim]
+        mask = keep.reshape(shape)
+    return w * mask
+
+
+def head_prune(w_qkv, num_heads, ratio):
+    """Head pruning for fused qkv weights [.., D, 3D]: zero lowest-norm heads."""
+    if ratio <= 0:
+        return w_qkv
+    D = w_qkv.shape[-2]
+    hd = D // num_heads
+    parts = jnp.split(w_qkv, 3, axis=-1)          # q,k,v each [..., D, D]
+    q = parts[0].reshape(*parts[0].shape[:-1], num_heads, hd)
+    score = jnp.sqrt(jnp.sum(jnp.square(q), axis=tuple(range(q.ndim - 2)) + (q.ndim - 1,)))
+    k = int(num_heads * ratio)
+    if k == 0:
+        return w_qkv
+    threshold = jnp.sort(score)[k - 1]
+    keep = (score > threshold).astype(w_qkv.dtype)     # [H]
+    mask = jnp.repeat(keep, hd)                         # [D]
+    return w_qkv * jnp.concatenate([mask, mask, mask])[None, :] \
+        if w_qkv.ndim == 2 else w_qkv * jnp.concatenate([mask, mask, mask])

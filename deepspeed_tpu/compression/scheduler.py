@@ -1,0 +1,23 @@
+"""Compression scheduling (reference `compression/scheduler.py`): feature gates by
+global step (offset / frequency)."""
+
+
+class CompressionScheduler:
+    def __init__(self, schedule_offset=0, schedule_offset_end=None, frequency=1):
+        self.offset = schedule_offset
+        self.offset_end = schedule_offset_end
+        self.frequency = max(frequency, 1)
+
+    def is_active(self, step):
+        if step < self.offset:
+            return False
+        if self.offset_end is not None and step > self.offset_end:
+            return False
+        return (step - self.offset) % self.frequency == 0
+
+    def ratio(self, step, start_ratio=0.0, target_ratio=0.5, total_steps=1000):
+        """Cubic sparsity ramp (snip_momentum style)."""
+        if step <= self.offset:
+            return start_ratio
+        progress = min((step - self.offset) / max(total_steps - self.offset, 1), 1.0)
+        return target_ratio + (start_ratio - target_ratio) * (1 - progress)**3

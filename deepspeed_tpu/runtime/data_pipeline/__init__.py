@@ -1,0 +1,9 @@
+from deepspeed_tpu.runtime.data_pipeline.curriculum import (
+    CurriculumScheduler,
+    apply_seqlen_curriculum,
+)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
+from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
+    RandomLTDScheduler,
+    random_ltd_layer,
+)

@@ -1,0 +1,95 @@
+"""Curriculum learning.
+
+Reference: `runtime/data_pipeline/data_sampling/` + the legacy seqlen truncation
+path (`runtime/engine.py:1792-1795`): difficulty (e.g. sequence length) ramps
+from `min_difficulty` to `max_difficulty` by a schedule of the global step.
+
+TPU note: changing sequence length per step would retrigger XLA compilation.
+`apply_seqlen_curriculum` therefore keeps the batch shape STATIC and masks
+tokens beyond the current difficulty (labels -> ignore index) — same learning
+signal, one compiled program. Bucketed true-truncation (a few fixed shapes) is
+available via `bucketize=`.
+"""
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+
+
+class CurriculumScheduler:
+    """Reference `CurriculumScheduler` (data_pipeline/curriculum_scheduler.py):
+    difficulty(step) by fixed_linear / fixed_root / fixed_discrete schedules."""
+
+    def __init__(self, config):
+        self.schedule_type = config.get("curriculum_type", config.get("schedule_type",
+                                                                      FIXED_LINEAR))
+        self.min_difficulty = config.get("min_difficulty", 8)
+        self.max_difficulty = config.get("max_difficulty", 1024)
+        cfg = config.get("schedule_config", config)
+        self.total_step = cfg.get("total_curriculum_step", cfg.get("total_step", 10000))
+        self.difficulty_step = cfg.get("difficulty_step", 8)
+        self.root_degree = cfg.get("root_degree", 2)
+        self.difficulties = cfg.get("difficulty", [])
+        self.max_steps = cfg.get("max_step", [])
+        self.current_difficulty = self.min_difficulty
+
+    def update_difficulty(self, global_steps):
+        t = self.schedule_type
+        if t == FIXED_LINEAR:
+            frac = min(global_steps / max(self.total_step, 1), 1.0)
+        elif t == FIXED_ROOT:
+            frac = min((global_steps / max(self.total_step, 1))**(1.0 / self.root_degree), 1.0)
+        elif t == FIXED_DISCRETE:
+            d = self.min_difficulty
+            for diff, until in zip(self.difficulties, self.max_steps):
+                if global_steps >= until:
+                    d = diff
+            self.current_difficulty = d
+            return d
+        else:
+            frac = 1.0
+        raw = self.min_difficulty + (self.max_difficulty - self.min_difficulty) * frac
+        stepped = int(raw // self.difficulty_step * self.difficulty_step)
+        self.current_difficulty = max(stepped, self.min_difficulty)
+        return self.current_difficulty
+
+    def get_difficulty(self, global_steps=None):
+        if global_steps is not None:
+            self.update_difficulty(global_steps)
+        return self.current_difficulty
+
+
+def apply_seqlen_curriculum(batch, difficulty, ignore_index=-1, bucketize=None):
+    """Mask labels past `difficulty` tokens (static-shape curriculum)."""
+    out = dict(batch)
+    tokens = out.get("tokens", out.get("input_ids"))
+    if tokens is None:
+        return out
+    T = tokens.shape[1]
+    if bucketize:
+        difficulty = min((b for b in bucketize if b >= difficulty), default=T)
+        out_tokens = np.asarray(tokens)[:, :difficulty]
+        for k in ("tokens", "input_ids", "labels", "attention_mask"):
+            if k in out:
+                out[k] = np.asarray(out[k])[:, :difficulty]
+        return out
+    if difficulty >= T:
+        return out
+    labels = out.get("labels")
+    if labels is None:
+        # causal LM: derive shifted labels, mask positions past the difficulty
+        tokens_np = np.asarray(tokens)
+        inputs = tokens_np[:, :-1]
+        labels = tokens_np[:, 1:].astype(np.int32).copy()
+        labels[:, max(difficulty - 1, 0):] = ignore_index
+        out["tokens"] = inputs
+        out["labels"] = labels
+    else:
+        labels = np.asarray(labels).astype(np.int32).copy()
+        labels[:, difficulty:] = ignore_index
+        out["labels"] = labels
+    return out

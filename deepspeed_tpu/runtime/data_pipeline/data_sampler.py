@@ -1,0 +1,63 @@
+"""Difficulty-indexed data sampling.
+
+Reference: `DeepSpeedDataSampler` (`data_pipeline/data_sampling/data_sampler.py:36`)
+— curriculum-driven sampler that restricts each epoch's candidate pool to samples
+whose difficulty metric <= current difficulty, using a precomputed
+metric→sample index (the offline `DataAnalyzer` map-reduce).
+
+Here: `difficulties` is an array aligned with the dataset (the analyzer output);
+sampling masks the pool per step and draws global batches deterministically.
+"""
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum import CurriculumScheduler
+
+
+class DeepSpeedDataSampler:
+    def __init__(self, dataset_len, batch_size, difficulties=None,
+                 curriculum_config=None, seed=0, drop_last=True):
+        self.dataset_len = dataset_len
+        self.batch_size = batch_size
+        self.difficulties = (np.asarray(difficulties) if difficulties is not None
+                             else None)
+        self.scheduler = (CurriculumScheduler(curriculum_config)
+                          if curriculum_config else None)
+        self.seed = seed
+        self.global_step = 0
+        self.rng = np.random.default_rng(seed)
+
+    def set_step(self, global_step):
+        self.global_step = global_step
+        if self.scheduler is not None:
+            self.scheduler.update_difficulty(global_step)
+
+    def candidate_pool(self):
+        if self.scheduler is None or self.difficulties is None:
+            return np.arange(self.dataset_len)
+        limit = self.scheduler.current_difficulty
+        pool = np.nonzero(self.difficulties <= limit)[0]
+        if len(pool) < self.batch_size:          # never starve the batch
+            order = np.argsort(self.difficulties)
+            pool = order[:self.batch_size]
+        return pool
+
+    def next_indices(self):
+        pool = self.candidate_pool()
+        idx = self.rng.choice(pool, size=self.batch_size,
+                              replace=len(pool) < self.batch_size)
+        self.global_step += 1
+        if self.scheduler is not None:
+            self.scheduler.update_difficulty(self.global_step)
+        return idx
+
+    def __iter__(self):
+        while True:
+            yield self.next_indices()
+
+    def state_dict(self):
+        return {"global_step": self.global_step, "seed": self.seed}
+
+    def load_state_dict(self, sd):
+        self.global_step = sd["global_step"]
+        self.set_step(self.global_step)

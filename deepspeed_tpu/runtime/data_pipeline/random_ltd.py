@@ -1,0 +1,51 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference: `runtime/data_pipeline/data_routing/` (+ `csrc/random_ltd/
+token_sort.cu`, `gather_scatter.cu`): middle transformer layers process a random
+subset of tokens; the rest bypass the layer; the kept count ramps up by schedule.
+
+TPU formulation: static-shape gather/scatter with a per-step permutation — the
+kept count changes only at schedule boundaries (each distinct count is one
+compiled program, like the reference's reserved-length buckets).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class RandomLTDScheduler:
+    """Kept-token count ramp (reference `data_routing/scheduler.py:38`)."""
+
+    def __init__(self, total_layers, start_ratio=0.5, end_ratio=1.0,
+                 total_steps=10000, ltd_start_layer=1, ltd_end_layer=None,
+                 bucket=64):
+        self.start_ratio = start_ratio
+        self.end_ratio = end_ratio
+        self.total_steps = max(total_steps, 1)
+        self.start_layer = ltd_start_layer
+        self.end_layer = ltd_end_layer if ltd_end_layer is not None else total_layers - 1
+        self.bucket = bucket
+
+    def keep_ratio(self, step):
+        frac = min(step / self.total_steps, 1.0)
+        return self.start_ratio + (self.end_ratio - self.start_ratio) * frac
+
+    def keep_count(self, step, seq_len):
+        raw = int(self.keep_ratio(step) * seq_len)
+        bucketed = max((raw // self.bucket) * self.bucket, self.bucket)
+        return min(bucketed, seq_len)
+
+
+def random_ltd_layer(layer_fn, x, keep_count, rng):
+    """Apply `layer_fn` to a random `keep_count`-token subset of x [B, T, D];
+    dropped tokens pass through unchanged (gather→process→scatter, the role of
+    `token_sort.cu`/`gather_scatter.cu`)."""
+    B, T, D = x.shape
+    if keep_count >= T:
+        return layer_fn(x)
+    perm = jax.vmap(lambda k: jax.random.permutation(k, T))(
+        jax.random.split(rng, B))                       # [B, T]
+    keep_idx = jnp.sort(perm[:, :keep_count], axis=1)   # preserve order
+    sub = jnp.take_along_axis(x, keep_idx[..., None], axis=1)
+    sub_out = layer_fn(sub)
+    return x.at[jnp.arange(B)[:, None], keep_idx].set(sub_out)

@@ -1,0 +1,100 @@
+"""Hybrid engine — one model flipping between training and fast generation (RLHF).
+
+Reference: `runtime/hybrid_engine.py:32` (`DeepSpeedHybridEngine`): inside an
+RLHF step the actor both generates rollouts (inference-optimized: gathered
+params, injected kernels, KV cache) and trains (ZeRO-3 partitioned). The
+reference juggles this with param gather/release and module swapping.
+
+TPU-native: params are global sharded arrays, so "flipping" is free — the decode
+program simply reads the CURRENT training params (XLA re-gathers per program as
+its sharding demands); no LoRA fuse/unfuse or cache retake machinery needed.
+`HybridEngine` = training Engine + a decode path compiled against the live
+params, with the reference's `generate()` surface.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.engine import Engine, ModelSpec
+from deepspeed_tpu.utils.logging import logger, log_dist
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+
+
+class HybridEngine(Engine):
+    """Engine + generate(). Construct via `initialize(..., hybrid_engine=...)` or
+    directly with a DecodeModelSpec for the generation path."""
+
+    def __init__(self, model: ModelSpec, config, decode_spec=None, **kw):
+        super().__init__(model, config, **kw)
+        self._decode_spec = decode_spec
+        self._generate_fn = None
+        self._gen_timer = SynchronizedWallClockTimer()
+        self.latency = 0.0
+        self.generate_count = 0
+
+    def set_decode_spec(self, decode_spec):
+        self._decode_spec = decode_spec
+        self._generate_fn = None
+
+    def _build_generate(self, max_new, greedy, temperature):
+        spec = self._decode_spec
+        assert spec is not None, "HybridEngine needs a DecodeModelSpec (set_decode_spec)"
+
+        def sample(logits, rng):
+            if greedy:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(rng, logits / jnp.maximum(temperature, 1e-6),
+                                          axis=-1).astype(jnp.int32)
+
+        def generate(params, tokens, cache, prompt_len, rng):
+            logits, cache = spec.prefill_fn(params, tokens, cache, None)
+            last = jnp.take_along_axis(logits, (prompt_len - 1)[:, None, None],
+                                       axis=1)[:, 0, :]
+            first = sample(last, rng)
+
+            def body(carry, _):
+                tok, pos, cache, rng = carry
+                rng, sub = jax.random.split(rng)
+                lg, cache = spec.decode_fn(params, tok, pos, cache)
+                nxt = sample(lg, sub)
+                return (nxt, pos + 1, cache, rng), tok
+
+            (_, _, cache, _), toks = jax.lax.scan(
+                body, (first, prompt_len, cache, rng), None, length=max_new)
+            return jnp.moveaxis(toks, 0, 1)
+
+        return jax.jit(generate)
+
+    def generate(self, tokens, max_new_tokens=32, greedy=True, temperature=1.0,
+                 rng=None):
+        """Rollout with the CURRENT training params (reference `generate` :174)."""
+        if self._generate_fn is None or self._gen_max != max_new_tokens:
+            self._generate_fn = self._build_generate(max_new_tokens, greedy, temperature)
+            self._gen_max = max_new_tokens
+        tokens = jnp.asarray(tokens)
+        B, T = tokens.shape
+        cache = self._decode_spec.init_cache(B, T + max_new_tokens,
+                                             self.compute_dtype)
+        prompt_len = jnp.full((B,), T, jnp.int32)
+        rng = rng if rng is not None else jax.random.fold_in(self.state.rng, 17)
+        self._gen_timer("generate").start()
+        out = self._generate_fn(self.state.params, tokens, cache, prompt_len, rng)
+        out = np.asarray(jax.device_get(out))
+        self._gen_timer("generate").stop()
+        self.generate_count += 1
+        self.latency = self._gen_timer("generate").elapsed(reset=True)
+        return out
+
+
+def make_gpt_hybrid_engine(cfg, ds_config, name="gpt-hybrid", seed=0, mesh=None):
+    """Convenience: GPT model wired for RLHF-style train+generate."""
+    from deepspeed_tpu.models.gpt import make_gpt_model, make_gpt_decode_model
+    model = make_gpt_model(cfg=cfg, name=name, seed=seed)
+    from deepspeed_tpu.config.core import TpuTrainConfig
+    engine = HybridEngine(model, TpuTrainConfig.load(ds_config), mesh=mesh)
+    decode = make_gpt_decode_model(cfg=cfg, name=name, params=model.params)
+    engine.set_decode_spec(decode)
+    return engine
