@@ -6,3 +6,9 @@ from deepspeed_tpu.models.gpt import (
     make_gpt_decode_model,
     GPT2_CONFIGS,
 )
+from deepspeed_tpu.models.llama import (
+    LLAMA_CONFIGS,
+    llama_config,
+    make_llama_model,
+    make_llama_decode_model,
+)

@@ -38,12 +38,14 @@ class GPTConfig:
     vocab_size: int = 50304          # padded to 128 multiple (MXU-friendly)
     n_layer: int = 12
     n_head: int = 12
+    n_kv_head: Optional[int] = None  # grouped-query attention; None = n_head (MHA)
     d_model: int = 768
     d_ff: Optional[int] = None       # default 4*d_model (or 8/3 for swiglu)
     max_seq_len: int = 1024
     dropout: float = 0.0
     use_rotary: bool = False         # False: learned positions (GPT-2); True: RoPE
     rotary_pct: float = 1.0
+    rope_theta: float = 10000.0      # RoPE base (LLaMA-3 uses 500000)
     use_swiglu: bool = False         # LLaMA-style gated MLP
     use_rmsnorm: bool = False        # LLaMA-style RMSNorm
     tie_embeddings: bool = True
@@ -55,18 +57,28 @@ class GPTConfig:
     def __post_init__(self):
         if self.d_ff is None:
             self.d_ff = int(8 * self.d_model / 3) if self.use_swiglu else 4 * self.d_model
+        if self.n_kv_head is None:
+            self.n_kv_head = self.n_head
         assert self.d_model % self.n_head == 0
+        assert self.n_head % self.n_kv_head == 0
 
     @property
     def head_dim(self):
         return self.d_model // self.n_head
 
+    @property
+    def qkv_dim(self):
+        """Fused qkv output width: H*hd for q + 2*Hkv*hd for k,v (GQA-aware)."""
+        return (self.n_head + 2 * self.n_kv_head) * self.head_dim
+
     def num_params(self):
         wpe = 0 if self.use_rotary else self.max_seq_len * self.d_model
-        per_block = (4 * self.d_model * self.d_model          # qkv + proj
+        per_block = (self.d_model * (self.qkv_dim + self.d_model)  # qkv + proj
                      + (3 if self.use_swiglu else 2) * self.d_model * self.d_ff
                      + 4 * self.d_model)                       # norms/biases approx
-        return self.vocab_size * self.d_model + wpe + self.n_layer * per_block
+        emb = self.vocab_size * self.d_model
+        head = 0 if self.tie_embeddings else self.vocab_size * self.d_model
+        return emb + head + wpe + self.n_layer * per_block
 
 
 # Reference model sizes used in the baseline ladder (BASELINE.md).
@@ -101,11 +113,12 @@ def init_gpt_params(cfg: GPTConfig, seed: int = 0, dtype=jnp.float32):
         return jnp.ones(shape, dtype)
 
     proj_scale = 0.02 / math.sqrt(2 * L)  # GPT-2 residual-proj init
+    QKV = cfg.qkv_dim
     block = {
         "ln1_scale": ones(L, D),
         "ln2_scale": ones(L, D),
-        "attn_qkv_w": norm(L, D, 3 * D),
-        "attn_qkv_b": zeros(L, 3 * D),
+        "attn_qkv_w": norm(L, D, QKV),
+        "attn_qkv_b": zeros(L, QKV),
         "attn_out_w": jnp.asarray(rng.normal(0.0, proj_scale, (L, D, D)), dtype),
         "attn_out_b": zeros(L, D),
         "mlp_out_b": zeros(L, D),
@@ -191,12 +204,12 @@ def _norm(x, scale, bias, use_rms, eps=1e-5):
     return (xf * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
 
 
-def _rope(x, positions, rotary_dims):
+def _rope(x, positions, rotary_dims, theta=10000.0):
     """Rotary position embedding over the first `rotary_dims` of the head dim.
     x: [B, T, H, hd]; positions: [B, T]."""
     hd = x.shape[-1]
     rd = rotary_dims
-    freqs = 1.0 / (10000.0**(jnp.arange(0, rd, 2, dtype=jnp.float32) / rd))
+    freqs = 1.0 / (theta**(jnp.arange(0, rd, 2, dtype=jnp.float32) / rd))
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,rd/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -210,36 +223,49 @@ def _rope(x, positions, rotary_dims):
 
 
 def _attention(q, k, v, causal_mask, cfg, attn_fn=None):
-    """q,k,v: [B, T, H, hd] → [B, T, H, hd]. fp32 softmax."""
+    """q: [B, T, H, hd]; k,v: [B, S, Hkv, hd] → [B, T, H, hd]. fp32 softmax.
+
+    GQA (Hkv < H): query heads are grouped per kv head and contracted without
+    materializing repeated k/v (reference serves GQA models like llama2-70b via
+    `module_inject/containers/llama2.py`)."""
     if attn_fn is not None:
+        if k.shape[2] != q.shape[2]:  # external kernels expect matched heads
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         return attn_fn(q, k, v)
     scale = 1.0 / math.sqrt(q.shape[-1])
-    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
-    logits = jnp.where(causal_mask, logits, -1e30)
+    B, T, H, hd = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv  # grouped einsum; G == 1 is plain MHA
+    qg = q.reshape(B, T, Hkv, G, hd)
+    logits = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale
+    logits = jnp.where(causal_mask[:, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhts,bshd->bthd", probs, v)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
+    return out.reshape(B, T, H, hd)
 
 
 def _block(x, p, cfg: GPTConfig, positions, dropout_rng=None, attn_fn=None):
     """One transformer block. x: [B, T, D]."""
     B, T, D = x.shape
-    H, hd = cfg.n_head, cfg.head_dim
+    H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
     use_rms = cfg.use_rmsnorm
 
     h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), use_rms)
     qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
     q = q.reshape(B, T, H, hd)
-    k = k.reshape(B, T, H, hd)
-    v = v.reshape(B, T, H, hd)
+    k = k.reshape(B, T, Hkv, hd)
+    v = v.reshape(B, T, Hkv, hd)
     # activations: heads on tensor axis (Megatron), seq on sequence axis
     q = shard_constraint(q, DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, None)
     k = shard_constraint(k, DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, None)
     v = shard_constraint(v, DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, None)
     if cfg.use_rotary:
         rd = int(cfg.rotary_pct * hd) // 2 * 2
-        q = _rope(q, positions, rd)
-        k = _rope(k, positions, rd)
+        q = _rope(q, positions, rd, cfg.rope_theta)
+        k = _rope(k, positions, rd, cfg.rope_theta)
     causal = jnp.tril(jnp.ones((T, T), bool))[None, None, :, :]
     attn = _attention(q, k, v, causal, cfg, attn_fn=attn_fn)
     attn = attn.reshape(B, T, D)
@@ -321,7 +347,7 @@ def make_gpt_model(cfg: GPTConfig = None, name="gpt2-125m", seed=0, attn_fn=None
 def init_kv_cache(cfg: GPTConfig, batch_size, max_len, dtype=jnp.bfloat16):
     """[L, B, max_len, H, hd] stacked cache (reference: InferenceContext workspace,
     `csrc/transformer/inference/includes/inference_context.h:49`)."""
-    shape = (cfg.n_layer, batch_size, max_len, cfg.n_head, cfg.head_dim)
+    shape = (cfg.n_layer, batch_size, max_len, cfg.n_kv_head, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
             "length": jnp.zeros((batch_size,), jnp.int32)}
 
@@ -330,20 +356,20 @@ def _block_decode(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
     """Single-token decode for one block. x: [B, 1, D]; cache_[kv]: [B, M, H, hd];
     pos: [B] current position."""
     B, _, D = x.shape
-    H, hd = cfg.n_head, cfg.head_dim
+    H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
     M = cache_k.shape[1]
     use_rms = cfg.use_rmsnorm
 
     h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), use_rms)
     qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
     q = q.reshape(B, 1, H, hd)
-    k = k.reshape(B, 1, H, hd)
-    v = v.reshape(B, 1, H, hd)
+    k = k.reshape(B, 1, Hkv, hd)
+    v = v.reshape(B, 1, Hkv, hd)
     if cfg.use_rotary:
         rd = int(cfg.rotary_pct * hd) // 2 * 2
-        q = _rope(q, pos[:, None], rd)
-        k = _rope(k, pos[:, None], rd)
+        q = _rope(q, pos[:, None], rd, cfg.rope_theta)
+        k = _rope(k, pos[:, None], rd, cfg.rope_theta)
 
     # scatter k,v at pos
     onehot = jax.nn.one_hot(pos, M, dtype=k.dtype)            # [B, M]
@@ -351,11 +377,13 @@ def _block_decode(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
     cache_v = cache_v * (1 - onehot)[..., None, None] + onehot[..., None, None] * v
 
     scale = 1.0 / math.sqrt(hd)
-    logits = jnp.einsum("bohd,bmhd->bhom", q, cache_k).astype(jnp.float32) * scale
-    valid = (jnp.arange(M)[None, :] <= pos[:, None])[:, None, None, :]
-    logits = jnp.where(valid, logits, -1e30)
+    valid = (jnp.arange(M)[None, :] <= pos[:, None])          # [B, M]
+    G = H // Hkv  # grouped einsum; G == 1 is plain MHA
+    qg = q.reshape(B, 1, Hkv, G, hd)
+    logits = jnp.einsum("bokgd,bmkd->bkgom", qg, cache_k).astype(jnp.float32) * scale
+    logits = jnp.where(valid[:, None, None, None, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    attn = jnp.einsum("bhom,bmhd->bohd", probs, cache_v).reshape(B, 1, D)
+    attn = jnp.einsum("bkgom,bmkd->bokgd", probs, cache_v).reshape(B, 1, D)
     x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
 
     h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms)
@@ -386,15 +414,15 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
             p, ck, cv = inputs
             h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm)
             qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-            H, hd = cfg.n_head, cfg.head_dim
-            k = k.reshape(B, T, H, hd)
-            v = v.reshape(B, T, H, hd)
+            H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+            q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
             q = q.reshape(B, T, H, hd)
+            k = k.reshape(B, T, Hkv, hd)
+            v = v.reshape(B, T, Hkv, hd)
             if cfg.use_rotary:
                 rd = int(cfg.rotary_pct * hd) // 2 * 2
-                q = _rope(q, positions, rd)
-                k = _rope(k, positions, rd)
+                q = _rope(q, positions, rd, cfg.rope_theta)
+                k = _rope(k, positions, rd, cfg.rope_theta)
             ck = ck.at[:, :T].set(k.astype(ck.dtype))
             cv = cv.at[:, :T].set(v.astype(cv.dtype))
             causal = jnp.tril(jnp.ones((T, T), bool))[None, None]

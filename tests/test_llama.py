@@ -1,0 +1,111 @@
+"""LLaMA family / GQA tests.
+
+Reference parity target: `module_inject/containers/llama.py` / `llama2.py` serve
+rotary+SwiGLU+RMSNorm models with grouped-query attention; here both training and
+decode paths are covered natively.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.config.core import MeshConfig
+from deepspeed_tpu.models.gpt import (GPTConfig, init_gpt_params, gpt_forward,
+                                      make_gpt_decode_model)
+from deepspeed_tpu.models.llama import LLAMA_CONFIGS, llama_config, make_llama_model
+
+TINY = llama_config(n_layer=2, n_head=4, n_kv_head=2, d_model=64, d_ff=128,
+                    max_seq_len=128, vocab_size=256, dtype=jnp.float32, remat=False)
+
+
+def _mk_mesh(**axes):
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    return mesh_mod.init_mesh(MeshConfig(**{**dict(data=1, tensor=1, sequence=1,
+                                                   expert=1, pipe=1), **axes}))
+
+
+def _expand_gqa_params(params, cfg: GPTConfig):
+    """Repeat each kv head G times inside the fused qkv weight → MHA-equivalent."""
+    H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    G = H // Hkv
+    qkv_w = params["blocks"]["attn_qkv_w"]          # [L, D, (H+2Hkv)*hd]
+    qkv_b = params["blocks"]["attn_qkv_b"]
+    L, D, _ = qkv_w.shape
+
+    def expand(w, axis):
+        q, k, v = jnp.split(w, [H * hd, (H + Hkv) * hd], axis=axis)
+        k = k.reshape(*k.shape[:-1], Hkv, hd)
+        v = v.reshape(*v.shape[:-1], Hkv, hd)
+        k = jnp.repeat(k, G, axis=-2).reshape(*k.shape[:-2], H * hd)
+        v = jnp.repeat(v, G, axis=-2).reshape(*v.shape[:-2], H * hd)
+        return jnp.concatenate([q, k, v], axis=axis)
+
+    out = jax.tree_util.tree_map(lambda x: x, params)
+    out["blocks"] = dict(params["blocks"])
+    out["blocks"]["attn_qkv_w"] = expand(qkv_w, -1)
+    out["blocks"]["attn_qkv_b"] = expand(qkv_b, -1)
+    return out
+
+
+def test_gqa_matches_expanded_mha():
+    _mk_mesh()
+    params = init_gpt_params(TINY, seed=0)
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, TINY.vocab_size, (2, 16)),
+                       jnp.int32)
+    out_gqa = gpt_forward(params, toks, TINY)
+
+    import dataclasses
+    mha_cfg = dataclasses.replace(TINY, n_kv_head=TINY.n_head)
+    out_mha = gpt_forward(_expand_gqa_params(params, TINY), toks, mha_cfg)
+    np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_mha),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_llama_tiny_trains():
+    _mk_mesh(data=2)
+    import deepspeed_tpu
+    model = make_llama_model(cfg=TINY, name="llama-tiny-test")
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"data": 2},
+        "steps_per_print": 1000,
+    })
+    rng = np.random.default_rng(0)
+    losses = []
+    batch = {"tokens": rng.integers(0, TINY.vocab_size,
+                                    (engine.train_batch_size(), 32)).astype(np.int32)}
+    for _ in range(5):
+        losses.append(float(engine.train_batch(batch)))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # memorizes a repeated batch
+
+
+def test_gqa_decode_matches_forward():
+    _mk_mesh()
+    from deepspeed_tpu.inference.engine import init_inference
+    spec = make_gpt_decode_model(cfg=TINY, name="tiny-gqa")
+    engine = init_inference(model=spec, config={"dtype": "float32",
+                                                "kv_cache_dtype": "float32",
+                                                "greedy": True})
+    toks = np.random.default_rng(1).integers(0, TINY.vocab_size, (2, 8)).astype(np.int32)
+    out = engine.generate(toks, max_new_tokens=4)
+
+    cur = jnp.asarray(toks)
+    ref = []
+    for _ in range(4):
+        logits = gpt_forward(spec.params, cur, TINY)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        ref.append(np.asarray(nxt))
+        cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out, np.stack(ref, axis=1))
+
+
+def test_llama_configs_param_counts():
+    # sanity: published sizes within 5%
+    assert abs(LLAMA_CONFIGS["llama2-7b"].num_params() / 6.74e9 - 1) < 0.05
+    assert abs(LLAMA_CONFIGS["llama3-8b"].num_params() / 8.03e9 - 1) < 0.05
