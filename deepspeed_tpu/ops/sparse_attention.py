@@ -116,6 +116,8 @@ class BSLongformerSparsityConfig(SparsityConfig):
         super().__init__(num_heads, block, different_layout_per_head)
         self.num_sliding = num_sliding_window_blocks
         self.global_idx = list(global_block_indices)
+        self.global_end = (list(global_block_end_indices)
+                           if global_block_end_indices is not None else None)
         self.attention = attention
 
     def make_layout(self, seq_len):
@@ -124,10 +126,7 @@ class BSLongformerSparsityConfig(SparsityConfig):
         for i in range(n):
             for j in range(max(0, i - w), min(n, i + w + 1)):
                 layout[:, i, j] = True
-        for g in self.global_idx:
-            if g < n:
-                layout[:, :, g] = True
-                layout[:, g, :] = True
+        _apply_globals(layout, n, self.global_idx, self.global_end, horizontal=True)
         if self.attention == "unidirectional":
             layout &= np.tril(np.ones((n, n), dtype=bool))[None]
         return layout
@@ -139,11 +138,17 @@ class VariableSparsityConfig(SparsityConfig):
     def __init__(self, num_heads, block=16, num_random_blocks=0,
                  local_window_blocks=(4,), global_block_indices=(0,),
                  global_block_end_indices=None, attention="bidirectional",
-                 horizontal_global_attention=False, different_layout_per_head=False):
+                 horizontal_global_attention=False, different_layout_per_head=False,
+                 seed=0):
         super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
         self.local_windows = list(local_window_blocks)
         self.global_idx = list(global_block_indices)
+        self.global_end = (list(global_block_end_indices)
+                           if global_block_end_indices is not None else None)
         self.attention = attention
+        self.horizontal_global = horizontal_global_attention
+        self.seed = seed
 
     def make_layout(self, seq_len):
         layout, n = self.setup_layout(seq_len)
@@ -155,13 +160,34 @@ class VariableSparsityConfig(SparsityConfig):
             layout[:, start:end, start:end] = True
             start = end
             wi += 1
-        for g in self.global_idx:
-            if g < n:
-                layout[:, :, g] = True
-                layout[:, g, :] = True
+        _apply_globals(layout, n, self.global_idx, self.global_end,
+                       horizontal=self.horizontal_global)
+        if self.num_random_blocks > 0:
+            rng = np.random.default_rng(self.seed)
+            heads = range(self.num_heads) if self.different_layout_per_head else [slice(None)]
+            for h in heads:
+                for i in range(n):
+                    for j in rng.choice(n, size=min(self.num_random_blocks, n),
+                                        replace=False):
+                        layout[h, i, j] = True
         if self.attention == "unidirectional":
             layout &= np.tril(np.ones((n, n), dtype=bool))[None]
         return layout
+
+
+def _apply_globals(layout, n, global_idx, global_end, horizontal):
+    """Global attention blocks: single indices, or ranges when end indices given
+    (reference `sparsity_config.py` global_block_end_indices semantics)."""
+    if global_end is not None:
+        cols = []
+        for s, e in zip(global_idx, global_end):
+            cols.extend(range(s, min(e, n)))
+    else:
+        cols = [g for g in global_idx if g < n]
+    for g in cols:
+        layout[:, :, g] = True
+        if horizontal:
+            layout[:, g, :] = True
 
 
 class SparseSelfAttention:
@@ -171,6 +197,7 @@ class SparseSelfAttention:
     def __init__(self, sparsity_config=None, softmax_scale=None, attn_mask_mode="mul"):
         self.config = sparsity_config or FixedSparsityConfig(num_heads=4)
         self.softmax_scale = softmax_scale
+        self.attn_mask_mode = attn_mask_mode
         self._layouts = {}
 
     def _mask(self, seq_len):
@@ -191,6 +218,16 @@ class SparseSelfAttention:
         if rpe is not None:
             s = s + rpe
         s = jnp.where(mask[None], s, -1e30)
+        if attn_mask is not None:
+            # reference attn_mask_mode: "mul" = boolean/0-1 keep mask, "add" =
+            # additive bias on scores. Mask broadcasts over [B?, T, T].
+            attn_mask = jnp.asarray(attn_mask)
+            while attn_mask.ndim < 4:
+                attn_mask = attn_mask[None]
+            if self.attn_mask_mode == "mul":
+                s = jnp.where(attn_mask != 0, s, -1e30)
+            else:
+                s = s + attn_mask.astype(s.dtype)
         if key_padding_mask is not None:
             s = jnp.where(key_padding_mask[:, None, None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)

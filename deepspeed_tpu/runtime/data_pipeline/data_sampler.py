@@ -25,7 +25,6 @@ class DeepSpeedDataSampler:
                           if curriculum_config else None)
         self.seed = seed
         self.global_step = 0
-        self.rng = np.random.default_rng(seed)
 
     def set_step(self, global_step):
         self.global_step = global_step
@@ -44,8 +43,11 @@ class DeepSpeedDataSampler:
 
     def next_indices(self):
         pool = self.candidate_pool()
-        idx = self.rng.choice(pool, size=self.batch_size,
-                              replace=len(pool) < self.batch_size)
+        # stateless draw keyed on (seed, global_step): checkpoint resume at step N
+        # continues the exact uninterrupted sequence
+        rng = np.random.default_rng((self.seed, self.global_step))
+        idx = rng.choice(pool, size=self.batch_size,
+                         replace=len(pool) < self.batch_size)
         self.global_step += 1
         if self.scheduler is not None:
             self.scheduler.update_difficulty(self.global_step)

@@ -71,15 +71,20 @@ class HybridEngine(Engine):
     def generate(self, tokens, max_new_tokens=32, greedy=True, temperature=1.0,
                  rng=None):
         """Rollout with the CURRENT training params (reference `generate` :174)."""
-        if self._generate_fn is None or self._gen_max != max_new_tokens:
+        key = (max_new_tokens, greedy, float(temperature))
+        if self._generate_fn is None or getattr(self, "_gen_key", None) != key:
             self._generate_fn = self._build_generate(max_new_tokens, greedy, temperature)
-            self._gen_max = max_new_tokens
+            self._gen_key = key
         tokens = jnp.asarray(tokens)
         B, T = tokens.shape
         cache = self._decode_spec.init_cache(B, T + max_new_tokens,
                                              self.compute_dtype)
         prompt_len = jnp.full((B,), T, jnp.int32)
-        rng = rng if rng is not None else jax.random.fold_in(self.state.rng, 17)
+        if rng is None:
+            # independent draws per call and per training step
+            rng = jax.random.fold_in(
+                jax.random.fold_in(self.state.rng, int(self.state.step)),
+                self.generate_count)
         self._gen_timer("generate").start()
         out = self._generate_fn(self.state.params, tokens, cache, prompt_len, rng)
         out = np.asarray(jax.device_get(out))
