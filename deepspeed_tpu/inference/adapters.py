@@ -1,0 +1,211 @@
+"""HF checkpoint adapters — the reference's injection-container role.
+
+Reference: `module_inject/containers/*` (gpt2.py, llama.py, llama2.py, opt.py…)
+map HuggingFace module trees onto fused inference blocks, transposing/fusing
+weights per architecture; `module_inject/load_checkpoint.py` does the state-dict
+walking. Here the same job is a pure weight-layout transform: HF state dict →
+our stacked-block pytree (models/gpt.py layout), after which the whole zoo
+(training engine, inference engine, TP specs, Pallas kernels) applies unchanged.
+
+Covered: GPT-2 (Conv1D [in,out] weights, learned positions, fused c_attn) and
+LLaMA 1/2/3 (Linear [out,in] weights → transpose; separate q/k/v → fused;
+HF "rotate-half" RoPE row order → interleaved, the inverse of the permutation in
+HF's `convert_llama_weights_to_hf.py`). Each adapter returns (GPTConfig, params)
+so callers can build either a training ModelSpec or a DecodeModelSpec.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt import GPTConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+def _t(x):
+    """torch tensor / numpy → numpy fp32."""
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().float().numpy()
+    return np.asarray(x, np.float32)
+
+
+def _state_dict(model_or_sd):
+    if hasattr(model_or_sd, "state_dict"):
+        return {k: _t(v) for k, v in model_or_sd.state_dict().items()}
+    return {k: _t(v) for k, v in model_or_sd.items()}
+
+
+def _stack(layers):
+    """list of per-layer dicts → stacked dict with leading layer dim."""
+    out = {}
+    for key in layers[0]:
+        out[key] = jnp.asarray(np.stack([l[key] for l in layers]))
+    return out
+
+
+# ----------------------------------------------------------------------
+# GPT-2
+# ----------------------------------------------------------------------
+
+
+def from_hf_gpt2(model_or_sd, hf_config=None, dtype=jnp.float32):
+    """GPT2LMHeadModel → (GPTConfig, params). Conv1D stores [in, out] — our
+    convention already; no transposes (reference container: `containers/gpt2.py`)."""
+    sd = _state_dict(model_or_sd)
+    if hf_config is None:
+        hf_config = getattr(model_or_sd, "config", None)
+    pre = "transformer." if "transformer.wte.weight" in sd else ""
+
+    n_layer = hf_config.n_layer if hf_config else \
+        1 + max(int(k.split(".")[1 if not pre else 2]) for k in sd if ".h." in "." + k)
+    cfg = GPTConfig(
+        vocab_size=sd[f"{pre}wte.weight"].shape[0],
+        n_layer=n_layer,
+        n_head=hf_config.n_head if hf_config else 12,
+        d_model=sd[f"{pre}wte.weight"].shape[1],
+        max_seq_len=sd[f"{pre}wpe.weight"].shape[0],
+        norm_eps=float(getattr(hf_config, "layer_norm_epsilon", 1e-5) or 1e-5),
+        use_rotary=False, use_swiglu=False, use_rmsnorm=False,
+        tie_embeddings=True, dtype=dtype, remat=False)
+
+    layers = []
+    for i in range(cfg.n_layer):
+        b = f"{pre}h.{i}."
+        layers.append({
+            "ln1_scale": sd[b + "ln_1.weight"],
+            "ln1_bias": sd[b + "ln_1.bias"],
+            "attn_qkv_w": sd[b + "attn.c_attn.weight"],     # [D, 3D], Conv1D
+            "attn_qkv_b": sd[b + "attn.c_attn.bias"],
+            "attn_out_w": sd[b + "attn.c_proj.weight"],
+            "attn_out_b": sd[b + "attn.c_proj.bias"],
+            "ln2_scale": sd[b + "ln_2.weight"],
+            "ln2_bias": sd[b + "ln_2.bias"],
+            "mlp_up_w": sd[b + "mlp.c_fc.weight"],
+            "mlp_up_b": sd[b + "mlp.c_fc.bias"],
+            "mlp_down_w": sd[b + "mlp.c_proj.weight"],
+            "mlp_out_b": sd[b + "mlp.c_proj.bias"],
+        })
+    params = {
+        "wte": jnp.asarray(sd[f"{pre}wte.weight"], dtype),
+        "wpe": jnp.asarray(sd[f"{pre}wpe.weight"], dtype),
+        "blocks": {k: v.astype(dtype) for k, v in _stack(layers).items()},
+        "lnf_scale": jnp.asarray(sd[f"{pre}ln_f.weight"], dtype),
+        "lnf_bias": jnp.asarray(sd[f"{pre}ln_f.bias"], dtype),
+    }
+    logger.info(f"adapted HF GPT-2: {cfg.n_layer}L d={cfg.d_model} vocab={cfg.vocab_size}")
+    return cfg, params
+
+
+# ----------------------------------------------------------------------
+# LLaMA
+# ----------------------------------------------------------------------
+
+
+def _unpermute_rope_rows(w, n_heads, head_dim):
+    """HF rotate-half row order → interleaved (Meta) order, per head.
+
+    HF's Meta→HF conversion applies, per head,
+    `w.view(d/2, 2, in).transpose(0, 1)` — evens first then odds. Invert it so
+    our interleaved `_rope` (models/gpt.py) sees the original pairing.
+    w: [n_heads*head_dim, in_dim] (torch Linear layout).
+    """
+    H, hd = n_heads, head_dim
+    w = w.reshape(H, 2, hd // 2, -1)        # [H, {evens,odds}, hd/2, in]
+    w = np.transpose(w, (0, 2, 1, 3))       # [H, hd/2, 2, in] → interleave
+    return w.reshape(H * hd, -1)
+
+
+def from_hf_llama(model_or_sd, hf_config=None, dtype=jnp.float32):
+    """LlamaForCausalLM → (GPTConfig, params). Transposes Linear [out,in]→[in,out],
+    fuses q/k/v, un-permutes RoPE rows (reference container: `containers/llama.py`)."""
+    sd = _state_dict(model_or_sd)
+    if hf_config is None:
+        hf_config = getattr(model_or_sd, "config", None)
+    assert hf_config is not None, "from_hf_llama needs the HF config (head counts)"
+
+    H = hf_config.num_attention_heads
+    Hkv = getattr(hf_config, "num_key_value_heads", H) or H
+    D = hf_config.hidden_size
+    hd = D // H
+    cfg = GPTConfig(
+        vocab_size=hf_config.vocab_size,
+        n_layer=hf_config.num_hidden_layers,
+        n_head=H, n_kv_head=Hkv, d_model=D,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 4096),
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-6)),
+        use_rotary=True, use_swiglu=True, use_rmsnorm=True,
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        dtype=dtype, remat=False)
+
+    layers = []
+    for i in range(cfg.n_layer):
+        b = f"model.layers.{i}."
+        q = _unpermute_rope_rows(sd[b + "self_attn.q_proj.weight"], H, hd)
+        k = _unpermute_rope_rows(sd[b + "self_attn.k_proj.weight"], Hkv, hd)
+        v = sd[b + "self_attn.v_proj.weight"]
+        qkv = np.concatenate([q, k, v], axis=0).T          # [D, (H+2Hkv)*hd]
+        layers.append({
+            "ln1_scale": sd[b + "input_layernorm.weight"],
+            "attn_qkv_w": qkv,
+            "attn_qkv_b": np.zeros(qkv.shape[1], np.float32),
+            "attn_out_w": sd[b + "self_attn.o_proj.weight"].T,
+            "attn_out_b": np.zeros(D, np.float32),
+            "ln2_scale": sd[b + "post_attention_layernorm.weight"],
+            "mlp_gate_w": sd[b + "mlp.gate_proj.weight"].T,
+            "mlp_up_w": sd[b + "mlp.up_proj.weight"].T,
+            "mlp_down_w": sd[b + "mlp.down_proj.weight"].T,
+            "mlp_out_b": np.zeros(D, np.float32),
+        })
+    params = {
+        "wte": jnp.asarray(sd["model.embed_tokens.weight"], dtype),
+        "blocks": {k: v.astype(dtype) for k, v in _stack(layers).items()},
+        "lnf_scale": jnp.asarray(sd["model.norm.weight"], dtype),
+    }
+    if not cfg.tie_embeddings:
+        head = sd.get("lm_head.weight", sd["model.embed_tokens.weight"])
+        params["lm_head"] = jnp.asarray(head, dtype)
+    logger.info(f"adapted HF LLaMA: {cfg.n_layer}L d={cfg.d_model} "
+                f"H={H}/{Hkv} vocab={cfg.vocab_size}")
+    return cfg, params
+
+
+# ----------------------------------------------------------------------
+# dispatch
+# ----------------------------------------------------------------------
+
+_ADAPTERS = {
+    "gpt2": from_hf_gpt2,
+    "llama": from_hf_llama,
+}
+
+
+def adapt_hf_model(model, dtype=jnp.float32):
+    """HF PreTrainedModel → (GPTConfig, params), dispatched on config.model_type
+    (reference: `replace_policy.py` policy matching)."""
+    mt = getattr(model.config, "model_type", None)
+    if mt not in _ADAPTERS:
+        raise NotImplementedError(
+            f"no adapter for model_type={mt!r}; available: {sorted(_ADAPTERS)}")
+    return _ADAPTERS[mt](model, model.config, dtype=dtype)
+
+
+def hf_decode_model(model, dtype=jnp.float32):
+    """HF model → DecodeModelSpec (inference engine input)."""
+    from deepspeed_tpu.models.gpt import make_gpt_decode_model
+    cfg, params = adapt_hf_model(model, dtype=dtype)
+    spec = make_gpt_decode_model(cfg=cfg, params=params,
+                                 name=getattr(model.config, "model_type", "hf"))
+    spec.eos_token_id = getattr(model.config, "eos_token_id", None)
+    return spec
+
+
+def hf_train_model(model, dtype=jnp.float32):
+    """HF model → training ModelSpec (continued pretraining / finetuning)."""
+    import dataclasses
+    from deepspeed_tpu.models.gpt import make_gpt_model
+    cfg, params = adapt_hf_model(model, dtype=dtype)
+    cfg = dataclasses.replace(cfg, remat=True, dtype=jnp.bfloat16)
+    spec = make_gpt_model(cfg=cfg, name=getattr(model.config, "model_type", "hf"))
+    spec.params = params
+    return spec

@@ -46,6 +46,7 @@ class GPTConfig:
     use_rotary: bool = False         # False: learned positions (GPT-2); True: RoPE
     rotary_pct: float = 1.0
     rope_theta: float = 10000.0      # RoPE base (LLaMA-3 uses 500000)
+    norm_eps: float = 1e-5           # LayerNorm/RMSNorm epsilon (HF LLaMA: 1e-6)
     use_swiglu: bool = False         # LLaMA-style gated MLP
     use_rmsnorm: bool = False        # LLaMA-style RMSNorm
     tie_embeddings: bool = True
@@ -252,7 +253,7 @@ def _block(x, p, cfg: GPTConfig, positions, dropout_rng=None, attn_fn=None):
     H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
     use_rms = cfg.use_rmsnorm
 
-    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), use_rms)
+    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), use_rms, cfg.norm_eps)
     qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
     q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
     q = q.reshape(B, T, H, hd)
@@ -271,7 +272,7 @@ def _block(x, p, cfg: GPTConfig, positions, dropout_rng=None, attn_fn=None):
     attn = attn.reshape(B, T, D)
     x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
 
-    h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms)
+    h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
     if cfg.use_swiglu:
         up = jax.nn.silu(h @ p["mlp_gate_w"]) * (h @ p["mlp_up_w"])
     else:
@@ -302,7 +303,7 @@ def gpt_forward(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None):
 
     x, _ = jax.lax.scan(scan_body, x, params["blocks"])
 
-    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm)
+    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm, cfg.norm_eps)
     head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
     logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
     return logits
@@ -360,7 +361,7 @@ def _block_decode(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
     M = cache_k.shape[1]
     use_rms = cfg.use_rmsnorm
 
-    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), use_rms)
+    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), use_rms, cfg.norm_eps)
     qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
     q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
     q = q.reshape(B, 1, H, hd)
@@ -386,7 +387,7 @@ def _block_decode(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
     attn = jnp.einsum("bkgom,bmkd->bokgd", probs, cache_v).reshape(B, 1, D)
     x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
 
-    h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms)
+    h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
     if cfg.use_swiglu:
         up = jax.nn.silu(h @ p["mlp_gate_w"]) * (h @ p["mlp_up_w"])
     else:
@@ -412,7 +413,7 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
 
         def body(x, inputs):
             p, ck, cv = inputs
-            h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm)
+            h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm, cfg.norm_eps)
             qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
             H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
             q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
@@ -428,7 +429,7 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
             causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
             attn = _attention(q, k, v, causal, cfg).reshape(B, T, cfg.d_model)
             x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
-            h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.use_rmsnorm)
+            h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.use_rmsnorm, cfg.norm_eps)
             if cfg.use_swiglu:
                 up = jax.nn.silu(h @ p["mlp_gate_w"]) * (h @ p["mlp_up_w"])
             else:
@@ -439,7 +440,7 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
         x, (ks, vs) = jax.lax.scan(
             lambda c, inp: body(c, inp), x,
             (params["blocks"], cache["k"], cache["v"]))
-        x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm)
+        x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm, cfg.norm_eps)
         head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
         logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
         cache = {"k": ks, "v": vs, "length": jnp.full((B,), T, jnp.int32)}
@@ -457,7 +458,7 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
             return x, (ck, cv)
 
         x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
-        x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm)
+        x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm, cfg.norm_eps)
         head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
         logits = jnp.einsum("bod,vd->bov", x, head.astype(x.dtype))[:, 0]
         cache = {"k": ks, "v": vs, "length": cache["length"] + 1}
