@@ -65,13 +65,28 @@ class InferenceEngine:
         else:
             shardings = jax.tree_util.tree_map(
                 lambda _: NamedSharding(self.mesh, P()), model.params)
-        self.params = jax.device_put(tree_cast(model.params, dtype), shardings)
+        params = jax.device_put(tree_cast(model.params, dtype), shardings)
 
-        self._prefill = jax.jit(model.prefill_fn)
-        self._decode = jax.jit(model.decode_fn, donate_argnums=(3,))
+        if config.quant.enabled:
+            # weight-only quantization: HBM keeps int8/int4, XLA fuses dequant
+            # into consumers (inference/quantization.py)
+            from deepspeed_tpu.inference.quantization import (quantize_param_tree,
+                                                              wrap_fn_dequant)
+            params, self.quant_stats = quantize_param_tree(
+                params, bits=config.quant.bits, group_size=config.quant.group_size)
+            self._fn_transform = wrap_fn_dequant
+        else:
+            self.quant_stats = None
+            self._fn_transform = lambda fn: fn
+        self.params = params
+
+        self._prefill = jax.jit(self._fn_transform(model.prefill_fn))
+        self._decode = jax.jit(self._fn_transform(model.decode_fn), donate_argnums=(3,))
         self._generate_jit = None
         log_dist(f"inference engine: {model.name} dtype={dtype} "
-                 f"tp={config.tensor_parallel.tp_size}", ranks=[0])
+                 f"tp={config.tensor_parallel.tp_size} "
+                 f"quant={'int%d' % config.quant.bits if config.quant.enabled else 'off'}",
+                 ranks=[0])
 
     def forward(self, tokens, cache=None, pad_mask=None):
         """Prefill forward (logits for a full sequence)."""
@@ -85,8 +100,8 @@ class InferenceEngine:
     __call__ = forward
 
     def _build_generate(self):
-        decode_fn = self.model_spec.decode_fn
-        prefill_fn = self.model_spec.prefill_fn
+        decode_fn = self._fn_transform(self.model_spec.decode_fn)
+        prefill_fn = self._fn_transform(self.model_spec.prefill_fn)
         greedy = self.config.greedy
         temperature = self.config.temperature
         top_k = self.config.top_k

@@ -346,19 +346,20 @@ def make_gpt_model(cfg: GPTConfig = None, name="gpt2-125m", seed=0, attn_fn=None
 
 
 def init_kv_cache(cfg: GPTConfig, batch_size, max_len, dtype=jnp.bfloat16):
-    """[L, B, max_len, H, hd] stacked cache (reference: InferenceContext workspace,
-    `csrc/transformer/inference/includes/inference_context.h:49`)."""
-    shape = (cfg.n_layer, batch_size, max_len, cfg.n_kv_head, cfg.head_dim)
+    """[L, B, Hkv, max_len, hd] stacked cache (reference: InferenceContext
+    workspace, `csrc/transformer/inference/includes/inference_context.h:49`).
+    Head-major layout so the decode kernel streams one head's K/V contiguously."""
+    shape = (cfg.n_layer, batch_size, cfg.n_kv_head, max_len, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
             "length": jnp.zeros((batch_size,), jnp.int32)}
 
 
 def _block_decode(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
-    """Single-token decode for one block. x: [B, 1, D]; cache_[kv]: [B, M, H, hd];
+    """Single-token decode for one block. x: [B, 1, D]; cache_[kv]: [B, Hkv, M, hd];
     pos: [B] current position."""
     B, _, D = x.shape
     H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
-    M = cache_k.shape[1]
+    M = cache_k.shape[2]
     use_rms = cfg.use_rmsnorm
 
     h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), use_rms, cfg.norm_eps)
@@ -372,19 +373,25 @@ def _block_decode(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
         q = _rope(q, pos[:, None], rd, cfg.rope_theta)
         k = _rope(k, pos[:, None], rd, cfg.rope_theta)
 
-    # scatter k,v at pos
+    # scatter k,v at pos (head-major cache)
     onehot = jax.nn.one_hot(pos, M, dtype=k.dtype)            # [B, M]
-    cache_k = cache_k * (1 - onehot)[..., None, None] + onehot[..., None, None] * k
-    cache_v = cache_v * (1 - onehot)[..., None, None] + onehot[..., None, None] * v
+    k_new = jnp.moveaxis(k, 1, 2)                             # [B, Hkv, 1, hd]
+    v_new = jnp.moveaxis(v, 1, 2)
+    cache_k = cache_k * (1 - onehot)[:, None, :, None] + onehot[:, None, :, None] * k_new
+    cache_v = cache_v * (1 - onehot)[:, None, :, None] + onehot[:, None, :, None] * v_new
 
-    scale = 1.0 / math.sqrt(hd)
-    valid = (jnp.arange(M)[None, :] <= pos[:, None])          # [B, M]
-    G = H // Hkv  # grouped einsum; G == 1 is plain MHA
-    qg = q.reshape(B, 1, Hkv, G, hd)
-    logits = jnp.einsum("bokgd,bmkd->bkgom", qg, cache_k).astype(jnp.float32) * scale
-    logits = jnp.where(valid[:, None, None, None, :], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    attn = jnp.einsum("bkgom,bmkd->bokgd", probs, cache_v).reshape(B, 1, D)
+    if cfg.use_flash_attention:
+        from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+        attn = decode_attention(q[:, 0], cache_k, cache_v, pos).reshape(B, 1, D)
+    else:
+        scale = 1.0 / math.sqrt(hd)
+        valid = (jnp.arange(M)[None, :] <= pos[:, None])      # [B, M]
+        G = H // Hkv  # grouped einsum; G == 1 is plain MHA
+        qg = q.reshape(B, Hkv, G, hd)
+        logits = jnp.einsum("bkgd,bkmd->bkgm", qg, cache_k).astype(jnp.float32) * scale
+        logits = jnp.where(valid[:, None, None, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bkgm,bkmd->bkgd", probs, cache_v).reshape(B, 1, D)
     x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
 
     h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
@@ -424,8 +431,8 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
                 rd = int(cfg.rotary_pct * hd) // 2 * 2
                 q = _rope(q, positions, rd, cfg.rope_theta)
                 k = _rope(k, positions, rd, cfg.rope_theta)
-            ck = ck.at[:, :T].set(k.astype(ck.dtype))
-            cv = cv.at[:, :T].set(v.astype(cv.dtype))
+            ck = ck.at[:, :, :T].set(jnp.moveaxis(k, 1, 2).astype(ck.dtype))
+            cv = cv.at[:, :, :T].set(jnp.moveaxis(v, 1, 2).astype(cv.dtype))
             causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
             attn = _attention(q, k, v, causal, cfg).reshape(B, T, cfg.d_model)
             x = x + attn @ p["attn_out_w"] + p["attn_out_b"]

@@ -1,0 +1,119 @@
+"""Single-token KV-cache attention (Pallas) — the decode hot op.
+
+Analog of the reference's `softmax_context` CUDA kernel
+(`csrc/transformer/inference/csrc/pt_binding.cpp`, softmax.cu — fused
+KV-cache attention with alibi/rope handled upstream). Decode attention is
+HBM-bandwidth bound: each step streams the whole K/V cache once. This kernel
+keeps the online-softmax accumulator in VMEM, reads K/V in blocks, masks by the
+current sequence position, and supports GQA by attending one kv head's group of
+query heads per grid cell.
+
+Layout: q [B, H, hd]; k/v cache [B, Hkv, M, hd]; pos [B] (current position,
+inclusive — the new token's k/v must already be scattered at pos).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _use_interpret():
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale, block_m):
+    # q_ref: [1, 1, G, hd]; k_ref/v_ref: [1, 1, M, hd]; pos_ref: SMEM [B]
+    b = pl.program_id(0)
+    pos = pos_ref[b]
+    G, hd = q_ref.shape[2:]
+    M = k_ref.shape[2]
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
+
+    nblocks = pl.cdiv(pos + 1, block_m)  # only blocks intersecting [0, pos]
+
+    def body(j, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[0, 0, pl.ds(j * block_m, block_m), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * block_m, block_m), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [G, bm]
+        k_pos = j * block_m + jax.lax.broadcasted_iota(jnp.int32, (G, block_m), 1)
+        s = jnp.where(k_pos <= pos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((G, hd), jnp.float32)
+    m0 = jnp.full((G,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((G,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nblocks, body, (acc0, m0, l0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def decode_attention(q, k, v, pos, sm_scale=None, block_m=128, interpret=None):
+    """q: [B, H, hd]; k,v: [B, Hkv, M, hd]; pos: [B] int32 → [B, H, hd].
+
+    Attends each query head to cache positions 0..pos inclusive. GQA-aware:
+    H must be a multiple of Hkv; the group of G=H//Hkv query heads rides one
+    grid cell with its kv head.
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    B, H, hd = q.shape
+    _, Hkv, M, _ = k.shape
+    assert H % Hkv == 0
+    G = H // Hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(hd)
+    block_m = min(block_m, M)
+    if M % block_m != 0:  # pad cache length to block multiple (masked anyway)
+        pad = block_m - M % block_m
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        M += pad
+
+    qg = q.reshape(B, Hkv, G, hd)
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=sm_scale, block_m=block_m),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, Hkv),
+            in_specs=[
+                pl.BlockSpec((1, 1, G, hd), lambda b, h, pos_ref: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, M, hd), lambda b, h, pos_ref: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, M, hd), lambda b, h, pos_ref: (b, h, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, G, hd), lambda b, h, pos_ref: (b, h, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype),
+        interpret=interpret,
+    )(pos.astype(jnp.int32), qg, k, v)
+    return out.reshape(B, H, hd)
+
+
+def decode_attention_reference(q, k, v, pos, sm_scale=None):
+    """jnp reference (numerics oracle for tests)."""
+    B, H, hd = q.shape
+    _, Hkv, M, _ = k.shape
+    G = H // Hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(hd)
+    qg = q.reshape(B, Hkv, G, hd)
+    s = jnp.einsum("bkgd,bkmd->bkgm", qg.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    valid = (jnp.arange(M)[None, :] <= pos[:, None])[:, None, None, :]
+    s = jnp.where(valid, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgm,bkmd->bkgd", p, v.astype(jnp.float32))
+    return out.reshape(B, H, hd).astype(q.dtype)
