@@ -28,14 +28,22 @@ from deepspeed_tpu.utils.logging import logger
 # Canonical axis names, outermost first.
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+ZERO_INNER_AXIS = "zero"     # inner factor of the data domain (MiCS/hpZ sub-groups)
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "sequence"
 TENSOR_AXIS = "tensor"
 
-ALL_AXES: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS)
+ALL_AXES: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, ZERO_INNER_AXIS, EXPERT_AXIS,
+                             SEQ_AXIS, TENSOR_AXIS)
 
-# ZeRO partitions over data×sequence (see module docstring).
-ZERO_AXES: Tuple[str, ...] = (DATA_AXIS, SEQ_AXIS)
+# ZeRO partitions over data×zero×sequence (see module docstring). The `zero`
+# axis is 1 unless MiCS (`mics_shard_size`) or hpZ (`zero_hpz_partition_size`)
+# confine (part of) the sharding to an inner sub-group that rides ICI
+# (reference: `zero/mics.py:55` sub-group sharding, `zero/config.py:256` hpZ).
+ZERO_AXES: Tuple[str, ...] = (DATA_AXIS, ZERO_INNER_AXIS, SEQ_AXIS)
+
+# Batch dims of activations shard over the full data domain.
+BATCH_AXES: Tuple[str, ...] = (DATA_AXIS, ZERO_INNER_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,18 +51,21 @@ class MeshSpec:
     """Resolved logical topology (analog of PipelineParallelGrid, `topology.py:251`)."""
     pipe: int = 1
     data: int = 1
+    zero: int = 1
     expert: int = 1
     sequence: int = 1
     tensor: int = 1
 
     @property
     def world_size(self):
-        return self.pipe * self.data * self.expert * self.sequence * self.tensor
+        return (self.pipe * self.data * self.zero * self.expert * self.sequence
+                * self.tensor)
 
     def axis_sizes(self):
         return {
             PIPE_AXIS: self.pipe,
             DATA_AXIS: self.data,
+            ZERO_INNER_AXIS: self.zero,
             EXPERT_AXIS: self.expert,
             SEQ_AXIS: self.sequence,
             TENSOR_AXIS: self.tensor,
@@ -67,6 +78,7 @@ class MeshSpec:
         sizes = {
             "pipe": mesh_config.pipe,
             "data": mesh_config.data,
+            "zero": getattr(mesh_config, "zero", 1),
             "expert": mesh_config.expert,
             "sequence": mesh_config.sequence,
             "tensor": mesh_config.tensor,
@@ -89,7 +101,8 @@ def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     assert len(devices) == spec.world_size, (
         f"need {spec.world_size} devices for {spec}, have {len(devices)}")
-    arr = np.asarray(devices).reshape(spec.pipe, spec.data, spec.expert, spec.sequence, spec.tensor)
+    arr = np.asarray(devices).reshape(spec.pipe, spec.data, spec.zero,
+                                      spec.expert, spec.sequence, spec.tensor)
     return Mesh(arr, ALL_AXES)
 
 
@@ -109,6 +122,7 @@ def set_mesh(mesh: Mesh, spec: Optional[MeshSpec] = None):
         spec = MeshSpec(
             pipe=sizes.get(PIPE_AXIS, 1),
             data=sizes.get(DATA_AXIS, 1),
+            zero=sizes.get(ZERO_INNER_AXIS, 1),
             expert=sizes.get(EXPERT_AXIS, 1),
             sequence=sizes.get(SEQ_AXIS, 1),
             tensor=sizes.get(TENSOR_AXIS, 1),

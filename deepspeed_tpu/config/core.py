@@ -203,6 +203,7 @@ class MeshConfig(ConfigModel):
     Axis order is outer→inner = DCN→ICI friendly: pipe, data, expert, sequence, tensor.
     """
     data: int = -1
+    zero: int = 1     # inner factor of the data domain (MiCS/hpZ sub-group size)
     tensor: int = 1
     pipe: int = 1
     sequence: int = 1

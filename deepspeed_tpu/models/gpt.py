@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.comm.mesh import DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, shard_constraint
+from deepspeed_tpu.comm.mesh import BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, shard_constraint
 from deepspeed_tpu.runtime.engine import ModelSpec
 
 
@@ -260,9 +260,9 @@ def _block(x, p, cfg: GPTConfig, positions, dropout_rng=None, attn_fn=None):
     k = k.reshape(B, T, Hkv, hd)
     v = v.reshape(B, T, Hkv, hd)
     # activations: heads on tensor axis (Megatron), seq on sequence axis
-    q = shard_constraint(q, DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, None)
-    k = shard_constraint(k, DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, None)
-    v = shard_constraint(v, DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, None)
+    q = shard_constraint(q, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
+    k = shard_constraint(k, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
+    v = shard_constraint(v, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
     if cfg.use_rotary:
         rd = int(cfg.rotary_pct * hd) // 2 * 2
         q = _rope(q, positions, rd, cfg.rope_theta)
@@ -277,9 +277,9 @@ def _block(x, p, cfg: GPTConfig, positions, dropout_rng=None, attn_fn=None):
         up = jax.nn.silu(h @ p["mlp_gate_w"]) * (h @ p["mlp_up_w"])
     else:
         up = jax.nn.gelu(h @ p["mlp_up_w"] + p["mlp_up_b"])
-    up = shard_constraint(up, DATA_AXIS, SEQ_AXIS, TENSOR_AXIS)
+    up = shard_constraint(up, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS)
     x = x + up @ p["mlp_down_w"] + p["mlp_out_b"]
-    return shard_constraint(x, DATA_AXIS, SEQ_AXIS, None)
+    return shard_constraint(x, BATCH_AXES, SEQ_AXIS, None)
 
 
 def gpt_forward(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None):
@@ -291,7 +291,7 @@ def gpt_forward(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None):
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
     if not cfg.use_rotary:
         x = x + jnp.take(params["wpe"], positions, axis=0).astype(dtype)
-    x = shard_constraint(x, DATA_AXIS, SEQ_AXIS, None)
+    x = shard_constraint(x, BATCH_AXES, SEQ_AXIS, None)
 
     block_fn = partial(_block, cfg=cfg, positions=positions, attn_fn=attn_fn)
     if cfg.remat:

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.comm.mesh import DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS, shard_constraint
+from deepspeed_tpu.comm.mesh import EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS, shard_constraint
 
 
 def _capacity(num_tokens, num_experts, capacity_factor, min_capacity):

@@ -30,7 +30,7 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm import mesh as mesh_mod
-from deepspeed_tpu.comm.mesh import DATA_AXIS, PIPE_AXIS, SEQ_AXIS
+from deepspeed_tpu.comm.mesh import BATCH_AXES, DATA_AXIS, PIPE_AXIS, SEQ_AXIS, ZERO_INNER_AXIS
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -167,7 +167,7 @@ def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
         loss = total / jnp.maximum(count, 1)
         # mean over the data domain so grads of pipe-replicated leaves come out as
         # global-batch means
-        return jax.lax.pmean(loss, (DATA_AXIS, SEQ_AXIS))
+        return jax.lax.pmean(loss, (DATA_AXIS, ZERO_INNER_AXIS, SEQ_AXIS))
 
     def loss_fn(params, batch, rng):
         mesh = mesh_mod.get_mesh()
@@ -178,7 +178,7 @@ def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
             "head": jax.tree_util.tree_map(lambda _: P(), params["head"]),
         }
         # batch stays data-sharded on its leading dim (composes PP × DP)
-        batch_spec = jax.tree_util.tree_map(lambda _: P(DATA_AXIS), batch)
+        batch_spec = jax.tree_util.tree_map(lambda _: P(BATCH_AXES), batch)
         with mesh_mod.constraints_disabled():
             fn = shard_map(local, mesh=mesh,
                            in_specs=(param_specs, batch_spec, P()),

@@ -22,7 +22,7 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm import mesh as mesh_mod
-from deepspeed_tpu.comm.mesh import DATA_AXIS, SEQ_AXIS, TENSOR_AXIS
+from deepspeed_tpu.comm.mesh import BATCH_AXES, SEQ_AXIS, TENSOR_AXIS
 
 NEG_INF = -1e30
 
@@ -90,7 +90,7 @@ def ring_attention(q, k, v, causal=True, sm_scale=None, axis_name=SEQ_AXIS, mesh
         m, l, o = _block_attn_partial(q, k, v, 0, 0, causal, sm_scale)
         return (o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
-    spec = P(DATA_AXIS, axis_name, TENSOR_AXIS, None)
+    spec = P(BATCH_AXES, axis_name, TENSOR_AXIS, None)
     fn = shard_map(
         partial(_ring_attention_local, axis_name=axis_name, sp=sp, causal=causal,
                 sm_scale=sm_scale),

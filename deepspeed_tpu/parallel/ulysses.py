@@ -20,7 +20,7 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm import mesh as mesh_mod
-from deepspeed_tpu.comm.mesh import DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, shard_constraint
+from deepspeed_tpu.comm.mesh import BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, shard_constraint
 
 
 def ulysses_attention(attn_fn):
@@ -30,12 +30,12 @@ def ulysses_attention(attn_fn):
     def wrapped(q, k, v, *args, **kwargs):
         # incoming: sequence-sharded on T (and possibly TP-sharded on H)
         # before attention: all heads local per (sequence,tensor) shard of H; full T
-        q = shard_constraint(q, DATA_AXIS, None, (SEQ_AXIS, TENSOR_AXIS), None)
-        k = shard_constraint(k, DATA_AXIS, None, (SEQ_AXIS, TENSOR_AXIS), None)
-        v = shard_constraint(v, DATA_AXIS, None, (SEQ_AXIS, TENSOR_AXIS), None)
+        q = shard_constraint(q, BATCH_AXES, None, (SEQ_AXIS, TENSOR_AXIS), None)
+        k = shard_constraint(k, BATCH_AXES, None, (SEQ_AXIS, TENSOR_AXIS), None)
+        v = shard_constraint(v, BATCH_AXES, None, (SEQ_AXIS, TENSOR_AXIS), None)
         out = attn_fn(q, k, v, *args, **kwargs)
         # back to sequence-sharded layout
-        return shard_constraint(out, DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, None)
+        return shard_constraint(out, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
 
     return wrapped
 
@@ -69,7 +69,7 @@ def ulysses_shard_map_attention(attn_fn, mesh=None):
     then trades back."""
     mesh = mesh or mesh_mod.get_mesh()
 
-    spec = P(DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, None)
+    spec = P(BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
 
     def local(q, k, v):
         # local shapes: [b, t/sp, h/tp, hd]

@@ -107,12 +107,13 @@ class Engine:
         if mesh is not None:
             mesh_mod.set_mesh(mesh)
         elif not mesh_mod.has_mesh():
+            self._factor_zero_subgroup(config)
             comm.init_distributed(mesh_config=config.mesh)
         self.mesh = mesh_mod.get_mesh()
         self.spec = mesh_mod.get_spec()
 
-        # ---- batch triad over the data axis (reference config.py batch arithmetic)
-        self.dp_world_size = self.spec.data
+        # ---- batch triad over the data domain (reference config.py batch arithmetic)
+        self.dp_world_size = self.spec.data * self.spec.zero
         (self.train_batch_size_value, self.micro_batch_size,
          self.gradient_accumulation_steps_value) = config.resolve_batch_sizes(self.dp_world_size)
 
@@ -208,6 +209,25 @@ class Engine:
 
         # flops profiler (lazy)
         self._flops_profiler = None
+
+    @staticmethod
+    def _factor_zero_subgroup(config):
+        """MiCS/hpZ: factor the data axis into data × zero so params shard over an
+        inner sub-group that rides ICI (reference `zero/mics.py:55` sub-group
+        sharding; `zero/config.py:256` hpZ secondary partition size)."""
+        zcfg = config.zero_optimization
+        sub = 0
+        if zcfg.mics_shard_size and zcfg.mics_shard_size > 0:
+            sub = zcfg.mics_shard_size
+        elif zcfg.zero_hpz_partition_size and zcfg.zero_hpz_partition_size > 1:
+            sub = zcfg.zero_hpz_partition_size
+        if sub > 1 and config.mesh.zero == 1:
+            config.mesh.zero = sub
+            if config.mesh.data != -1:
+                assert config.mesh.data % sub == 0, (
+                    f"data axis {config.mesh.data} not divisible by "
+                    f"MiCS/hpZ sub-group size {sub}")
+                config.mesh.data //= sub
 
     # ------------------------------------------------------------------
     # state construction
@@ -389,9 +409,87 @@ class Engine:
 
         return apply_grads
 
+    def _quantized_micro_grad_fn(self):
+        """ZeRO++ explicit-collective micro step (qwZ/qgZ).
+
+        The standard step lets XLA insert bf16/f32 collectives from sharding
+        constraints; quantized collectives must be explicit, so this variant runs
+        the micro-grad inside `shard_map` over the data domain: params arrive as
+        their ZeRO-3 shards and are (optionally) gathered over an int8 wire
+        (qwZ, reference `partition_parameters.py:668`), grads leave through the
+        2-hop int8 all-to-all reduce (qgZ, `coalesced_collectives.py:31`).
+        Supported on pure data-parallel meshes (tensor/sequence/pipe/expert = 1),
+        matching the reference's DP-only scope for these features.
+        """
+        from jax import shard_map
+        from deepspeed_tpu.runtime import quantized_collectives as qc
+
+        zcfg = self.config.zero_optimization
+        qw = bool(zcfg.zero_quantized_weights) and self.zero_stage == 3
+        qg = bool(zcfg.zero_quantized_gradients)
+        sizes = self.spec.axis_sizes()
+        for ax in (mesh_mod.TENSOR_AXIS, mesh_mod.SEQ_AXIS, mesh_mod.PIPE_AXIS,
+                   mesh_mod.EXPERT_AXIS):
+            assert sizes[ax] == 1, (
+                "zero_quantized_weights/gradients need a pure data-parallel mesh "
+                f"(axis {ax} has size {sizes[ax]})")
+        axes = tuple(a for a in (mesh_mod.DATA_AXIS, mesh_mod.ZERO_INNER_AXIS)
+                     if sizes[a] > 1) or (mesh_mod.DATA_AXIS,)
+        micro_grad = self._micro_grad_fn()
+        group_size = 256
+
+        param_specs = jax.tree_util.tree_map(lambda s: s.spec, self.param_shardings)
+
+        def gather_dim(spec):
+            for i, e in enumerate(spec):
+                if e is not None:
+                    return i
+            return None
+
+        def body(params, micro_batch, rng, scale_state):
+            if qw:
+                def gather(p, spec):
+                    d = gather_dim(spec)
+                    if d is None:
+                        return p
+                    return qc.quantized_all_gather_dim(p, axes, d, group_size)
+                params = jax.tree_util.tree_map(gather, params, param_specs)
+            with mesh_mod.constraints_disabled():
+                grads, loss = micro_grad(params, micro_batch, rng, scale_state)
+            n = 1
+            for a in axes:
+                n *= sizes[a]
+            if qg:
+                # qgZ sums over the domain; grad semantics here are mean
+                grads = jax.tree_util.tree_map(
+                    lambda g: qc.qgz_allreduce(g.astype(jnp.float32), axes,
+                                               group_size) / n, grads)
+            else:
+                grads = jax.lax.pmean(grads, axes)
+            loss = jax.lax.pmean(loss, axes)
+            return grads, loss
+
+        def qmicro(params, micro_batch, rng, scale_state):
+            in_batch_specs = jax.tree_util.tree_map(
+                lambda _: P(mesh_mod.BATCH_AXES), micro_batch)
+            return shard_map(
+                body, mesh=self.mesh,
+                in_specs=(param_specs, in_batch_specs, P(),
+                          jax.tree_util.tree_map(lambda _: P(), scale_state)),
+                out_specs=(jax.tree_util.tree_map(lambda _: P(), params), P()),
+                check_vma=False,
+            )(params, micro_batch, rng, scale_state)
+
+        return qmicro
+
     def _build_train_step(self):
         gas = self.gradient_accumulation_steps_value
-        micro_grad = self._micro_grad_fn()
+        zcfg = self.config.zero_optimization
+        if zcfg.zero_quantized_gradients or (zcfg.zero_quantized_weights
+                                             and self.zero_stage == 3):
+            micro_grad = self._quantized_micro_grad_fn()
+        else:
+            micro_grad = self._micro_grad_fn()
         apply_grads = self._apply_grads_fn()
         grad_shardings = self._grad_shardings()
         predivide = self.config.gradient_predivide_factor or 1.0
@@ -509,7 +607,7 @@ class Engine:
     # ------------------------------------------------------------------
 
     def _batch_sharding(self, for_scan):
-        lead = (None, mesh_mod.DATA_AXIS) if for_scan else (mesh_mod.DATA_AXIS,)
+        lead = (None, mesh_mod.BATCH_AXES) if for_scan else (mesh_mod.BATCH_AXES,)
         return NamedSharding(self.mesh, P(*lead))
 
     def _shard_batch(self, batch, for_scan):

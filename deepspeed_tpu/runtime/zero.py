@@ -102,19 +102,39 @@ class ZeroShardingPolicy:
         self.config = zero_config
         self.mesh = mesh
         self.stage = zero_config.stage
+        self.mics = bool(zero_config.mics_shard_size and zero_config.mics_shard_size > 0)
+        self.hpz = int(getattr(zero_config, "zero_hpz_partition_size", 1) or 1)
         self.domain = self.partition_domain()
         self.domain_size = _axis_size(mesh, self.domain)
+        self.param_domain = self.param_partition_domain()
+        self.param_domain_size = _axis_size(mesh, self.param_domain)
         self.persistence_threshold = (zero_config.stage3_param_persistence_threshold
                                       if self.stage == 3 else 0)
 
     def partition_domain(self):
-        """Mesh axes forming the ZeRO partition domain.
+        """Mesh axes forming the ZeRO state partition domain.
 
-        MiCS (`mics_shard_size`) confines sharding to a sub-group: on TPU that is
-        naturally the innermost slice of the data domain — we express it by noting
-        the desired size; XLA's hierarchical collectives over ICI handle locality.
+        MiCS (`mics_shard_size`, reference `zero/mics.py:55`) confines ALL sharding
+        (params, grads, optimizer states) to the inner `zero` sub-axis — the
+        sub-group rides adjacent ICI neighbors; XLA reduces within the group
+        (reduce-scatter over `zero`) and replicates across groups (all-reduce over
+        `data`), the MiCS hierarchical communication pattern.
         """
+        if self.mics:
+            return (mesh_mod.ZERO_INNER_AXIS,)
         return mesh_mod.ZERO_AXES
+
+    def param_partition_domain(self):
+        """Axes over which stage-3 *parameters* shard.
+
+        hpZ (ZeRO++ secondary partition, `zero/config.py:256`): optimizer states
+        shard over the full domain, but the bf16 params gather from a secondary
+        copy sharded only within the `zero` sub-group (one node) — forward/backward
+        all-gathers ride ICI, never DCN.
+        """
+        if self.stage == 3 and self.hpz > 1 and not self.mics:
+            return (mesh_mod.ZERO_INNER_AXIS,)
+        return self.domain
 
     # ---- params ----
 
@@ -123,7 +143,8 @@ class ZeroShardingPolicy:
             base = tuple(base_spec) if base_spec is not None else ()
             base = base + (None,) * (len(shape) - len(base))
             return P(*base)
-        return shard_leaf_spec(shape, base_spec, self.domain, self.domain_size,
+        return shard_leaf_spec(shape, base_spec, self.param_domain,
+                               self.param_domain_size,
                                min_size=self.persistence_threshold)
 
     def param_shardings(self, params, param_specs=None):
