@@ -171,12 +171,84 @@ def from_hf_llama(model_or_sd, hf_config=None, dtype=jnp.float32):
 
 
 # ----------------------------------------------------------------------
+# BERT
+# ----------------------------------------------------------------------
+
+
+def from_hf_bert(model_or_sd, hf_config=None, dtype=jnp.float32):
+    """BertForMaskedLM → (BertConfig, params) for models/bert.py
+    (reference container: `containers/bert.py`). Linear [out,in] → transpose;
+    q/k/v fused; post-LN layout."""
+    from deepspeed_tpu.models.bert import BertConfig
+    sd = _state_dict(model_or_sd)
+    if hf_config is None:
+        hf_config = getattr(model_or_sd, "config", None)
+    assert hf_config is not None
+
+    D = hf_config.hidden_size
+    cfg = BertConfig(
+        vocab_size=hf_config.vocab_size,
+        n_layer=hf_config.num_hidden_layers,
+        n_head=hf_config.num_attention_heads,
+        d_model=D,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        type_vocab_size=hf_config.type_vocab_size,
+        norm_eps=float(hf_config.layer_norm_eps),
+        pre_layer_norm=False, dtype=dtype, remat=False)
+
+    layers = []
+    for i in range(cfg.n_layer):
+        b = f"bert.encoder.layer.{i}."
+        q = sd[b + "attention.self.query.weight"]
+        k = sd[b + "attention.self.key.weight"]
+        v = sd[b + "attention.self.value.weight"]
+        qb = sd[b + "attention.self.query.bias"]
+        kb = sd[b + "attention.self.key.bias"]
+        vb = sd[b + "attention.self.value.bias"]
+        layers.append({
+            "attn_qkv_w": np.concatenate([q, k, v], axis=0).T,
+            "attn_qkv_b": np.concatenate([qb, kb, vb]),
+            "attn_out_w": sd[b + "attention.output.dense.weight"].T,
+            "attn_out_b": sd[b + "attention.output.dense.bias"],
+            "ln1_scale": sd[b + "attention.output.LayerNorm.weight"],
+            "ln1_bias": sd[b + "attention.output.LayerNorm.bias"],
+            "mlp_up_w": sd[b + "intermediate.dense.weight"].T,
+            "mlp_up_b": sd[b + "intermediate.dense.bias"],
+            "mlp_down_w": sd[b + "output.dense.weight"].T,
+            "mlp_down_b": sd[b + "output.dense.bias"],
+            "ln2_scale": sd[b + "output.LayerNorm.weight"],
+            "ln2_bias": sd[b + "output.LayerNorm.bias"],
+        })
+    V = cfg.vocab_size
+    params = {
+        "word_emb": jnp.asarray(sd["bert.embeddings.word_embeddings.weight"], dtype),
+        "pos_emb": jnp.asarray(sd["bert.embeddings.position_embeddings.weight"], dtype),
+        "type_emb": jnp.asarray(sd["bert.embeddings.token_type_embeddings.weight"], dtype),
+        "emb_ln_scale": jnp.asarray(sd["bert.embeddings.LayerNorm.weight"], dtype),
+        "emb_ln_bias": jnp.asarray(sd["bert.embeddings.LayerNorm.bias"], dtype),
+        "blocks": {k2: v2.astype(dtype) for k2, v2 in _stack(layers).items()},
+        "mlm_dense_w": jnp.asarray(sd["cls.predictions.transform.dense.weight"].T, dtype),
+        "mlm_dense_b": jnp.asarray(sd["cls.predictions.transform.dense.bias"], dtype),
+        "mlm_ln_scale": jnp.asarray(sd["cls.predictions.transform.LayerNorm.weight"], dtype),
+        "mlm_ln_bias": jnp.asarray(sd["cls.predictions.transform.LayerNorm.bias"], dtype),
+        "mlm_bias": jnp.asarray(sd.get("cls.predictions.bias", np.zeros(V)), dtype),
+        "pooler_w": jnp.asarray(sd.get("bert.pooler.dense.weight",
+                                       np.zeros((D, D))).T, dtype),
+        "pooler_b": jnp.asarray(sd.get("bert.pooler.dense.bias", np.zeros(D)), dtype),
+    }
+    logger.info(f"adapted HF BERT: {cfg.n_layer}L d={cfg.d_model} vocab={V}")
+    return cfg, params
+
+
+# ----------------------------------------------------------------------
 # dispatch
 # ----------------------------------------------------------------------
 
 _ADAPTERS = {
     "gpt2": from_hf_gpt2,
     "llama": from_hf_llama,
+    "bert": from_hf_bert,
 }
 
 
@@ -191,8 +263,10 @@ def adapt_hf_model(model, dtype=jnp.float32):
 
 
 def hf_decode_model(model, dtype=jnp.float32):
-    """HF model → DecodeModelSpec (inference engine input)."""
+    """HF model → DecodeModelSpec (inference engine input, causal LMs only)."""
     from deepspeed_tpu.models.gpt import make_gpt_decode_model
+    mt = getattr(model.config, "model_type", None)
+    assert mt != "bert", "BERT is an encoder — use hf_train_model / bert_encode"
     cfg, params = adapt_hf_model(model, dtype=dtype)
     spec = make_gpt_decode_model(cfg=cfg, params=params,
                                  name=getattr(model.config, "model_type", "hf"))
@@ -203,9 +277,18 @@ def hf_decode_model(model, dtype=jnp.float32):
 def hf_train_model(model, dtype=jnp.float32):
     """HF model → training ModelSpec (continued pretraining / finetuning)."""
     import dataclasses
-    from deepspeed_tpu.models.gpt import make_gpt_model
+    from functools import partial
+    mt = getattr(model.config, "model_type", "hf")
     cfg, params = adapt_hf_model(model, dtype=dtype)
     cfg = dataclasses.replace(cfg, remat=True, dtype=jnp.bfloat16)
-    spec = make_gpt_model(cfg=cfg, name=getattr(model.config, "model_type", "hf"))
+    if mt == "bert":
+        from deepspeed_tpu.models.bert import (bert_param_specs, bert_mlm_loss,
+                                               bert_encode)
+        from deepspeed_tpu.runtime.engine import ModelSpec
+        return ModelSpec(loss_fn=partial(bert_mlm_loss, cfg=cfg), params=params,
+                         param_specs=bert_param_specs(cfg),
+                         apply_fn=partial(bert_encode, cfg=cfg), name=mt)
+    from deepspeed_tpu.models.gpt import make_gpt_model
+    spec = make_gpt_model(cfg=cfg, name=mt)
     spec.params = params
     return spec

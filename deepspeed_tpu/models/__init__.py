@@ -12,3 +12,9 @@ from deepspeed_tpu.models.llama import (
     make_llama_model,
     make_llama_decode_model,
 )
+from deepspeed_tpu.models.bert import (
+    BertConfig,
+    BERT_CONFIGS,
+    make_bert_model,
+    bert_encode,
+)

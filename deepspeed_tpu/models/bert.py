@@ -1,0 +1,266 @@
+"""BERT encoder family — MLM pretraining + classification heads, TPU-first.
+
+Reference surface: the fused BERT training kernels
+(`csrc/transformer/ds_transformer_cuda.cpp`, frontend `DeepSpeedTransformerLayer`
+`deepspeed/ops/transformer/transformer.py:296`) behind the "fastest BERT
+pretraining" claim (`docs/_posts/2020-05-28-fastest-bert-training.md`), the BERT
+injection containers (`module_inject/containers/bert.py`, `distil_bert.py`), and
+the BingBertSquad model test (`tests/model/BingBertSquad`).
+
+TPU realization mirrors models/gpt.py: stacked blocks + `lax.scan`, bf16 with
+fp32 norm/softmax accumulation, remat per block, Megatron TP PartitionSpecs,
+batch on the data domain. Supports post-LN (original BERT) and pre-LN
+(`DeepSpeedTransformerConfig.pre_layer_norm`) residual placement.
+"""
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, shard_constraint
+from deepspeed_tpu.runtime.engine import ModelSpec
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30528          # padded to 64 multiple (MXU-friendly)
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: Optional[int] = None       # default 4*d_model
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    pre_layer_norm: bool = False     # reference DeepSpeedTransformerConfig knob
+    remat: bool = True
+    dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.d_ff is None:
+            self.d_ff = 4 * self.d_model
+        assert self.d_model % self.n_head == 0
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_head
+
+
+BERT_CONFIGS = {
+    "bert-tiny": BertConfig(n_layer=2, n_head=4, d_model=128, max_seq_len=128,
+                            vocab_size=1024),
+    "bert-base": BertConfig(n_layer=12, n_head=12, d_model=768),
+    "bert-large": BertConfig(n_layer=24, n_head=16, d_model=1024),
+}
+
+
+def init_bert_params(cfg: BertConfig, seed: int = 0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    D, F, L = cfg.d_model, cfg.d_ff, cfg.n_layer
+
+    def norm(*shape, scale=0.02):
+        return jnp.asarray(rng.normal(0.0, scale, shape), dtype)
+
+    def zeros(*shape):
+        return jnp.zeros(shape, dtype)
+
+    def ones(*shape):
+        return jnp.ones(shape, dtype)
+
+    block = {
+        "attn_qkv_w": norm(L, D, 3 * D),
+        "attn_qkv_b": zeros(L, 3 * D),
+        "attn_out_w": norm(L, D, D),
+        "attn_out_b": zeros(L, D),
+        "ln1_scale": ones(L, D),
+        "ln1_bias": zeros(L, D),
+        "mlp_up_w": norm(L, D, F),
+        "mlp_up_b": zeros(L, F),
+        "mlp_down_w": norm(L, F, D),
+        "mlp_down_b": zeros(L, D),
+        "ln2_scale": ones(L, D),
+        "ln2_bias": zeros(L, D),
+    }
+    params = {
+        "word_emb": norm(cfg.vocab_size, D),
+        "pos_emb": norm(cfg.max_seq_len, D),
+        "type_emb": norm(cfg.type_vocab_size, D),
+        "emb_ln_scale": ones(D),
+        "emb_ln_bias": zeros(D),
+        "blocks": block,
+        # MLM head: dense + LN + decoder (tied to word_emb) + bias
+        "mlm_dense_w": norm(D, D),
+        "mlm_dense_b": zeros(D),
+        "mlm_ln_scale": ones(D),
+        "mlm_ln_bias": zeros(D),
+        "mlm_bias": zeros(cfg.vocab_size),
+        # pooler (CLS) for classification/NSP
+        "pooler_w": norm(D, D),
+        "pooler_b": zeros(D),
+    }
+    return params
+
+
+def bert_param_specs(cfg: BertConfig):
+    """Megatron TP specs (column qkv/up, row out/down), like gpt_param_specs."""
+    t = TENSOR_AXIS
+    block = {
+        "attn_qkv_w": P(None, None, t),
+        "attn_qkv_b": P(None, t),
+        "attn_out_w": P(None, t, None),
+        "attn_out_b": P(None, None),
+        "ln1_scale": P(None, None),
+        "ln1_bias": P(None, None),
+        "mlp_up_w": P(None, None, t),
+        "mlp_up_b": P(None, t),
+        "mlp_down_w": P(None, t, None),
+        "mlp_down_b": P(None, None),
+        "ln2_scale": P(None, None),
+        "ln2_bias": P(None, None),
+    }
+    return {
+        "word_emb": P(t, None),
+        "pos_emb": P(None, None),
+        "type_emb": P(None, None),
+        "emb_ln_scale": P(None), "emb_ln_bias": P(None),
+        "blocks": block,
+        "mlm_dense_w": P(None, None), "mlm_dense_b": P(None),
+        "mlm_ln_scale": P(None), "mlm_ln_bias": P(None),
+        "mlm_bias": P(t),
+        "pooler_w": P(None, None), "pooler_b": P(None),
+    }
+
+
+def _ln(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    xf = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (xf * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _bert_block(x, p, mask_bias, cfg: BertConfig):
+    """x: [B, T, D]; mask_bias: [B, 1, 1, T] additive (-inf on padding)."""
+    B, T, D = x.shape
+    H, hd = cfg.n_head, cfg.head_dim
+    eps = cfg.norm_eps
+
+    def attend(h):
+        qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = shard_constraint(q.reshape(B, T, H, hd), BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
+        k = shard_constraint(k.reshape(B, T, H, hd), BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
+        v = shard_constraint(v.reshape(B, T, H, hd), BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
+        s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) / math.sqrt(hd)
+        s = s + mask_bias
+        probs = jax.nn.softmax(s, axis=-1).astype(h.dtype)
+        attn = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T, D)
+        return attn @ p["attn_out_w"] + p["attn_out_b"]
+
+    def mlp(h):
+        up = jax.nn.gelu(h @ p["mlp_up_w"] + p["mlp_up_b"], approximate=False)
+        up = shard_constraint(up, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS)
+        return up @ p["mlp_down_w"] + p["mlp_down_b"]
+
+    if cfg.pre_layer_norm:
+        x = x + attend(_ln(x, p["ln1_scale"], p["ln1_bias"], eps))
+        x = x + mlp(_ln(x, p["ln2_scale"], p["ln2_bias"], eps))
+    else:  # post-LN (original BERT)
+        x = _ln(x + attend(x), p["ln1_scale"], p["ln1_bias"], eps)
+        x = _ln(x + mlp(x), p["ln2_scale"], p["ln2_bias"], eps)
+    return shard_constraint(x, BATCH_AXES, SEQ_AXIS, None)
+
+
+def bert_encode(params, input_ids, cfg: BertConfig, token_type_ids=None,
+                attention_mask=None):
+    """→ sequence output [B, T, D]."""
+    B, T = input_ids.shape
+    dtype = cfg.dtype
+    x = jnp.take(params["word_emb"], input_ids, axis=0)
+    x = x + jnp.take(params["pos_emb"], jnp.arange(T), axis=0)[None]
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(input_ids)
+    x = x + jnp.take(params["type_emb"], token_type_ids, axis=0)
+    x = _ln(x.astype(dtype), params["emb_ln_scale"], params["emb_ln_bias"],
+            cfg.norm_eps)
+    x = shard_constraint(x, BATCH_AXES, SEQ_AXIS, None)
+
+    if attention_mask is None:
+        mask_bias = jnp.zeros((B, 1, 1, T), jnp.float32)
+    else:
+        mask_bias = jnp.where(attention_mask[:, None, None, :] != 0, 0.0, -1e30) \
+            .astype(jnp.float32)
+
+    block_fn = partial(_bert_block, mask_bias=mask_bias, cfg=cfg)
+    if cfg.remat:
+        block_fn = jax.checkpoint(block_fn)
+
+    def body(x, layer_params):
+        return block_fn(x, layer_params), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    return x
+
+
+def bert_mlm_logits(params, seq_out, cfg: BertConfig):
+    h = seq_out @ params["mlm_dense_w"] + params["mlm_dense_b"]
+    h = _ln(jax.nn.gelu(h, approximate=False), params["mlm_ln_scale"], params["mlm_ln_bias"],
+            cfg.norm_eps)
+    return jnp.einsum("btd,vd->btv", h, params["word_emb"].astype(h.dtype)) \
+        + params["mlm_bias"]
+
+
+def bert_pooled(params, seq_out):
+    """CLS-token pooled output (tanh dense)."""
+    return jnp.tanh(seq_out[:, 0] @ params["pooler_w"] + params["pooler_b"])
+
+
+def bert_mlm_loss(params, batch, rng, cfg: BertConfig):
+    """batch: input_ids [B,T], labels [B,T] with -100 = unmasked (HF convention),
+    optional token_type_ids / attention_mask."""
+    seq = bert_encode(params, batch["input_ids"], cfg,
+                      token_type_ids=batch.get("token_type_ids"),
+                      attention_mask=batch.get("attention_mask"))
+    logits = bert_mlm_logits(params, seq, cfg).astype(jnp.float32)
+    labels = batch["labels"]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    safe = jnp.maximum(labels, 0)
+    gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    mask = (labels >= 0).astype(jnp.float32)
+    return ((logz - gold) * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def bert_cls_loss(params, batch, rng, cfg: BertConfig, num_classes=2):
+    """Sequence classification on the pooled CLS (BingBertSquad-style head)."""
+    seq = bert_encode(params, batch["input_ids"], cfg,
+                      token_type_ids=batch.get("token_type_ids"),
+                      attention_mask=batch.get("attention_mask"))
+    pooled = bert_pooled(params, seq)
+    logits = (pooled @ params["cls_w"] + params["cls_b"]).astype(jnp.float32)
+    labels = batch["labels"]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return (logz - gold).mean()
+
+
+def make_bert_model(cfg: BertConfig = None, name="bert-base", seed=0,
+                    task="mlm", num_classes=2) -> ModelSpec:
+    cfg = cfg or BERT_CONFIGS[name]
+    params = init_bert_params(cfg, seed=seed)
+    specs = bert_param_specs(cfg)
+    if task == "cls":
+        rng = np.random.default_rng(seed + 1)
+        params["cls_w"] = jnp.asarray(rng.normal(0, 0.02, (cfg.d_model, num_classes)),
+                                      jnp.float32)
+        params["cls_b"] = jnp.zeros((num_classes,), jnp.float32)
+        specs = {**specs, "cls_w": P(None, None), "cls_b": P(None)}
+        loss = partial(bert_cls_loss, cfg=cfg, num_classes=num_classes)
+    else:
+        loss = partial(bert_mlm_loss, cfg=cfg)
+    return ModelSpec(loss_fn=loss, params=params, param_specs=specs,
+                     apply_fn=partial(bert_encode, cfg=cfg), name=name)
