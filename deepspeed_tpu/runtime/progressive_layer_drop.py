@@ -1,0 +1,56 @@
+"""Progressive Layer Drop (PLD).
+
+Reference: `runtime/progressive_layer_drop.py` — keep-probability schedule
+theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar feeding stochastic
+layer skipping during BERT-style pretraining.
+
+TPU-native use: `theta(step)` is a host-side scalar passed into the jitted step;
+the model consumes it via a per-layer bernoulli mask folded into the `lax.scan`
+over blocks (static shapes — the drop multiplies the residual branch by 0/1 and
+rescales, never changing the graph).
+"""
+
+import numpy as np
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta=0.5, gamma=0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step):
+        def _prob(x, g, t):
+            return (1.0 - t) * np.exp(-g * x) + t
+
+        self.current_theta = float(_prob(global_step, self.gamma, self.theta))
+
+    # reference name parity
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+
+def pld_block_scan(block_fn, x, stacked_params, theta, rng):
+    """Scan over layers with stochastic depth at keep-prob theta.
+
+    Per layer i: keep ~ Bernoulli(theta); output = x + keep/theta * f(x) — the
+    inverted-dropout rescale keeps expectations unchanged. `block_fn(x, p)` must
+    return the residual *delta* (not x + delta).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    keys = jax.random.split(rng, n_layers)
+
+    def body(carry, inp):
+        params_i, key = inp
+        keep = jax.random.bernoulli(key, theta).astype(carry.dtype)
+        delta = block_fn(carry, params_i)
+        return carry + delta * keep / jnp.maximum(theta, 1e-6), None
+
+    out, _ = jax.lax.scan(body, x, (stacked_params, keys))
+    return out

@@ -1,0 +1,59 @@
+"""Abstract ("meta") initialization and direct-to-sharded materialization.
+
+Reference: `OnDevice` (`deepspeed/utils/init_on_device.py`) constructs modules
+on the meta device (shapes only); `zero.Init` (`zero/partition_parameters.py:723`)
+partitions parameters *at construction* so the full model never materializes on
+one device.
+
+TPU-native: both collapse into two primitives —
+  * `abstract_init(init_fn, *args)` → pytree of jax.ShapeDtypeStruct via
+    `jax.eval_shape` (zero memory, the "meta device");
+  * `materialize_sharded(init_fn, shardings, *args)` → jit with out_shardings:
+    XLA materializes each parameter shard directly on its owner device, so a
+    model larger than one chip's HBM initializes without ever being gathered —
+    exactly zero.Init's contract, minus the module-patching machinery.
+"""
+
+import jax
+
+
+def abstract_init(init_fn, *args, **kwargs):
+    """Shapes/dtypes of `init_fn(*args)` without allocating (the meta device)."""
+    return jax.eval_shape(init_fn, *args, **kwargs)
+
+
+def materialize_sharded(init_fn, shardings, *args, **kwargs):
+    """Run `init_fn` with every output leaf placed per `shardings` at creation.
+
+    `shardings`: pytree of NamedSharding matching init_fn's output (e.g. from
+    ZeroShardingPolicy.param_shardings over abstract_init's result).
+    """
+    return jax.jit(init_fn, out_shardings=shardings)(*args, **kwargs)
+
+
+class OnDevice:
+    """Reference-shaped context manager.
+
+    with OnDevice(dtype=jnp.bfloat16, device="meta"):
+        shapes = builder()          # builder returns abstract shapes
+
+    On TPU the context itself needs no patching — it simply records the target
+    and exposes `.abstract` / `.materialize` for the two phases.
+    """
+
+    def __init__(self, dtype=None, device="meta", enabled=True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def abstract(self, init_fn, *args, **kwargs):
+        return abstract_init(init_fn, *args, **kwargs)
+
+    def materialize(self, init_fn, shardings, *args, **kwargs):
+        return materialize_sharded(init_fn, shardings, *args, **kwargs)
