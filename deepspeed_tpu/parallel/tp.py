@@ -109,25 +109,11 @@ def plan_tp_specs(params, tp_size: Optional[int] = None, overrides: Dict[str, P]
 
 
 def tiled_linear(x, w, b=None, splits=4):
-    """TiledLinear (`runtime/zero/tiling.py:32`): compute X @ W in column tiles to
-    cap peak activation memory; XLA keeps tiles in sequence."""
-    out_dim = w.shape[-1]
-    assert out_dim % splits == 0, f"out dim {out_dim} not divisible into {splits} tiles"
-    tiles = jnp.split(w, splits, axis=-1)
-    outs = [x @ t for t in tiles]
-    y = jnp.concatenate(outs, axis=-1)
-    if b is not None:
-        y = y + b
-    return y
+    """Compat alias for the canonical implementation in runtime/tiling.py
+    (`runtime/zero/tiling.py:32`)."""
+    from deepspeed_tpu.runtime.tiling import tiled_matmul
+    return tiled_matmul(x, w, b, out_splits=splits)
 
 
-class TiledLinear:
-    """Class-form parity wrapper over `tiled_linear`."""
-
-    def __init__(self, in_features, out_features, in_splits=1, out_splits=4, bias=True):
-        self.in_features = in_features
-        self.out_features = out_features
-        self.out_splits = out_splits
-
-    def __call__(self, params, x):
-        return tiled_linear(x, params["w"], params.get("b"), splits=self.out_splits)
+# Canonical class lives in runtime/tiling.py; re-exported here for parity.
+from deepspeed_tpu.runtime.tiling import TiledLinear  # noqa: E402

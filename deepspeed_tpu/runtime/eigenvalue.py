@@ -46,7 +46,7 @@ def power_iteration_hessian(loss_fn, params, batch, max_iter=100, tol=1e-2,
     leaves, treedef = jax.tree_util.tree_flatten(params)
     key = jax.random.PRNGKey(seed)
     keys = jax.random.split(key, len(leaves))
-    v0 = treedef.unflatten([jax.random.normal(k, l.shape, jnp.float32)
+    v0 = treedef.unflatten([jax.random.normal(k, l.shape, l.dtype)
                             for k, l in zip(keys, leaves)])
 
     def normalize(v):

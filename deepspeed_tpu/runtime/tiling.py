@@ -22,6 +22,8 @@ def tiled_matmul(x, w, b=None, out_splits=1, in_splits=1):
     """
     K, N = w.shape
     assert N % out_splits == 0 and K % in_splits == 0
+    assert in_splits == 1 or out_splits == 1, (
+        "tile one dimension at a time (combined K and N tiling is not supported)")
 
     if in_splits > 1:
         xt = jnp.stack(jnp.split(x, in_splits, axis=-1))       # [S, ..., K/S]

@@ -52,8 +52,18 @@ class OnDevice:
     def __exit__(self, *exc):
         return False
 
+    def _cast(self, fn):
+        if self.dtype is None:
+            return fn
+
+        def casted(*a, **kw):
+            from deepspeed_tpu.utils.tree import tree_cast
+            return tree_cast(fn(*a, **kw), self.dtype)
+
+        return casted
+
     def abstract(self, init_fn, *args, **kwargs):
-        return abstract_init(init_fn, *args, **kwargs)
+        return abstract_init(self._cast(init_fn), *args, **kwargs)
 
     def materialize(self, init_fn, shardings, *args, **kwargs):
-        return materialize_sharded(init_fn, shardings, *args, **kwargs)
+        return materialize_sharded(self._cast(init_fn), shardings, *args, **kwargs)

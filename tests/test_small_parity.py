@@ -107,3 +107,15 @@ def test_meta_init_and_sharded_materialize(devices8):
     # each device holds 1/8 of w1
     shard_shape = params["w1"].addressable_shards[0].data.shape
     assert shard_shape[0] == 512 // 8
+
+
+def test_eigenvalue_bf16_params():
+    """Regression: power iteration must work with bfloat16 params (TPU default)."""
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+    def loss_fn(p, batch):
+        return jnp.sum(p["x"].astype(jnp.float32) ** 2)
+
+    ev, _ = Eigenvalue(max_iter=50).compute_eigenvalue(
+        loss_fn, {"x": jnp.zeros(8, jnp.bfloat16)}, batch=None)
+    assert abs(float(ev) - 2.0) < 0.1  # Hessian = 2*I
