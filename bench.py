@@ -48,7 +48,13 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
 
+    import dataclasses
     cfg = GPT2_CONFIGS[model_name]
+    use_flash = os.environ.get("BENCH_FLASH", "0") == "1" and seq % 128 == 0
+    remat = os.environ.get("BENCH_REMAT", "1") == "1"
+    policy = os.environ.get("BENCH_REMAT_POLICY", "dots_with_no_batch_dims_saveable")
+    cfg = dataclasses.replace(cfg, use_flash_attention=use_flash, remat=remat,
+                              remat_policy=policy)
     model = make_gpt_model(cfg=cfg, name=model_name)
     n_chips = jax.device_count()
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
@@ -62,7 +68,8 @@ def main():
 
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size, (engine.train_batch_size(), seq + 1)).astype(np.int32)
-    b = {"tokens": tokens}
+    # explicit labels keep the model's T == seq (128-multiple → flash kernel path)
+    b = {"tokens": tokens[:, :-1], "labels": tokens[:, 1:]}
 
     for _ in range(warmup):
         loss = engine.train_batch(b)

@@ -229,6 +229,9 @@ def _attention(q, k, v, causal_mask, cfg, attn_fn=None):
     GQA (Hkv < H): query heads are grouped per kv head and contracted without
     materializing repeated k/v (reference serves GQA models like llama2-70b via
     `module_inject/containers/llama2.py`)."""
+    if attn_fn is None and cfg.use_flash_attention and q.shape[1] % 128 == 0:
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        attn_fn = partial(flash_attention, causal=True)
     if attn_fn is not None:
         if k.shape[2] != q.shape[2]:  # external kernels expect matched heads
             rep = q.shape[2] // k.shape[2]

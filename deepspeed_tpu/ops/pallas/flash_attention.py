@@ -35,7 +35,9 @@ def _use_interpret():
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_k):
-    # q_ref: [block_q, D]; k_ref/v_ref: [T, D]; o_ref: [block_q, D]; lse_ref: [block_q]
+    # q_ref: [block_q, D]; k_ref/v_ref: [T, D]; o_ref: [block_q, D];
+    # lse_ref: [T//block_q, block_q] (whole-array block; row qi written per program —
+    # TPU grid iterations run sequentially, so disjoint row writes are safe)
     qi = pl.program_id(1)
     block_q, D = q_ref.shape
     T = k_ref.shape[0]
@@ -74,7 +76,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
 
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[:, :] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[:] = (m + jnp.log(l_safe)).astype(jnp.float32)
+    lse_ref[qi, :] = (m + jnp.log(l_safe)).astype(jnp.float32)
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
@@ -95,15 +97,16 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
+            # blocked [Tb, bq] layout satisfies TPU (8,128) tiling via whole-array blocks
+            pl.BlockSpec((None, T // block_q, block_q), lambda bh, qi: (bh, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+            jax.ShapeDtypeStruct((BH, T // block_q, block_q), jnp.float32),
         ],
         interpret=interpret,
     )(q2, k2, v2)
-    return out.reshape(B, H, T, D), lse.reshape(B, H, T)
+    return out.reshape(B, H, T, D), lse
 
 
 # ----------------------------------------------------------------------
@@ -118,8 +121,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     T = k_ref.shape[0]
     q = q_ref[:, :].astype(jnp.float32) * sm_scale
     do = do_ref[:, :].astype(jnp.float32)
-    lse = lse_ref[:]
-    delta = delta_ref[:]
+    lse = lse_ref[qi, :]
+    delta = delta_ref[qi, :]
 
     nblocks = T // block_k
     nblocks_dyn = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, nblocks) \
@@ -160,8 +163,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dk, dv = carry
         q = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32) * sm_scale
         do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(i * block_q, block_q)]
-        delta = delta_ref[pl.ds(i * block_q, block_q)]
+        lse = lse_ref[i, :]
+        delta = delta_ref[i, :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [bq, bk]
         if causal:
@@ -194,8 +197,9 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
 
     q2, k2, v2 = (x.reshape(BH, T, D) for x in (q, k, v))
     do2 = do.reshape(BH, T, D)
-    lse2 = lse.reshape(BH, T)
-    delta2 = delta.reshape(BH, T)
+    Tb = T // block_q
+    lse2 = lse                                   # [BH, Tb, block_q] (blocked)
+    delta2 = delta.reshape(BH, Tb, block_q)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k),
@@ -205,8 +209,8 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
-            pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((None, Tb, block_q), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, Tb, block_q), lambda bh, qi: (bh, 0, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
@@ -221,8 +225,8 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((None, block_k, D), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((None, block_k, D), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((None, T, D), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((None, T), lambda bh, ki: (bh, 0)),
-            pl.BlockSpec((None, T), lambda bh, ki: (bh, 0)),
+            pl.BlockSpec((None, Tb, block_q), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((None, Tb, block_q), lambda bh, ki: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, D), lambda bh, ki: (bh, ki, 0)),
