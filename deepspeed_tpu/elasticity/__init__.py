@@ -2,4 +2,10 @@ from deepspeed_tpu.elasticity.elasticity import (
     compute_elastic_config,
     get_compatible_gpus,
     ElasticityError,
+    ElasticityIncompatibleWorldSize,
+)
+from deepspeed_tpu.elasticity.elastic_agent import (
+    ElasticAgent,
+    AgentSpec,
+    MembershipChanged,
 )

@@ -1,0 +1,96 @@
+"""Elastic agent — restart-on-membership-change supervision.
+
+Reference: `elasticity/elastic_agent.py:28` (`DSElasticAgent`, a torch-elastic
+agent subclass that restarts worker groups when the rendezvous membership
+changes, injecting DeepSpeed env).
+
+TPU analog: there is no torch-elastic; recovery is supervised restart. The agent
+runs a training callable (or subprocess) in a loop; when it exits with a
+membership-change/failure condition, the agent re-reads the resource view,
+validates the new world size against the elastic config
+(`compute_elastic_config`, elasticity.py), and restarts — resume comes from the
+latest (reshardable) checkpoint, which orbax restores onto whatever mesh now
+exists.
+"""
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from deepspeed_tpu.elasticity.elasticity import (compute_elastic_config,
+                                                 ElasticityIncompatibleWorldSize)
+from deepspeed_tpu.utils.logging import logger
+
+
+class MembershipChanged(Exception):
+    """Raised by a worker (or watcher) when the device/host membership changed."""
+
+
+@dataclass
+class AgentSpec:
+    """What the agent supervises.
+
+    `run_fn(world_size, micro_batch)` — the training entry; must resume from the
+    latest checkpoint itself (engine.load_checkpoint).
+    `world_size_fn()` — current resource view (e.g. len of reachable hosts ×
+    chips/host); re-queried before every (re)start.
+    """
+    run_fn: Callable[[int, int], None]
+    world_size_fn: Callable[[], int]
+    ds_config: dict
+    max_restarts: int = 100
+    restart_backoff_s: float = 5.0
+    on_restart: Optional[Callable[[int], None]] = None
+
+
+class ElasticAgent:
+    """Supervises one training job with elastic world-size revalidation."""
+
+    def __init__(self, spec: AgentSpec):
+        self.spec = spec
+        self.restarts = 0
+
+    def _admissible(self, world_size):
+        """(final_batch, micro_batch) for this world size, or raises."""
+        final_batch, _valid, micro = compute_elastic_config(
+            self.spec.ds_config, world_size=world_size, return_microbatch=True)
+        return final_batch, micro
+
+    def run(self):
+        """Run until clean exit or restart budget exhausted. Returns True on
+        clean completion."""
+        while True:
+            world = self.spec.world_size_fn()
+            try:
+                final_batch, micro = self._admissible(world)
+            except ElasticityIncompatibleWorldSize:
+                # wait for the resource view to move into the valid set
+                logger.warning(f"elastic agent: world size {world} inadmissible; "
+                               f"waiting {self.spec.restart_backoff_s}s")
+                if not self._consume_restart():
+                    return False
+                time.sleep(self.spec.restart_backoff_s)
+                continue
+
+            logger.info(f"elastic agent: starting run | world={world} "
+                        f"batch={final_batch} micro={micro} "
+                        f"restart #{self.restarts}")
+            try:
+                self.spec.run_fn(world, micro)
+                return True
+            except MembershipChanged as e:
+                logger.warning(f"elastic agent: membership changed ({e}); restarting")
+            except Exception as e:  # worker fault → restart from checkpoint
+                logger.warning(f"elastic agent: worker failed ({e!r}); restarting")
+            if not self._consume_restart():
+                return False
+            if self.spec.on_restart is not None:
+                self.spec.on_restart(self.restarts)
+            time.sleep(self.spec.restart_backoff_s)
+
+    def _consume_restart(self):
+        self.restarts += 1
+        if self.restarts > self.spec.max_restarts:
+            logger.error("elastic agent: restart budget exhausted")
+            return False
+        return True

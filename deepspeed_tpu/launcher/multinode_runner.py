@@ -1,0 +1,257 @@
+"""Multinode runner command builders.
+
+Reference: `launcher/multinode_runner.py:51-366` (PDSH/OpenMPI/MPICH/IMPI/SLURM/
+MVAPICH runners, each turning (args, resource pool) into the shell command that
+starts the per-node launcher).
+
+TPU launch model: ONE process per host drives all local chips, so every runner
+below emits one task per host running `python -m deepspeed_tpu.launcher.launch`
+with the node rank; rendezvous is `jax.distributed.initialize` against the
+coordinator (MASTER_ADDR:MASTER_PORT), carried by the same env-var contract the
+reference uses (RANK/WORLD_SIZE/MASTER_*).
+"""
+
+import os
+import shutil
+import sys
+from abc import ABC, abstractmethod
+from shlex import quote, split
+
+PDSH_MAX_FAN_OUT = 1024
+MVAPICH_TMP_HOSTFILE = "/tmp/dstpu_mvapich_hostfile"
+
+
+class MultiNodeRunner(ABC):
+    """Builds the host-fanout command for one launcher backend."""
+
+    def __init__(self, args, world_info_base64):
+        self.args = args
+        self.user_arguments = self.parse_user_args()
+        self.user_script = args.user_script
+        self.world_info_base64 = world_info_base64
+        self.exports = {}
+
+    @abstractmethod
+    def backend_exists(self):
+        """Whether the backend binary is installed on this machine."""
+
+    @abstractmethod
+    def get_cmd(self, environment, active_resources):
+        """The command to execute (list of argv tokens)."""
+
+    def add_export(self, key, var):
+        self.exports[key.strip()] = str(var).strip()
+
+    def parse_user_args(self):
+        return list(self.args.user_args)
+
+    @property
+    def name(self):
+        return self.__class__.__name__
+
+    def _launch_module(self):
+        """argv tail shared by all runners: the node-local launcher module."""
+        return [
+            sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+            f"--world_info={self.world_info_base64}",
+            f"--master_addr={self.args.master_addr}",
+            f"--master_port={self.args.master_port}",
+        ]
+
+
+class PDSHRunner(MultiNodeRunner):
+    """ssh fanout via pdsh; node rank comes from pdsh's %n substitution."""
+
+    def backend_exists(self):
+        return shutil.which("pdsh") is not None
+
+    @property
+    def name(self):
+        return "pdsh"
+
+    def get_cmd(self, environment, active_resources):
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        active_workers = ",".join(active_resources.keys())
+
+        pdsh_cmd = ["pdsh", "-S", "-f", str(PDSH_MAX_FAN_OUT), "-w", active_workers]
+        pdsh_cmd += split(getattr(self.args, "launcher_args", "") or "")
+
+        exports = "".join(f"export {k}={quote(v)}; " for k, v in self.exports.items())
+        launch = (self._launch_module() + ["--node_rank=%n"] +
+                  [quote(self.user_script)] +
+                  [a if a.startswith("-") else quote(a) for a in self.user_arguments])
+        return pdsh_cmd + [exports + f"cd {quote(os.path.abspath('.'))}; " +
+                           " ".join(launch)], environment
+
+
+class OpenMPIRunner(MultiNodeRunner):
+
+    def __init__(self, args, world_info_base64, resource_pool):
+        super().__init__(args, world_info_base64)
+        self.resource_pool = resource_pool
+        self.add_export("UCX_TLS", "tcp")
+
+    def backend_exists(self):
+        return shutil.which("ompi_info") is not None
+
+    @property
+    def name(self):
+        return "openmpi"
+
+    def get_cmd(self, environment, active_resources):
+        # one task per host; node rank taken from OMPI env at the far end.
+        # The hostfile passed to mpirun is regenerated from the FILTERED
+        # resource set so include/exclude/num_nodes filters hold.
+        total_hosts = len(active_resources)
+        tmp_hostfile = "/tmp/dstpu_openmpi_hostfile"
+        with open(tmp_hostfile, "w") as f:
+            for host in active_resources:
+                f.write(f"{host} slots=1\n")
+        mpirun = [
+            "mpirun", "-n", str(total_hosts), "--map-by", "ppr:1:node",
+            "-hostfile", tmp_hostfile,
+            "--mca", "btl", "^openib", "--mca", "btl_tcp_if_include", "eth0",
+        ] + split(getattr(self.args, "launcher_args", "") or "")
+        for k, v in self.exports.items():
+            mpirun += ["-x", f"{k}={v}"]
+        launch = self._launch_module() + ["--node_rank=OMPI_COMM_WORLD_RANK"]
+        return mpirun + launch + [self.user_script] + self.user_arguments, environment
+
+
+class MPICHRunner(MultiNodeRunner):
+
+    def __init__(self, args, world_info_base64, resource_pool):
+        super().__init__(args, world_info_base64)
+        self.resource_pool = resource_pool
+
+    def backend_exists(self):
+        return shutil.which("mpirun") is not None
+
+    @property
+    def name(self):
+        return "mpich"
+
+    def get_cmd(self, environment, active_resources):
+        devices_per_node = self.resource_pool.values()
+        total_hosts = len(self.resource_pool)
+        if len(set(devices_per_node)) != 1:
+            raise ValueError("MPICH requires same slot count on all hosts")
+        mpirun = ["mpirun", "-n", str(total_hosts), "-ppn", "1"] + \
+            split(getattr(self.args, "launcher_args", "") or "")
+        for k, v in self.exports.items():
+            mpirun += ["-genv", k, str(v)]
+        launch = self._launch_module() + ["--node_rank=PMI_RANK"]
+        return mpirun + launch + [self.user_script] + self.user_arguments, environment
+
+
+class IMPIRunner(MultiNodeRunner):
+
+    def __init__(self, args, world_info_base64, resource_pool):
+        super().__init__(args, world_info_base64)
+        self.resource_pool = resource_pool
+
+    def backend_exists(self):
+        return shutil.which("mpirun") is not None
+
+    @property
+    def name(self):
+        return "impi"
+
+    def get_cmd(self, environment, active_resources):
+        total_hosts = len(self.resource_pool)
+        mpirun = ["mpirun", "-ppn", "1"] + \
+            split(getattr(self.args, "launcher_args", "") or "")
+        for k, v in self.exports.items():
+            mpirun += ["-genv", k, str(v)]
+        # Intel MPI: explicit per-host blocks
+        out = list(mpirun)
+        for rank, host in enumerate(active_resources):
+            out += ["-host", host]
+            out += self._launch_module() + [f"--node_rank={rank}"]
+            out += [self.user_script] + self.user_arguments
+            if rank != total_hosts - 1:
+                out.append(":")
+        return out, environment
+
+
+class SlurmRunner(MultiNodeRunner):
+
+    def __init__(self, args, world_info_base64, resource_pool):
+        super().__init__(args, world_info_base64)
+        self.resource_pool = resource_pool
+
+    def backend_exists(self):
+        return shutil.which("sinfo") is not None
+
+    @property
+    def name(self):
+        return "slurm"
+
+    def get_cmd(self, environment, active_resources):
+        bad = [k for k, v in self.exports.items() if "," in k or "," in str(v)]
+        assert not bad, (f"exports {bad} contain commas, which srun --export "
+                         "splits on — pass them through launcher_args instead")
+        total_hosts = len(active_resources)
+        srun = ["srun", "-N", str(total_hosts), "--ntasks-per-node=1"] + \
+            split(getattr(self.args, "launcher_args", "") or "")
+        if getattr(self.args, "include", ""):
+            srun += ["--nodelist", self.args.include]
+        if getattr(self.args, "exclude", ""):
+            srun += ["--exclude", self.args.exclude]
+        exports = "ALL"
+        for k, v in self.exports.items():
+            exports += f",{k}={v}"
+        srun += [f"--export={exports}"]
+        launch = self._launch_module() + ["--node_rank=SLURM_NODEID"]
+        return srun + launch + [self.user_script] + self.user_arguments, environment
+
+
+class MVAPICHRunner(MultiNodeRunner):
+
+    def __init__(self, args, world_info_base64, resource_pool):
+        super().__init__(args, world_info_base64)
+        self.resource_pool = resource_pool
+        self.add_export("MV2_SMP_USE_CMA", "0")
+        self.add_export("MV2_DEBUG_SHOW_BACKTRACE", "1")
+
+    def backend_exists(self):
+        return shutil.which("mpirun_rsh") is not None
+
+    @property
+    def name(self):
+        return "mvapich"
+
+    def get_cmd(self, environment, active_resources):
+        devices_per_node = self.resource_pool.values()
+        total_hosts = len(self.resource_pool)
+        if len(set(devices_per_node)) != 1:
+            raise ValueError("MVAPICH requires same slot count on all hosts")
+        with open(MVAPICH_TMP_HOSTFILE, "w") as f:
+            for host in self.resource_pool.keys():
+                f.write(f"{host}\n")
+        mpirun = ["mpirun_rsh", "-np", str(total_hosts),
+                  "-hostfile", MVAPICH_TMP_HOSTFILE] + \
+            split(getattr(self.args, "launcher_args", "") or "")
+        exports = []
+        for k, v in self.exports.items():
+            exports.append(f"{k}={v}")
+        launch = self._launch_module() + ["--node_rank=MV2_COMM_WORLD_RANK"]
+        return mpirun + exports + launch + [self.user_script] + self.user_arguments, \
+            environment
+
+
+RUNNER_CLASSES = {
+    "pdsh": PDSHRunner,
+    "openmpi": OpenMPIRunner,
+    "mpich": MPICHRunner,
+    "impi": IMPIRunner,
+    "slurm": SlurmRunner,
+    "mvapich": MVAPICHRunner,
+}
+
+
+def make_runner(name, args, world_info_base64, resource_pool):
+    cls = RUNNER_CLASSES[name]
+    if cls is PDSHRunner:
+        return cls(args, world_info_base64)
+    return cls(args, world_info_base64, resource_pool)

@@ -47,7 +47,11 @@ def parse_args(args=None):
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=["ssh", "pdsh", "local"])
+                        choices=["ssh", "pdsh", "local", "openmpi", "mpich",
+                                 "impi", "slurm", "mvapich"])
+    parser.add_argument("--launcher_args", type=str, default="",
+                        help="extra flags passed through to the backend "
+                             "(pdsh/mpirun/srun)")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("--autotuning", type=str, default="",
                         choices=["", "tune", "run"])
@@ -92,12 +96,14 @@ def encode_world_info(resources):
     return base64.urlsafe_b64encode(data).decode()
 
 
+def _export_env_items():
+    """(key, value) pairs of env vars forwarded to remote hosts."""
+    return [(k, v) for k, v in os.environ.items()
+            if any(k.startswith(p) for p in EXPORT_ENVS)]
+
+
 def _build_env_exports():
-    exports = []
-    for key, val in os.environ.items():
-        if any(key.startswith(p) for p in EXPORT_ENVS):
-            exports.append(f"export {key}={shlex.quote(val)}")
-    return "; ".join(exports)
+    return "; ".join(f"export {k}={shlex.quote(v)}" for k, v in _export_env_items())
 
 
 def main(args=None):
@@ -117,6 +123,24 @@ def main(args=None):
         env.setdefault("RANK", "0")
         logger.info(f"launching single-host: {' '.join(cmd_tail)}")
         proc = subprocess.Popen([sys.executable] + cmd_tail, env=env)
+        _forward_signals(proc)
+        return proc.wait()
+
+    if args.launcher not in ("ssh",):
+        # backend-managed fanout (pdsh / mpirun / srun ... — reference
+        # multinode_runner.py:51-366); we only build + exec the command
+        from deepspeed_tpu.launcher.multinode_runner import make_runner
+        if not getattr(args, "master_addr", ""):
+            args.master_addr = list(resources.keys())[0]
+        runner = make_runner(args.launcher, args, encode_world_info(resources),
+                             resources)
+        if not runner.backend_exists():
+            raise RuntimeError(f"launcher backend '{args.launcher}' not installed")
+        for key, val in _export_env_items():
+            runner.add_export(key, val)
+        cmd, env = runner.get_cmd(dict(os.environ), resources)
+        logger.info(f"launching via {runner.name}: {' '.join(map(str, cmd))}")
+        proc = subprocess.Popen(cmd, env=env)
         _forward_signals(proc)
         return proc.wait()
 

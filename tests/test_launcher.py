@@ -1,0 +1,159 @@
+"""Launcher + elasticity tests (reference: tests/unit/launcher/, elasticity/)."""
+
+import base64
+import json
+import types
+
+import pytest
+
+from deepspeed_tpu.launcher import runner as runner_mod
+from deepspeed_tpu.launcher import launch as launch_mod
+from deepspeed_tpu.launcher.multinode_runner import (make_runner, PDSHRunner,
+                                                     SlurmRunner, OpenMPIRunner,
+                                                     MPICHRunner, IMPIRunner,
+                                                     MVAPICHRunner)
+from deepspeed_tpu.elasticity import (ElasticAgent, AgentSpec, MembershipChanged,
+                                      compute_elastic_config,
+                                      ElasticityIncompatibleWorldSize)
+
+
+def _args(**kw):
+    base = dict(user_script="train.py", user_args=["--foo", "1"],
+                master_addr="node0", master_port=29500, hostfile="/tmp/hf",
+                launcher_args="", include="", exclude="")
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+RESOURCES = {"node0": 4, "node1": 4}
+WORLD_B64 = base64.urlsafe_b64encode(json.dumps(RESOURCES).encode()).decode()
+
+
+class TestHostfile:
+    def test_parse(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("# comment\nnode0 slots=4\nnode1 slots=8\n\n")
+        res = runner_mod.fetch_hostfile(str(hf))
+        assert res == {"node0": 4, "node1": 8}
+
+    def test_filters(self):
+        res = {"a": 1, "b": 2, "c": 3}
+        assert runner_mod.filter_resources(res, "a,b", "") == {"a": 1, "b": 2}
+        assert runner_mod.filter_resources(res, "", "b") == {"a": 1, "c": 3}
+
+
+class TestMultinodeRunners:
+    @pytest.mark.parametrize("name,cls", [
+        ("pdsh", PDSHRunner), ("slurm", SlurmRunner), ("openmpi", OpenMPIRunner),
+        ("mpich", MPICHRunner), ("impi", IMPIRunner), ("mvapich", MVAPICHRunner),
+    ])
+    def test_make_runner(self, name, cls):
+        r = make_runner(name, _args(), WORLD_B64, RESOURCES)
+        assert isinstance(r, cls)
+        assert r.name
+
+    def test_pdsh_cmd(self):
+        r = make_runner("pdsh", _args(), WORLD_B64, RESOURCES)
+        r.add_export("JAX_PLATFORMS", "tpu")
+        cmd, env = r.get_cmd({}, RESOURCES)
+        joined = " ".join(map(str, cmd))
+        assert cmd[0] == "pdsh"
+        assert "node0,node1" in cmd
+        assert "deepspeed_tpu.launcher.launch" in joined
+        assert "--node_rank=%n" in joined
+        assert "export JAX_PLATFORMS=tpu" in joined
+        assert "train.py" in joined and "--foo" in joined
+        assert env["PDSH_RCMD_TYPE"] == "ssh"
+
+    def test_slurm_cmd(self):
+        r = make_runner("slurm", _args(), WORLD_B64, RESOURCES)
+        r.add_export("XLA_FLAGS", "--xla_foo")
+        cmd, _ = r.get_cmd({}, RESOURCES)
+        assert cmd[0] == "srun"
+        assert "--ntasks-per-node=1" in cmd
+        assert any(c.startswith("--export=ALL,XLA_FLAGS=") for c in cmd)
+        assert "--node_rank=SLURM_NODEID" in cmd
+
+    def test_openmpi_cmd(self):
+        r = make_runner("openmpi", _args(), WORLD_B64, RESOURCES)
+        cmd, _ = r.get_cmd({}, RESOURCES)
+        assert cmd[0] == "mpirun"
+        assert "ppr:1:node" in cmd
+        i = cmd.index("-n")
+        assert cmd[i + 1] == "2"
+
+    def test_impi_per_host_blocks(self):
+        r = make_runner("impi", _args(), WORLD_B64, RESOURCES)
+        cmd, _ = r.get_cmd({}, RESOURCES)
+        assert cmd.count("-host") == 2
+        assert cmd.count(":") == 1
+
+
+class TestNodeLauncher:
+    def test_resolve_node_rank(self):
+        assert launch_mod.resolve_node_rank("3") == 3
+        assert launch_mod.resolve_node_rank("MY_RANK", {"MY_RANK": "5"}) == 5
+        with pytest.raises(ValueError):
+            launch_mod.resolve_node_rank("NOT_SET", {})
+
+    def test_build_rank_env(self):
+        env = launch_mod.build_rank_env(RESOURCES, node_rank=1, local_rank=2,
+                                        procs_per_node=4, master_addr="node0",
+                                        master_port=29500, base_env={})
+        assert env["RANK"] == "6"
+        assert env["LOCAL_RANK"] == "2"
+        assert env["WORLD_SIZE"] == "8"
+        assert env["CROSS_RANK"] == "1"
+        assert env["COORDINATOR_ADDRESS"] == "node0:29500"
+        assert env["PROCESS_ID"] == "6"
+
+    def test_launch_spawns_and_propagates_rc(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os, sys\n"
+            "print(os.environ['RANK'], os.environ['WORLD_SIZE'])\n"
+            "sys.exit(0 if os.environ['RANK'] != '1' else 3)\n")
+        rc = launch_mod.main([
+            f"--world_info={base64.urlsafe_b64encode(json.dumps({'localhost': 2}).encode()).decode()}",
+            "--node_rank=0", "--procs_per_node=2", str(script)])
+        assert rc == 3
+
+
+class TestElasticAgent:
+    DS_CONFIG = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                                "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                                "max_gpus": 32}}
+
+    def test_restart_on_membership_change(self):
+        calls = []
+
+        def run_fn(world, micro):
+            calls.append((world, micro))
+            if len(calls) == 1:
+                raise MembershipChanged("host lost")
+
+        _, valid = compute_elastic_config(self.DS_CONFIG)
+        w0, w1 = valid[-1], valid[-2]
+        worlds = iter([w0, w1])
+        spec = AgentSpec(run_fn=run_fn, world_size_fn=lambda: next(worlds),
+                         ds_config=self.DS_CONFIG, restart_backoff_s=0.0)
+        assert ElasticAgent(spec).run()
+        assert len(calls) == 2
+        assert calls[0][0] == w0 and calls[1][0] == w1
+
+    def test_restart_budget(self):
+        def run_fn(world, micro):
+            raise RuntimeError("boom")
+
+        spec = AgentSpec(run_fn=run_fn, world_size_fn=lambda: 4,
+                         ds_config=self.DS_CONFIG, max_restarts=2,
+                         restart_backoff_s=0.0)
+        assert not ElasticAgent(spec).run()
+
+    def test_inadmissible_world_size(self):
+        final_batch, valid = compute_elastic_config(self.DS_CONFIG)
+        bad = max(valid) + 1
+        while bad in valid:
+            bad += 1
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(self.DS_CONFIG, world_size=bad)
