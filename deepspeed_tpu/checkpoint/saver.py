@@ -74,15 +74,91 @@ class NumpyCheckpointEngine(CheckpointEngine):
         return jax.tree_util.tree_unflatten(treedef, flat)
 
 
+class AsyncCheckpointEngine(CheckpointEngine):
+    """Async tiered save (reference `NebulaCheckpointEngine`,
+    `nebula_checkpoint_engine.py:20`: snapshot fast, persist in background).
+
+    The host copy of the state is taken synchronously (so training can mutate /
+    donate device buffers immediately); serialization runs on a worker thread.
+    `commit(tag)` blocks until the pending save is durable — the engine-level
+    `save_checkpoint` calls it before writing `latest`, preserving the
+    reference's "latest is only advanced after persist" semantics.
+    """
+
+    def __init__(self, inner: CheckpointEngine):
+        import threading
+        self.inner = inner
+        self._thread = None
+        self._error = None
+        self._threading = threading
+        self._completions = []
+
+    def add_completion(self, fn):
+        """Run `fn()` in the worker after the pending save persists — used for
+        metadata whose ordering contract is "only after the state is durable"
+        (the `latest` file)."""
+        self._completions.append(fn)
+
+    def save(self, state, path):
+        host_state = jax.tree_util.tree_map(
+            lambda x: jax.device_get(x) if hasattr(x, "devices") else x, state)
+        self.wait()
+        completions, self._completions = self._completions, []
+
+        def worker():
+            try:
+                self.inner.save(host_state, path)
+                for fn in completions:
+                    fn()
+            except Exception as e:  # surfaced on commit/wait
+                self._error = e
+
+        self._thread = self._threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def load(self, path, template):
+        self.wait()
+        return self.inner.load(path, template)
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def commit(self, tag):
+        self.wait()
+        return True
+
+
 def _make_engine(config):
     name = getattr(config.checkpoint, "engine", "orbax")
+    async_save = bool(getattr(config.checkpoint, "async_save", False))
     if name == "numpy":
-        return NumpyCheckpointEngine()
-    try:
-        return OrbaxCheckpointEngine(async_save=config.checkpoint.async_save)
-    except Exception as e:
-        logger.warning(f"orbax unavailable ({e}); falling back to numpy engine")
-        return NumpyCheckpointEngine()
+        eng = NumpyCheckpointEngine()
+    else:
+        try:
+            eng = OrbaxCheckpointEngine(async_save=async_save)
+        except Exception as e:
+            logger.warning(f"orbax unavailable ({e}); falling back to numpy engine")
+            eng = NumpyCheckpointEngine()
+    # orbax has its own async machinery; thread-wrap only the numpy engine
+    # (whether requested or reached via fallback)
+    if async_save and isinstance(eng, NumpyCheckpointEngine):
+        eng = AsyncCheckpointEngine(eng)
+    return eng
+
+
+def _engine_for(engine):
+    """One checkpoint engine per training engine, so async saves overlap
+    training and cross-call wait() semantics hold."""
+    ck = getattr(engine, "_ckpt_engine", None)
+    if ck is None:
+        ck = _make_engine(engine.config)
+        engine._ckpt_engine = ck
+    return ck
 
 
 def get_latest_tag(load_dir):
@@ -97,16 +173,38 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None, save_latest=T
     ckpt_dir = pathlib.Path(save_dir) / str(tag)
     ckpt_dir.mkdir(parents=True, exist_ok=True)
 
-    ck_engine = _make_engine(engine.config)
+    ck_engine = _engine_for(engine)
     state_path = ckpt_dir / "state"
-    ck_engine.save(engine.state, str(state_path))
 
-    if jax.process_index() == 0:
+    def write_metadata():
+        if jax.process_index() != 0:
+            return
         with open(ckpt_dir / "client.json", "w") as f:
             json.dump(client_state or {}, f, indent=2, default=str)
+        # ship the consolidation script next to `latest` at the save_dir root
+        # (reference engine.py:3366 copies zero_to_fp32.py into the save dir so
+        # `python zero_to_fp32.py . out` works in place)
+        try:
+            import shutil
+            from deepspeed_tpu.checkpoint import zero_to_fp32 as _z2f
+            shutil.copyfile(_z2f.__file__,
+                            pathlib.Path(save_dir) / "zero_to_fp32.py")
+        except Exception as e:
+            logger.warning(f"could not ship zero_to_fp32.py: {e}")
         if save_latest:
+            # ordering contract: `latest` only advances after the state persists
             with open(pathlib.Path(save_dir) / LATEST_FILE, "w") as f:
                 f.write(str(tag))
+
+    if isinstance(ck_engine, AsyncCheckpointEngine):
+        # metadata (incl. `latest`) written by the worker after persist;
+        # save() returns as soon as the host snapshot is taken
+        ck_engine.add_completion(write_metadata)
+        ck_engine.save(engine.state, str(state_path))
+    else:
+        ck_engine.save(engine.state, str(state_path))
+        ck_engine.commit(tag)
+        write_metadata()
     log_dist(f"saved checkpoint {tag} to {ckpt_dir}", ranks=[0])
     return str(ckpt_dir)
 
@@ -122,7 +220,7 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
         logger.warning(f"checkpoint dir {ckpt_dir} does not exist")
         return None, None
 
-    ck_engine = _make_engine(engine.config)
+    ck_engine = _engine_for(engine)
     restored = ck_engine.load(str(ckpt_dir / "state"), engine.state)
 
     if load_module_only:

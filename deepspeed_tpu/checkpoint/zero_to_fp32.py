@@ -1,0 +1,119 @@
+"""Offline fp32 consolidation — `zero_to_fp32` analog.
+
+Reference: `deepspeed/utils/zero_to_fp32.py` (587 LoC) — a standalone script that
+DeepSpeed copies into every checkpoint directory (`runtime/engine.py:3366`) so a
+user can reassemble the full fp32 state dict from ZeRO-partitioned shard files
+without an engine or a distributed launch.
+
+TPU analog: our checkpoints store the whole TrainState through orbax (sharding
+recorded in array metadata) or the npz fallback, so "consolidation" is: restore
+on host, pick the fp32 master tree (fall back to params when training was pure
+fp32/bf16 without master copies), cast to fp32, and emit one flat
+``{path: np.ndarray}`` dict. Works on CPU with no TPU attached.
+
+Usage (CLI, mirrors the reference's):
+    python -m deepspeed_tpu.checkpoint.zero_to_fp32 <checkpoint_dir> <output.npz> [--tag TAG]
+"""
+
+import argparse
+import json
+import os
+import pathlib
+
+import numpy as np
+
+LATEST_FILE = "latest"
+
+
+def _read_latest(ckpt_root):
+    latest = pathlib.Path(ckpt_root) / LATEST_FILE
+    if latest.exists():
+        return latest.read_text().strip()
+    return None
+
+
+def _flatten(tree, prefix=()):
+    """pytree -> {dot.path: leaf} with stable, human-readable keys."""
+    out = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.update(_flatten(tree[k], prefix + (str(k),)))
+    elif isinstance(tree, (list, tuple)) and not hasattr(tree, "_fields"):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, prefix + (str(i),)))
+    elif hasattr(tree, "_fields"):  # NamedTuple
+        for name in tree._fields:
+            out.update(_flatten(getattr(tree, name), prefix + (name,)))
+    elif tree is None:
+        pass
+    else:
+        out[".".join(prefix)] = tree
+    return out
+
+
+def _restore_state_tree(state_path):
+    """Load a saved TrainState directory (orbax or npz) as host numpy trees."""
+    npz = os.path.join(state_path, "state.npz")
+    if os.path.exists(npz):
+        with np.load(npz) as data:
+            return {k: data[k] for k in data.files}, "npz"
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(os.path.abspath(state_path))
+    return restored, "orbax"
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
+    """Full fp32 params as {path: np.ndarray} (reference
+    `get_fp32_state_dict_from_zero_checkpoint`)."""
+    tag = tag or _read_latest(checkpoint_dir)
+    if tag is None:
+        raise FileNotFoundError(f"no '{LATEST_FILE}' file in {checkpoint_dir}; pass --tag")
+    state_path = os.path.join(checkpoint_dir, str(tag), "state")
+    if not os.path.isdir(state_path):
+        raise FileNotFoundError(f"no state dir at {state_path}")
+    restored, fmt = _restore_state_tree(state_path)
+
+    if fmt == "npz":
+        # npz engine stores a flat positional list; param/master split is not
+        # recoverable without the engine's treedef — return raw leaves.
+        return {k: np.asarray(v, np.float32) for k, v in restored.items()}
+
+    # orbax: TrainState structure round-trips as a dict-like pytree
+    tree = restored
+    master = tree.get("master") if isinstance(tree, dict) else getattr(tree, "master", None)
+    params = tree.get("params") if isinstance(tree, dict) else getattr(tree, "params", None)
+    source = master if master is not None else params
+    if source is None:
+        raise ValueError("checkpoint has neither 'master' nor 'params' trees")
+    flat = _flatten(source)
+    return {k: np.asarray(v, np.float32) for k, v in flat.items()}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file, tag=None):
+    """Write the consolidated fp32 dict to one .npz (reference writes a torch
+    ``pytorch_model.bin``)."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=tag)
+    out = pathlib.Path(output_file)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    np.savez(out, **sd)
+    meta = {"num_params": len(sd),
+            "total_elems": int(sum(int(np.prod(v.shape)) for v in sd.values()))}
+    print(json.dumps(meta))
+    return output_file
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Consolidate a deepspeed_tpu checkpoint into one fp32 npz")
+    parser.add_argument("checkpoint_dir")
+    parser.add_argument("output_file")
+    parser.add_argument("--tag", default=None)
+    args = parser.parse_args(argv)
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir,
+                                               args.output_file, tag=args.tag)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

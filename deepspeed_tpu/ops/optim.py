@@ -76,11 +76,21 @@ def _adagrad(lr: ScalarOrSchedule, params: Dict[str, Any]):
 
 
 def _onebit_adam(lr: ScalarOrSchedule, params: Dict[str, Any]):
-    # Compressed-communication variant (reference `runtime/fp16/onebit/adam.py:14`).
-    # On TPU the gradient compression happens in the comm path (see
-    # runtime/compressed_grads.py); numerically the optimizer is Adam.
+    # Compressed-communication family (reference `runtime/fp16/onebit/`):
+    # warmup phase = exact base optimizer, then frozen variance + sign-compressed
+    # momentum with error feedback (see runtime/compressed_grads.py).
     from deepspeed_tpu.runtime.compressed_grads import onebit_adam
     return onebit_adam(lr, params)
+
+
+def _onebit_lamb(lr: ScalarOrSchedule, params: Dict[str, Any]):
+    from deepspeed_tpu.runtime.compressed_grads import onebit_lamb
+    return onebit_lamb(lr, params)
+
+
+def _zero_one_adam(lr: ScalarOrSchedule, params: Dict[str, Any]):
+    from deepspeed_tpu.runtime.compressed_grads import zero_one_adam
+    return zero_one_adam(lr, params)
 
 
 OPTIMIZER_REGISTRY = {
@@ -91,8 +101,8 @@ OPTIMIZER_REGISTRY = {
     SGD_OPTIMIZER: _sgd,
     ADAGRAD_OPTIMIZER: _adagrad,
     ONEBIT_ADAM_OPTIMIZER: _onebit_adam,
-    ZERO_ONE_ADAM_OPTIMIZER: _onebit_adam,
-    ONEBIT_LAMB_OPTIMIZER: _lamb,
+    ZERO_ONE_ADAM_OPTIMIZER: _zero_one_adam,
+    ONEBIT_LAMB_OPTIMIZER: _onebit_lamb,
 }
 
 
