@@ -100,18 +100,34 @@ def from_hf_gpt2(model_or_sd, hf_config=None, dtype=jnp.float32):
 # ----------------------------------------------------------------------
 
 
-def _unpermute_rope_rows(w, n_heads, head_dim):
-    """HF rotate-half row order → interleaved (Meta) order, per head.
+def _unpermute_rope_rows(w, n_heads, head_dim, rotary_dims=None):
+    """HF rotate-half row order → interleaved order, per head.
 
-    HF's Meta→HF conversion applies, per head,
-    `w.view(d/2, 2, in).transpose(0, 1)` — evens first then odds. Invert it so
-    our interleaved `_rope` (models/gpt.py) sees the original pairing.
+    HF applies RoPE as rotate_half over contiguous halves of the (first
+    `rotary_dims` of the) head dim; our `_rope` (models/gpt.py) rotates
+    interleaved pairs. Reorder the rows so pair (i, i+rd/2) becomes (2i, 2i+1);
+    rows past `rotary_dims` (NeoX rotary_pct < 1) stay in place.
     w: [n_heads*head_dim, in_dim] (torch Linear layout).
     """
     H, hd = n_heads, head_dim
-    w = w.reshape(H, 2, hd // 2, -1)        # [H, {evens,odds}, hd/2, in]
-    w = np.transpose(w, (0, 2, 1, 3))       # [H, hd/2, 2, in] → interleave
-    return w.reshape(H * hd, -1)
+    rd = rotary_dims if rotary_dims is not None else hd
+    w = w.reshape(H, hd, -1)
+    rot, keep = w[:, :rd], w[:, rd:]
+    rot = rot.reshape(H, 2, rd // 2, -1)     # [H, {half0,half1}, rd/2, in]
+    rot = np.transpose(rot, (0, 2, 1, 3))    # interleave the halves
+    rot = rot.reshape(H, rd, -1)
+    return np.concatenate([rot, keep], axis=1).reshape(H * hd, -1)
+
+
+def _split_fused_qkv_per_head(w, n_heads, head_dim):
+    """BLOOM/NeoX fused query_key_value stores [H, (q,k,v), hd] interleaved per
+    head — split into contiguous q, k, v of [H*hd, in_dim]."""
+    in_dim = w.shape[-1] if w.ndim == 2 else 1
+    w = w.reshape(n_heads, 3, head_dim, -1)
+    q, k, v = w[:, 0], w[:, 1], w[:, 2]
+    out = lambda t: t.reshape(n_heads * head_dim, in_dim) if in_dim > 1 \
+        else t.reshape(n_heads * head_dim)
+    return out(q), out(k), out(v)
 
 
 def from_hf_llama(model_or_sd, hf_config=None, dtype=jnp.float32):
@@ -167,6 +183,283 @@ def from_hf_llama(model_or_sd, hf_config=None, dtype=jnp.float32):
         params["lm_head"] = jnp.asarray(head, dtype)
     logger.info(f"adapted HF LLaMA: {cfg.n_layer}L d={cfg.d_model} "
                 f"H={H}/{Hkv} vocab={cfg.vocab_size}")
+    return cfg, params
+
+
+# ----------------------------------------------------------------------
+# OPT
+# ----------------------------------------------------------------------
+
+
+def from_hf_opt(model_or_sd, hf_config=None, dtype=jnp.float32):
+    """OPTForCausalLM → (GPTConfig, params). Pre-LN decoder with ReLU MLP and
+    learned positions at a +2 offset — the offset is absorbed by trimming the
+    first two position rows (reference container: `containers/opt.py`,
+    `fusedqkv_utils.py`)."""
+    sd = _state_dict(model_or_sd)
+    if hf_config is None:
+        hf_config = getattr(model_or_sd, "config", None)
+    assert hf_config is not None
+    assert getattr(hf_config, "do_layer_norm_before", True), \
+        "post-LN OPT variants (350m) are not supported"
+    D = hf_config.hidden_size
+    assert getattr(hf_config, "word_embed_proj_dim", D) == D, \
+        "OPT word_embed_proj_dim != hidden_size not supported"
+
+    cfg = GPTConfig(
+        vocab_size=hf_config.vocab_size,
+        n_layer=hf_config.num_hidden_layers,
+        n_head=hf_config.num_attention_heads,
+        d_model=D,
+        d_ff=hf_config.ffn_dim,
+        max_seq_len=hf_config.max_position_embeddings,
+        activation="relu",
+        use_rotary=False, use_swiglu=False, use_rmsnorm=False,
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", True)),
+        dtype=dtype, remat=False)
+
+    layers = []
+    for i in range(cfg.n_layer):
+        b = f"model.decoder.layers.{i}."
+        q = sd[b + "self_attn.q_proj.weight"]
+        k = sd[b + "self_attn.k_proj.weight"]
+        v = sd[b + "self_attn.v_proj.weight"]
+        layers.append({
+            "ln1_scale": sd[b + "self_attn_layer_norm.weight"],
+            "ln1_bias": sd[b + "self_attn_layer_norm.bias"],
+            "attn_qkv_w": np.concatenate([q, k, v], axis=0).T,
+            "attn_qkv_b": np.concatenate([sd[b + "self_attn.q_proj.bias"],
+                                          sd[b + "self_attn.k_proj.bias"],
+                                          sd[b + "self_attn.v_proj.bias"]]),
+            "attn_out_w": sd[b + "self_attn.out_proj.weight"].T,
+            "attn_out_b": sd[b + "self_attn.out_proj.bias"],
+            "ln2_scale": sd[b + "final_layer_norm.weight"],
+            "ln2_bias": sd[b + "final_layer_norm.bias"],
+            "mlp_up_w": sd[b + "fc1.weight"].T,
+            "mlp_up_b": sd[b + "fc1.bias"],
+            "mlp_down_w": sd[b + "fc2.weight"].T,
+            "mlp_out_b": sd[b + "fc2.bias"],
+        })
+    params = {
+        "wte": jnp.asarray(sd["model.decoder.embed_tokens.weight"], dtype),
+        # OPTLearnedPositionalEmbedding indexes at position+2
+        "wpe": jnp.asarray(sd["model.decoder.embed_positions.weight"][2:], dtype),
+        "blocks": {k2: v2.astype(dtype) for k2, v2 in _stack(layers).items()},
+        "lnf_scale": jnp.asarray(sd["model.decoder.final_layer_norm.weight"], dtype),
+        "lnf_bias": jnp.asarray(sd["model.decoder.final_layer_norm.bias"], dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(sd["lm_head.weight"], dtype)
+    logger.info(f"adapted HF OPT: {cfg.n_layer}L d={cfg.d_model} vocab={cfg.vocab_size}")
+    return cfg, params
+
+
+# ----------------------------------------------------------------------
+# BLOOM
+# ----------------------------------------------------------------------
+
+
+def from_hf_bloom(model_or_sd, hf_config=None, dtype=jnp.float32):
+    """BloomForCausalLM → (GPTConfig, params). Alibi attention (no position
+    embedding), word-embedding LayerNorm, per-head-interleaved fused qkv
+    (reference container: `containers/bloom.py`)."""
+    sd = _state_dict(model_or_sd)
+    if hf_config is None:
+        hf_config = getattr(model_or_sd, "config", None)
+    assert hf_config is not None
+    H = hf_config.n_head
+    D = hf_config.hidden_size
+    hd = D // H
+
+    cfg = GPTConfig(
+        vocab_size=hf_config.vocab_size,
+        n_layer=hf_config.n_layer,
+        n_head=H, d_model=D, d_ff=4 * D,
+        max_seq_len=getattr(hf_config, "seq_length", 2048) or 2048,
+        norm_eps=float(getattr(hf_config, "layer_norm_epsilon", 1e-5)),
+        use_alibi=True, use_emb_ln=True,
+        use_rotary=False, use_swiglu=False, use_rmsnorm=False,
+        tie_embeddings=True, dtype=dtype, remat=False)
+
+    pre = "transformer." if "transformer.word_embeddings.weight" in sd else ""
+    layers = []
+    for i in range(cfg.n_layer):
+        b = f"{pre}h.{i}."
+        qw, kw, vw = _split_fused_qkv_per_head(
+            sd[b + "self_attention.query_key_value.weight"], H, hd)
+        qb, kb, vb = _split_fused_qkv_per_head(
+            sd[b + "self_attention.query_key_value.bias"], H, hd)
+        layers.append({
+            "ln1_scale": sd[b + "input_layernorm.weight"],
+            "ln1_bias": sd[b + "input_layernorm.bias"],
+            "attn_qkv_w": np.concatenate([qw, kw, vw], axis=0).T,
+            "attn_qkv_b": np.concatenate([qb, kb, vb]),
+            "attn_out_w": sd[b + "self_attention.dense.weight"].T,
+            "attn_out_b": sd[b + "self_attention.dense.bias"],
+            "ln2_scale": sd[b + "post_attention_layernorm.weight"],
+            "ln2_bias": sd[b + "post_attention_layernorm.bias"],
+            "mlp_up_w": sd[b + "mlp.dense_h_to_4h.weight"].T,
+            "mlp_up_b": sd[b + "mlp.dense_h_to_4h.bias"],
+            "mlp_down_w": sd[b + "mlp.dense_4h_to_h.weight"].T,
+            "mlp_out_b": sd[b + "mlp.dense_4h_to_h.bias"],
+        })
+    params = {
+        "wte": jnp.asarray(sd[f"{pre}word_embeddings.weight"], dtype),
+        "emb_ln_scale": jnp.asarray(sd[f"{pre}word_embeddings_layernorm.weight"], dtype),
+        "emb_ln_bias": jnp.asarray(sd[f"{pre}word_embeddings_layernorm.bias"], dtype),
+        "blocks": {k2: v2.astype(dtype) for k2, v2 in _stack(layers).items()},
+        "lnf_scale": jnp.asarray(sd[f"{pre}ln_f.weight"], dtype),
+        "lnf_bias": jnp.asarray(sd[f"{pre}ln_f.bias"], dtype),
+    }
+    logger.info(f"adapted HF BLOOM: {cfg.n_layer}L d={cfg.d_model} alibi "
+                f"vocab={cfg.vocab_size}")
+    return cfg, params
+
+
+# ----------------------------------------------------------------------
+# GPT-NeoX / GPT-J
+# ----------------------------------------------------------------------
+
+
+def from_hf_gpt_neox(model_or_sd, hf_config=None, dtype=jnp.float32):
+    """GPTNeoXForCausalLM → (GPTConfig, params). Partial rotary (rotary_pct),
+    parallel residual, per-head-interleaved fused qkv, untied embed_out
+    (reference container: `containers/gptneox.py`)."""
+    sd = _state_dict(model_or_sd)
+    if hf_config is None:
+        hf_config = getattr(model_or_sd, "config", None)
+    assert hf_config is not None
+    H = hf_config.num_attention_heads
+    D = hf_config.hidden_size
+    hd = D // H
+    rd = int(hf_config.rotary_pct * hd) // 2 * 2
+
+    cfg = GPTConfig(
+        vocab_size=hf_config.vocab_size,
+        n_layer=hf_config.num_hidden_layers,
+        n_head=H, d_model=D, d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        norm_eps=float(getattr(hf_config, "layer_norm_eps", 1e-5)),
+        use_rotary=True, rotary_pct=float(hf_config.rotary_pct),
+        rope_theta=float(getattr(hf_config, "rotary_emb_base", 10000.0)),
+        parallel_residual=bool(getattr(hf_config, "use_parallel_residual", True)),
+        use_swiglu=False, use_rmsnorm=False,
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        dtype=dtype, remat=False)
+
+    layers = []
+    for i in range(cfg.n_layer):
+        b = f"gpt_neox.layers.{i}."
+        qw, kw, vw = _split_fused_qkv_per_head(
+            sd[b + "attention.query_key_value.weight"], H, hd)
+        qb, kb, vb = _split_fused_qkv_per_head(
+            sd[b + "attention.query_key_value.bias"], H, hd)
+        qw = _unpermute_rope_rows(qw, H, hd, rd)
+        kw = _unpermute_rope_rows(kw, H, hd, rd)
+        qb = _unpermute_rope_rows(qb[:, None], H, hd, rd)[:, 0]
+        kb = _unpermute_rope_rows(kb[:, None], H, hd, rd)[:, 0]
+        layers.append({
+            "ln1_scale": sd[b + "input_layernorm.weight"],
+            "ln1_bias": sd[b + "input_layernorm.bias"],
+            "attn_qkv_w": np.concatenate([qw, kw, vw], axis=0).T,
+            "attn_qkv_b": np.concatenate([qb, kb, vb]),
+            "attn_out_w": sd[b + "attention.dense.weight"].T,
+            "attn_out_b": sd[b + "attention.dense.bias"],
+            "ln2_scale": sd[b + "post_attention_layernorm.weight"],
+            "ln2_bias": sd[b + "post_attention_layernorm.bias"],
+            "mlp_up_w": sd[b + "mlp.dense_h_to_4h.weight"].T,
+            "mlp_up_b": sd[b + "mlp.dense_h_to_4h.bias"],
+            "mlp_down_w": sd[b + "mlp.dense_4h_to_h.weight"].T,
+            "mlp_out_b": sd[b + "mlp.dense_4h_to_h.bias"],
+        })
+    params = {
+        "wte": jnp.asarray(sd["gpt_neox.embed_in.weight"], dtype),
+        "blocks": {k2: v2.astype(dtype) for k2, v2 in _stack(layers).items()},
+        "lnf_scale": jnp.asarray(sd["gpt_neox.final_layer_norm.weight"], dtype),
+        "lnf_bias": jnp.asarray(sd["gpt_neox.final_layer_norm.bias"], dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(sd["embed_out.weight"], dtype)
+    logger.info(f"adapted HF GPT-NeoX: {cfg.n_layer}L d={cfg.d_model} "
+                f"rot%={cfg.rotary_pct} vocab={cfg.vocab_size}")
+    return cfg, params
+
+
+def from_hf_gptj(model_or_sd, hf_config=None, dtype=jnp.float32):
+    """GPTJForCausalLM → (GPTConfig, params). Natively-interleaved rotary over
+    `rotary_dim`, single-LN parallel residual (ln2 := copy of ln1), biasless
+    attention projections, biased LM head (reference container:
+    `containers/gptj.py`)."""
+    sd = _state_dict(model_or_sd)
+    if hf_config is None:
+        hf_config = getattr(model_or_sd, "config", None)
+    assert hf_config is not None
+    H = hf_config.n_head
+    D = hf_config.n_embd
+    hd = D // H
+    rd = int(getattr(hf_config, "rotary_dim", hd) or hd)
+
+    cfg = GPTConfig(
+        vocab_size=hf_config.vocab_size,
+        n_layer=hf_config.n_layer,
+        n_head=H, d_model=D,
+        d_ff=getattr(hf_config, "n_inner", None) or 4 * D,
+        max_seq_len=hf_config.n_positions,
+        norm_eps=float(getattr(hf_config, "layer_norm_epsilon", 1e-5)),
+        use_rotary=True, rotary_pct=rd / hd,
+        parallel_residual=True,
+        use_swiglu=False, use_rmsnorm=False,
+        tie_embeddings=False, dtype=dtype, remat=False)
+
+    layers = []
+    for i in range(cfg.n_layer):
+        b = f"transformer.h.{i}."
+        q = sd[b + "attn.q_proj.weight"]   # GPT-J rope is already interleaved
+        k = sd[b + "attn.k_proj.weight"]
+        v = sd[b + "attn.v_proj.weight"]
+        ln_s, ln_b = sd[b + "ln_1.weight"], sd[b + "ln_1.bias"]
+        layers.append({
+            "ln1_scale": ln_s,
+            "ln1_bias": ln_b,
+            # single-LN parallel residual: mlp reads the SAME normed input
+            "ln2_scale": ln_s.copy(),
+            "ln2_bias": ln_b.copy(),
+            "attn_qkv_w": np.concatenate([q, k, v], axis=0).T,
+            "attn_qkv_b": np.zeros(3 * D, np.float32),
+            "attn_out_w": sd[b + "attn.out_proj.weight"].T,
+            "attn_out_b": np.zeros(D, np.float32),
+            "mlp_up_w": sd[b + "mlp.fc_in.weight"].T,
+            "mlp_up_b": sd[b + "mlp.fc_in.bias"],
+            "mlp_down_w": sd[b + "mlp.fc_out.weight"].T,
+            "mlp_out_b": sd[b + "mlp.fc_out.bias"],
+        })
+    params = {
+        "wte": jnp.asarray(sd["transformer.wte.weight"], dtype),
+        "blocks": {k2: v2.astype(dtype) for k2, v2 in _stack(layers).items()},
+        "lnf_scale": jnp.asarray(sd["transformer.ln_f.weight"], dtype),
+        "lnf_bias": jnp.asarray(sd["transformer.ln_f.bias"], dtype),
+        "lm_head": jnp.asarray(sd["lm_head.weight"], dtype),
+    }
+    if "lm_head.bias" in sd:
+        params["lm_head_bias"] = jnp.asarray(sd["lm_head.bias"], dtype)
+    logger.info(f"adapted HF GPT-J: {cfg.n_layer}L d={cfg.d_model} rd={rd}")
+    return cfg, params
+
+
+# ----------------------------------------------------------------------
+# Mistral
+# ----------------------------------------------------------------------
+
+
+def from_hf_mistral(model_or_sd, hf_config=None, dtype=jnp.float32):
+    """MistralForCausalLM → (GPTConfig, params). LLaMA layout + sliding-window
+    attention (reference AutoTP serves mistral via the llama shard plan)."""
+    import dataclasses as _dc
+    cfg, params = from_hf_llama(model_or_sd, hf_config, dtype=dtype)
+    hf_config = hf_config or getattr(model_or_sd, "config", None)
+    window = getattr(hf_config, "sliding_window", None)
+    if window:
+        cfg = _dc.replace(cfg, sliding_window=int(window))
     return cfg, params
 
 

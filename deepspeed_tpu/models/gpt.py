@@ -49,6 +49,11 @@ class GPTConfig:
     norm_eps: float = 1e-5           # LayerNorm/RMSNorm epsilon (HF LLaMA: 1e-6)
     use_swiglu: bool = False         # LLaMA-style gated MLP
     use_rmsnorm: bool = False        # LLaMA-style RMSNorm
+    activation: str = "gelu"         # "gelu" (tanh approx = HF gelu_new), "relu" (OPT)
+    use_alibi: bool = False          # BLOOM attention bias instead of positions
+    use_emb_ln: bool = False         # BLOOM LayerNorm after word embedding
+    parallel_residual: bool = False  # NeoX/GPT-J: x + attn(ln1 x) + mlp(ln2 x)
+    sliding_window: Optional[int] = None  # Mistral local attention window
     tie_embeddings: bool = True
     remat: bool = True               # jax.checkpoint each block
     remat_policy: str = "nothing_saveable"  # or "dots_with_no_batch_dims_saveable"
@@ -143,8 +148,11 @@ def init_gpt_params(cfg: GPTConfig, seed: int = 0, dtype=jnp.float32):
     }
     if not cfg.use_rmsnorm:
         params["lnf_bias"] = zeros(D)
-    if not cfg.use_rotary:
+    if not cfg.use_rotary and not cfg.use_alibi:
         params["wpe"] = norm(cfg.max_seq_len, D, scale=0.01)
+    if cfg.use_emb_ln:
+        params["emb_ln_scale"] = ones(D)
+        params["emb_ln_bias"] = zeros(D)
     if not cfg.tie_embeddings:
         params["lm_head"] = norm(cfg.vocab_size, D, scale=0.02)
     return params
@@ -182,8 +190,11 @@ def gpt_param_specs(cfg: GPTConfig):
     }
     if not cfg.use_rmsnorm:
         specs["lnf_bias"] = P(None)
-    if not cfg.use_rotary:
+    if not cfg.use_rotary and not cfg.use_alibi:
         specs["wpe"] = P(None, None)
+    if cfg.use_emb_ln:
+        specs["emb_ln_scale"] = P(None)
+        specs["emb_ln_bias"] = P(None)
     if not cfg.tie_embeddings:
         specs["lm_head"] = P(t, None)
     return specs
@@ -205,6 +216,41 @@ def _norm(x, scale, bias, use_rms, eps=1e-5):
     return (xf * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
 
 
+def _act(x, cfg):
+    if cfg.activation == "relu":
+        return jax.nn.relu(x)
+    return jax.nn.gelu(x)
+
+
+def _alibi_slopes(n_heads):
+    """BLOOM/press-et-al alibi head slopes (geometric in 2^(-8/n); odd head
+    counts get the interleaved extension)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        slopes = pow2_slopes(n_heads)
+    else:
+        base = 2 ** math.floor(math.log2(n_heads))
+        slopes = pow2_slopes(base)
+        extra = pow2_slopes(2 * base)[0::2][: n_heads - base]
+        slopes += extra
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def _alibi_bias(cfg, q_positions, k_positions):
+    """[H, Tq, S] additive attention bias: -slope_h * (t - s)."""
+    dist = (q_positions[:, None] - k_positions[None, :]).astype(jnp.float32)
+    return -_alibi_slopes(cfg.n_head)[:, None, None] * dist
+
+
+def _window_mask(q_positions, k_positions, window):
+    """Sliding-window validity [Tq, S]: key within `window` of the query."""
+    dist = q_positions[:, None] - k_positions[None, :]
+    return dist < window
+
+
 def _rope(x, positions, rotary_dims, theta=10000.0):
     """Rotary position embedding over the first `rotary_dims` of the head dim.
     x: [B, T, H, hd]; positions: [B, T]."""
@@ -223,13 +269,14 @@ def _rope(x, positions, rotary_dims, theta=10000.0):
         else rotated.astype(x.dtype)
 
 
-def _attention(q, k, v, causal_mask, cfg, attn_fn=None):
+def _attention(q, k, v, causal_mask, cfg, attn_fn=None, bias=None):
     """q: [B, T, H, hd]; k,v: [B, S, Hkv, hd] → [B, T, H, hd]. fp32 softmax.
 
     GQA (Hkv < H): query heads are grouped per kv head and contracted without
     materializing repeated k/v (reference serves GQA models like llama2-70b via
-    `module_inject/containers/llama2.py`)."""
-    if attn_fn is None and cfg.use_flash_attention and q.shape[1] % 128 == 0:
+    `module_inject/containers/llama2.py`). `bias`: additive [H, T, S] (alibi)."""
+    if attn_fn is None and cfg.use_flash_attention and bias is None \
+            and q.shape[1] % 128 == 0:
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
         attn_fn = partial(flash_attention, causal=True)
     if attn_fn is not None:
@@ -244,10 +291,25 @@ def _attention(q, k, v, causal_mask, cfg, attn_fn=None):
     G = H // Hkv  # grouped einsum; G == 1 is plain MHA
     qg = q.reshape(B, T, Hkv, G, hd)
     logits = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale
+    if bias is not None:
+        S = k.shape[1]
+        logits = logits + bias.reshape(Hkv, G, T, S)[None]
     logits = jnp.where(causal_mask[:, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
     return out.reshape(B, T, H, hd)
+
+
+def _mlp(h, p, cfg, constrain=True):
+    """MLP half-block: gated (swiglu) or plain with configurable activation.
+    `constrain=False` on the decode path ([B, 1, F] can't shard on sequence)."""
+    if cfg.use_swiglu:
+        up = jax.nn.silu(h @ p["mlp_gate_w"]) * (h @ p["mlp_up_w"])
+    else:
+        up = _act(h @ p["mlp_up_w"] + p["mlp_up_b"], cfg)
+    if constrain:
+        up = shard_constraint(up, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS)
+    return up @ p["mlp_down_w"] + p["mlp_out_b"]
 
 
 def _block(x, p, cfg: GPTConfig, positions, dropout_rng=None, attn_fn=None):
@@ -270,18 +332,25 @@ def _block(x, p, cfg: GPTConfig, positions, dropout_rng=None, attn_fn=None):
         rd = int(cfg.rotary_pct * hd) // 2 * 2
         q = _rope(q, positions, rd, cfg.rope_theta)
         k = _rope(k, positions, rd, cfg.rope_theta)
-    causal = jnp.tril(jnp.ones((T, T), bool))[None, None, :, :]
-    attn = _attention(q, k, v, causal, cfg, attn_fn=attn_fn)
+    t_pos = jnp.arange(T, dtype=jnp.int32)
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    if cfg.sliding_window:
+        causal = causal & _window_mask(t_pos, t_pos, cfg.sliding_window)
+    causal = causal[None, None, :, :]
+    # alibi uses in-sequence distances (standard unpadded formulation)
+    bias = _alibi_bias(cfg, t_pos, t_pos) if cfg.use_alibi else None
+    attn = _attention(q, k, v, causal, cfg, attn_fn=attn_fn, bias=bias)
     attn = attn.reshape(B, T, D)
-    x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
+    attn_out = attn @ p["attn_out_w"] + p["attn_out_b"]
 
-    h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
-    if cfg.use_swiglu:
-        up = jax.nn.silu(h @ p["mlp_gate_w"]) * (h @ p["mlp_up_w"])
+    if cfg.parallel_residual:
+        # NeoX/GPT-J: both halves read the block INPUT (GPT-J ties ln2 == ln1)
+        h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
+        x = x + attn_out + _mlp(h2, p, cfg)
     else:
-        up = jax.nn.gelu(h @ p["mlp_up_w"] + p["mlp_up_b"])
-    up = shard_constraint(up, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS)
-    x = x + up @ p["mlp_down_w"] + p["mlp_out_b"]
+        x = x + attn_out
+        h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
+        x = x + _mlp(h2, p, cfg)
     return shard_constraint(x, BATCH_AXES, SEQ_AXIS, None)
 
 
@@ -292,8 +361,11 @@ def gpt_forward(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None):
     x = jnp.take(params["wte"], tokens, axis=0).astype(dtype)
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
-    if not cfg.use_rotary:
+    if not cfg.use_rotary and not cfg.use_alibi:
         x = x + jnp.take(params["wpe"], positions, axis=0).astype(dtype)
+    if cfg.use_emb_ln:  # BLOOM word-embedding LayerNorm
+        x = _norm(x, params["emb_ln_scale"], params.get("emb_ln_bias"),
+                  use_rms=False, eps=cfg.norm_eps)
     x = shard_constraint(x, BATCH_AXES, SEQ_AXIS, None)
 
     block_fn = partial(_block, cfg=cfg, positions=positions, attn_fn=attn_fn)
@@ -309,6 +381,8 @@ def gpt_forward(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None):
     x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm, cfg.norm_eps)
     head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
     logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+    if "lm_head_bias" in params:  # GPT-J ties a bias to the LM head
+        logits = logits + params["lm_head_bias"].astype(logits.dtype)
     return logits
 
 
@@ -383,26 +457,36 @@ def _block_decode(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
     cache_k = cache_k * (1 - onehot)[:, None, :, None] + onehot[:, None, :, None] * k_new
     cache_v = cache_v * (1 - onehot)[:, None, :, None] + onehot[:, None, :, None] * v_new
 
-    if cfg.use_flash_attention:
+    use_plain_path = cfg.use_alibi or cfg.sliding_window
+    if cfg.use_flash_attention and not use_plain_path:
         from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
         attn = decode_attention(q[:, 0], cache_k, cache_v, pos).reshape(B, 1, D)
     else:
         scale = 1.0 / math.sqrt(hd)
-        valid = (jnp.arange(M)[None, :] <= pos[:, None])      # [B, M]
+        m_pos = jnp.arange(M)
+        valid = (m_pos[None, :] <= pos[:, None])              # [B, M]
+        if cfg.sliding_window:
+            valid = valid & (pos[:, None] - m_pos[None, :] < cfg.sliding_window)
         G = H // Hkv  # grouped einsum; G == 1 is plain MHA
         qg = q.reshape(B, Hkv, G, hd)
         logits = jnp.einsum("bkgd,bkmd->bkgm", qg, cache_k).astype(jnp.float32) * scale
+        if cfg.use_alibi:
+            dist = (pos[:, None] - m_pos[None, :]).astype(jnp.float32)  # [B, M]
+            bias = -_alibi_slopes(H).reshape(Hkv, G)[None, :, :, None] * \
+                dist[:, None, None, :]
+            logits = logits + bias
         logits = jnp.where(valid[:, None, None, :], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         attn = jnp.einsum("bkgm,bkmd->bkgd", probs, cache_v).reshape(B, 1, D)
-    x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
+    attn_out = attn @ p["attn_out_w"] + p["attn_out_b"]
 
-    h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
-    if cfg.use_swiglu:
-        up = jax.nn.silu(h @ p["mlp_gate_w"]) * (h @ p["mlp_up_w"])
+    if cfg.parallel_residual:
+        h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
+        x = x + attn_out + _mlp(h2, p, cfg, constrain=False)
     else:
-        up = jax.nn.gelu(h @ p["mlp_up_w"] + p["mlp_up_b"])
-    x = x + up @ p["mlp_down_w"] + p["mlp_out_b"]
+        x = x + attn_out
+        h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
+        x = x + _mlp(h2, p, cfg, constrain=False)
     return x, cache_k, cache_v
 
 
@@ -453,6 +537,8 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
         x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm, cfg.norm_eps)
         head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
         logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+        if "lm_head_bias" in params:  # GPT-J ties a bias to the LM head
+            logits = logits + params["lm_head_bias"].astype(logits.dtype)
         cache = {"k": ks, "v": vs, "length": jnp.full((B,), T, jnp.int32)}
         return logits, cache
 
@@ -471,6 +557,8 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
         x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm, cfg.norm_eps)
         head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
         logits = jnp.einsum("bod,vd->bov", x, head.astype(x.dtype))[:, 0]
+        if "lm_head_bias" in params:
+            logits = logits + params["lm_head_bias"].astype(logits.dtype)
         cache = {"k": ks, "v": vs, "length": cache["length"] + 1}
         return logits, cache
 

@@ -1,0 +1,326 @@
+"""MoE-GPT — GPT with Mixture-of-Experts MLPs, training AND inference.
+
+Reference: training MoE via `deepspeed/moe/layer.py:16` placed inside client
+transformer MLPs, and MoE *inference* via the expert-parallel containers
+(`ops/transformer/inference/moe_inference.py`, `inference/engine.py:260`
+`_create_ep_parallel_group`).
+
+TPU-native formulation: every `moe_freq`-th block's MLP is a GShard-style
+expert layer — gate → top-1 dispatch einsum constrained onto the `expert` mesh
+axis (XLA inserts the all-to-all pair) → expert FFN batched over the expert
+dim → combine einsum. Static capacity, masked overflow (no dynamic shapes).
+Inference gating drops jitter/aux-loss and keeps argmax routing; the decode
+path routes single tokens with a plain one-hot combine (capacity is irrelevant
+at batch-per-step granularity).
+"""
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import (BATCH_AXES, EXPERT_AXIS, SEQ_AXIS,
+                                     TENSOR_AXIS, shard_constraint)
+from deepspeed_tpu.models.gpt import (GPTConfig, _block, _block_decode, _norm,
+                                      _attention, _rope, init_gpt_params,
+                                      gpt_param_specs, init_kv_cache)
+from deepspeed_tpu.parallel.moe import top1_gating
+from deepspeed_tpu.runtime.engine import ModelSpec
+
+
+@dataclasses.dataclass
+class MoEGPTConfig(GPTConfig):
+    num_experts: int = 8
+    moe_freq: int = 2                 # every moe_freq-th block is MoE (from block 1)
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    moe_aux_weight: float = 0.01
+
+    def moe_layer_ids(self):
+        return [i for i in range(self.n_layer) if i % self.moe_freq == 1]
+
+
+def init_moe_gpt_params(cfg: MoEGPTConfig, seed: int = 0, dtype=jnp.float32):
+    """Dense skeleton (stacked blocks, gpt.py layout) + per-MoE-layer expert
+    weights {layer_id: {gate_w, w_up [E,D,F], w_down [E,F,D]}}."""
+    params = init_gpt_params(cfg, seed=seed, dtype=dtype)
+    rng = np.random.default_rng(seed + 7)
+    D, F, E = cfg.d_model, cfg.d_ff, cfg.num_experts
+    moe = {}
+    for lid in cfg.moe_layer_ids():
+        moe[str(lid)] = {
+            "gate_w": jnp.asarray(rng.normal(0, 0.02, (D, E)), dtype),
+            "w_up": jnp.asarray(rng.normal(0, 0.02, (E, D, F)), dtype),
+            "b_up": jnp.zeros((E, F), dtype),
+            "w_down": jnp.asarray(rng.normal(0, 0.02 / np.sqrt(2 * cfg.n_layer),
+                                             (E, F, D)), dtype),
+            "b_down": jnp.zeros((E, D), dtype),
+        }
+    params["moe"] = moe
+    return params
+
+
+def moe_gpt_param_specs(cfg: MoEGPTConfig):
+    specs = gpt_param_specs(cfg)
+    e, t = EXPERT_AXIS, TENSOR_AXIS
+    moe_spec = {
+        "gate_w": P(None, None),
+        "w_up": P(e, None, t),
+        "b_up": P(e, t),
+        "w_down": P(e, t, None),
+        "b_down": P(e, None),
+    }
+    specs["moe"] = {str(lid): dict(moe_spec) for lid in cfg.moe_layer_ids()}
+    return specs
+
+
+def _expert_ffn(xe, mp, cfg):
+    """xe: [E, C, D] tokens per expert → [E, C, D]; batched expert FFN on the
+    expert mesh axis."""
+    h = jnp.einsum("ecd,edf->ecf", xe, mp["w_up"]) + mp["b_up"][:, None, :]
+    h = jax.nn.gelu(h) if cfg.activation == "gelu" else jax.nn.relu(h)
+    h = shard_constraint(h, EXPERT_AXIS, None, TENSOR_AXIS)
+    return jnp.einsum("ecf,efd->ecd", h, mp["w_down"]) + mp["b_down"][:, None, :]
+
+
+def _moe_mlp(x, mp, cfg: MoEGPTConfig, training=True):
+    """x: [B, T, D] → (out, l_aux). GShard dispatch/combine einsums."""
+    B, T, D = x.shape
+    xf = x.reshape(B * T, D)
+    logits = (xf @ mp["gate_w"]).astype(jnp.float32)
+    cf = cfg.capacity_factor if training else cfg.eval_capacity_factor
+    l_aux, dispatch, combine, _counts = top1_gating(
+        logits, capacity_factor=cf, min_capacity=cfg.min_capacity)
+    # dispatch: [N, E, C] — einsum routes tokens to expert slots; the sharding
+    # constraint on the expert dim makes XLA emit the a2a (reference _AllToAll)
+    xe = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), xf)
+    xe = shard_constraint(xe, EXPERT_AXIS, None, None)
+    ye = _expert_ffn(xe, mp, cfg)
+    out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), ye)
+    return out.reshape(B, T, D), l_aux
+
+
+def moe_gpt_forward(params, tokens, cfg: MoEGPTConfig, training=True, rng=None):
+    """[B, T] → (logits, total_l_aux). Python loop over layers (MoE layers break
+    the homogeneous scan; L is moderate for MoE models)."""
+    B, T = tokens.shape
+    x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    if not cfg.use_rotary and not cfg.use_alibi:
+        x = x + jnp.take(params["wpe"], positions, axis=0).astype(cfg.dtype)
+    x = shard_constraint(x, BATCH_AXES, SEQ_AXIS, None)
+
+    l_aux_total = jnp.asarray(0.0, jnp.float32)
+    moe_ids = set(cfg.moe_layer_ids())
+    for lid in range(cfg.n_layer):
+        p = jax.tree_util.tree_map(lambda a: a[lid], params["blocks"])
+        if lid in moe_ids:
+            # attention half from the dense block, MLP half replaced by MoE
+            x = _moe_block(x, p, params["moe"][str(lid)], cfg, positions, training)
+            x, l_aux = x
+            l_aux_total = l_aux_total + l_aux
+        else:
+            x = _block(x, p, cfg, positions)
+
+    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm,
+              cfg.norm_eps)
+    head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
+    logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+    return logits, l_aux_total
+
+
+def _moe_block(x, p, mp, cfg, positions, training):
+    """Transformer block with MoE MLP (attention identical to gpt._block)."""
+    import math
+    B, T, D = x.shape
+    H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm, cfg.norm_eps)
+    qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
+    q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
+    q = q.reshape(B, T, H, hd)
+    k = k.reshape(B, T, Hkv, hd)
+    v = v.reshape(B, T, Hkv, hd)
+    if cfg.use_rotary:
+        rd = int(cfg.rotary_pct * hd) // 2 * 2
+        q = _rope(q, positions, rd, cfg.rope_theta)
+        k = _rope(k, positions, rd, cfg.rope_theta)
+    causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
+    attn = _attention(q, k, v, causal, cfg).reshape(B, T, D)
+    x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
+
+    h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.use_rmsnorm, cfg.norm_eps)
+    moe_out, l_aux = _moe_mlp(h2, mp, cfg, training)
+    x = x + moe_out
+    return shard_constraint(x, BATCH_AXES, SEQ_AXIS, None), l_aux
+
+
+def moe_gpt_loss(params, batch, rng, cfg: MoEGPTConfig):
+    tokens = batch.get("tokens", batch.get("input_ids"))
+    labels = batch.get("labels")
+    if labels is None:
+        inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    else:
+        inputs = tokens
+    logits, l_aux = moe_gpt_forward(params, inputs, cfg, training=True, rng=rng)
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    safe = jnp.maximum(labels, 0)
+    gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    mask = (labels >= 0).astype(jnp.float32)
+    nll = ((logz - gold) * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return nll + cfg.moe_aux_weight * l_aux, {"lm_loss": nll, "l_aux": l_aux}
+
+
+def make_moe_gpt_model(cfg: MoEGPTConfig, name="moe-gpt", seed=0) -> ModelSpec:
+    params = init_moe_gpt_params(cfg, seed=seed)
+    return ModelSpec(loss_fn=partial(moe_gpt_loss, cfg=cfg), params=params,
+                     param_specs=moe_gpt_param_specs(cfg), has_aux=True,
+                     apply_fn=partial(moe_gpt_forward, cfg=cfg, training=False),
+                     name=name)
+
+
+# ----------------------------------------------------------------------
+# inference (expert-parallel decode — reference moe_inference.py)
+# ----------------------------------------------------------------------
+
+
+def _moe_mlp_decode(x, mp, cfg):
+    """Single-token routing: x [B, 1, D]; every token goes to its argmax expert
+    (capacity-free — one token per step cannot overflow)."""
+    B, _, D = x.shape
+    xf = x.reshape(B, D)
+    logits = (xf @ mp["gate_w"]).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top = jnp.argmax(probs, axis=-1)                       # [B]
+    gate = jnp.max(probs, axis=-1).astype(x.dtype)         # [B]
+    onehot = jax.nn.one_hot(top, cfg.num_experts, dtype=x.dtype)  # [B, E]
+    # dispatch every token to all experts' slots, mask by routing (E is small;
+    # trades E× FFN flops for static shapes — decode is bandwidth-bound anyway)
+    xe = jnp.einsum("be,bd->ebd", onehot, xf)              # [E, B, D]
+    ye = _expert_ffn(xe, mp, cfg)                          # [E, B, D]
+    out = jnp.einsum("be,ebd->bd", onehot, ye) * gate[:, None]
+    return out.reshape(B, 1, D)
+
+
+def make_moe_gpt_decode_model(cfg: MoEGPTConfig, params=None, name="moe-gpt", seed=0):
+    from deepspeed_tpu.inference.engine import DecodeModelSpec
+    if params is None:
+        params = init_moe_gpt_params(cfg, seed=seed)
+    moe_ids = set(cfg.moe_layer_ids())
+
+    def prefill_fn(params, tokens, cache, pad_mask):
+        B, T = tokens.shape
+        x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+        if not cfg.use_rotary and not cfg.use_alibi:
+            x = x + jnp.take(params["wpe"], positions, axis=0).astype(cfg.dtype)
+        ks, vs = [], []
+        H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+        for lid in range(cfg.n_layer):
+            p = jax.tree_util.tree_map(lambda a: a[lid], params["blocks"])
+            h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm,
+                      cfg.norm_eps)
+            qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
+            q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
+            q = q.reshape(B, T, H, hd)
+            k = k.reshape(B, T, Hkv, hd)
+            v = v.reshape(B, T, Hkv, hd)
+            if cfg.use_rotary:
+                rd = int(cfg.rotary_pct * hd) // 2 * 2
+                q = _rope(q, positions, rd, cfg.rope_theta)
+                k = _rope(k, positions, rd, cfg.rope_theta)
+            M = cache["k"].shape[3]
+            ks.append(jnp.moveaxis(k, 1, 2))
+            vs.append(jnp.moveaxis(v, 1, 2))
+            causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
+            attn = _attention(q, k, v, causal, cfg).reshape(B, T, cfg.d_model)
+            x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
+            h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.use_rmsnorm,
+                       cfg.norm_eps)
+            if lid in moe_ids:
+                out, _ = _moe_mlp(h2, params["moe"][str(lid)], cfg, training=False)
+                x = x + out
+            else:
+                from deepspeed_tpu.models.gpt import _mlp
+                x = x + _mlp(h2, p, cfg)
+        x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm,
+                  cfg.norm_eps)
+        head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
+        logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+        new_cache = {
+            "k": cache["k"].at[:, :, :, :T].set(jnp.stack(ks, 0).astype(cache["k"].dtype)),
+            "v": cache["v"].at[:, :, :, :T].set(jnp.stack(vs, 0).astype(cache["v"].dtype)),
+            "length": jnp.full((B,), T, jnp.int32),
+        }
+        return logits, new_cache
+
+    def decode_fn(params, token, pos, cache):
+        B = token.shape[0]
+        x = jnp.take(params["wte"], token[:, None], axis=0).astype(cfg.dtype)
+        if not cfg.use_rotary and not cfg.use_alibi:
+            x = x + jnp.take(params["wpe"], pos[:, None], axis=0).astype(cfg.dtype)
+        new_k, new_v = [], []
+        for lid in range(cfg.n_layer):
+            p = jax.tree_util.tree_map(lambda a: a[lid], params["blocks"])
+            if lid in moe_ids:
+                x, ck, cv = _moe_block_decode(x, p, params["moe"][str(lid)],
+                                              cache["k"][lid], cache["v"][lid],
+                                              pos, cfg)
+            else:
+                x, ck, cv = _block_decode(x, p, cache["k"][lid], cache["v"][lid],
+                                          pos, cfg)
+            new_k.append(ck)
+            new_v.append(cv)
+        x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm,
+                  cfg.norm_eps)
+        head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
+        logits = jnp.einsum("bod,vd->bov", x, head.astype(x.dtype))[:, 0]
+        cache_out = {"k": jnp.stack(new_k, 0), "v": jnp.stack(new_v, 0),
+                     "length": cache["length"] + 1}
+        return logits, cache_out
+
+    def init_cache(batch_size, max_len, dtype=jnp.bfloat16):
+        return init_kv_cache(cfg, batch_size, max_len, dtype)
+
+    return DecodeModelSpec(prefill_fn=prefill_fn, decode_fn=decode_fn,
+                           init_cache=init_cache, params=params, name=name)
+
+
+def _moe_block_decode(x, p, mp, cache_k, cache_v, pos, cfg):
+    """_block_decode with the MLP replaced by single-token MoE routing."""
+    import math as _math
+    B, _, D = x.shape
+    H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    M = cache_k.shape[2]
+    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm, cfg.norm_eps)
+    qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
+    q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
+    q = q.reshape(B, 1, H, hd)
+    k = k.reshape(B, 1, Hkv, hd)
+    v = v.reshape(B, 1, Hkv, hd)
+    if cfg.use_rotary:
+        rd = int(cfg.rotary_pct * hd) // 2 * 2
+        q = _rope(q, pos[:, None], rd, cfg.rope_theta)
+        k = _rope(k, pos[:, None], rd, cfg.rope_theta)
+    onehot = jax.nn.one_hot(pos, M, dtype=k.dtype)
+    k_new = jnp.moveaxis(k, 1, 2)
+    v_new = jnp.moveaxis(v, 1, 2)
+    cache_k = cache_k * (1 - onehot)[:, None, :, None] + onehot[:, None, :, None] * k_new
+    cache_v = cache_v * (1 - onehot)[:, None, :, None] + onehot[:, None, :, None] * v_new
+    scale = 1.0 / _math.sqrt(hd)
+    valid = (jnp.arange(M)[None, :] <= pos[:, None])
+    G = H // Hkv
+    qg = q.reshape(B, Hkv, G, hd)
+    logits = jnp.einsum("bkgd,bkmd->bkgm", qg, cache_k).astype(jnp.float32) * scale
+    logits = jnp.where(valid[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    attn = jnp.einsum("bkgm,bkmd->bkgd", probs, cache_v).reshape(B, 1, D)
+    x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
+    h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.use_rmsnorm, cfg.norm_eps)
+    x = x + _moe_mlp_decode(h2, mp, cfg)
+    return x, cache_k, cache_v
