@@ -1,0 +1,53 @@
+"""`ds_elastic` CLI — inspect an elastic config and its admissible world sizes.
+
+Behavioral analog of the reference's `bin/ds_elastic` (argparse over a config
+json, prints elasticity block + computed final batch / valid device counts /
+micro-batch for an intended world size).
+"""
+
+import argparse
+import json
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="deepspeed-tpu elasticity inspector")
+    parser.add_argument("-c", "--config", type=str, required=True,
+                        help="deepspeed-tpu config json")
+    parser.add_argument("-w", "--world-size", type=int, default=0,
+                        help="intended/current world size (device count)")
+    args = parser.parse_args(argv)
+
+    with open(args.config) as f:
+        ds_config = json.load(f)
+
+    print("-" * 42)
+    print("Elasticity config:")
+    print("-" * 42)
+    print(json.dumps(ds_config.get("elasticity", {}), indent=4, sort_keys=True))
+
+    if args.world_size > 0:
+        final_batch_size, valid_gpus, micro_batch_size = compute_elastic_config(
+            ds_config=ds_config, target_deepspeed_version=deepspeed_tpu.__version__,
+            world_size=args.world_size, return_microbatch=True)
+        print("-" * 42)
+        print(f"Calculated results for world size {args.world_size}:")
+        print("-" * 42)
+        print(f"final_batch_size .... {final_batch_size}")
+        print(f"valid_device_counts . {valid_gpus}")
+        print(f"micro_batch_size .... {micro_batch_size}")
+    else:
+        final_batch_size, valid_gpus = compute_elastic_config(
+            ds_config=ds_config, target_deepspeed_version=deepspeed_tpu.__version__)
+        print("-" * 42)
+        print("Calculated results:")
+        print("-" * 42)
+        print(f"final_batch_size .... {final_batch_size}")
+        print(f"valid_device_counts . {valid_gpus}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

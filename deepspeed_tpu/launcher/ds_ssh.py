@@ -1,0 +1,59 @@
+"""`ds_ssh` CLI — run a command on every host of a hostfile.
+
+Behavioral analog of the reference's `bin/ds_ssh` (pdsh fan-out over the
+hostfile's first column). Uses pdsh when available, otherwise a plain
+ssh-per-host loop, so it works on minimal images.
+"""
+
+import argparse
+import shlex
+import shutil
+import subprocess
+import sys
+
+from deepspeed_tpu.launcher.runner import fetch_hostfile
+
+DEFAULT_HOSTFILE = "/job/hostfile"
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="run a command on all hosts in a hostfile")
+    parser.add_argument("-f", "--hostfile", default=DEFAULT_HOSTFILE,
+                        help="hostfile path (default: /job/hostfile)")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command to run on every host")
+    args = parser.parse_args(argv)
+
+    if not args.command:
+        parser.error("no command given")
+
+    resources = fetch_hostfile(args.hostfile)
+    if not resources:
+        print(f"Missing or empty hostfile at {args.hostfile}, unable to proceed",
+              file=sys.stderr)
+        return 1
+    hosts = list(resources.keys())
+
+    cmd = " ".join(shlex.quote(c) for c in args.command)
+    if shutil.which("pdsh"):
+        env = {"PDSH_RCMD_TYPE": "ssh"}
+        full = ["pdsh", "-w", ",".join(hosts), cmd]
+        import os
+        return subprocess.call(full, env={**os.environ, **env})
+
+    rc = 0
+    for host in hosts:
+        proc = subprocess.run(["ssh", "-n", "-o", "StrictHostKeyChecking=no", host, cmd],
+                              stdin=subprocess.DEVNULL, capture_output=True, text=True)
+        prefix = f"{host}: "
+        for line in proc.stdout.splitlines():
+            print(prefix + line)
+        for line in proc.stderr.splitlines():
+            print(prefix + line, file=sys.stderr)
+        rc = rc or proc.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

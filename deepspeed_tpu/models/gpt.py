@@ -276,7 +276,7 @@ def _attention(q, k, v, causal_mask, cfg, attn_fn=None, bias=None):
     materializing repeated k/v (reference serves GQA models like llama2-70b via
     `module_inject/containers/llama2.py`). `bias`: additive [H, T, S] (alibi)."""
     if attn_fn is None and cfg.use_flash_attention and bias is None \
-            and q.shape[1] % 128 == 0:
+            and not cfg.sliding_window and q.shape[1] % 128 == 0:
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
         attn_fn = partial(flash_attention, causal=True)
     if attn_fn is not None:
@@ -312,22 +312,27 @@ def _mlp(h, p, cfg, constrain=True):
     return up @ p["mlp_down_w"] + p["mlp_out_b"]
 
 
-def _block(x, p, cfg: GPTConfig, positions, dropout_rng=None, attn_fn=None):
-    """One transformer block. x: [B, T, D]."""
+def _attn_half(x, p, cfg: GPTConfig, positions, attn_fn=None, constrain=True):
+    """Attention half-block: ln1 → qkv → rope → masked attention → out-proj.
+
+    Returns (attn_out, k, v) with k/v [B, T, Hkv, hd] so decode-model prefill
+    can write them into the KV cache. Every architecture flag (rotary, alibi,
+    sliding window, GQA) is honored here, in ONE place, for the training
+    forward, the MoE blocks, and the inference prefill alike."""
     B, T, D = x.shape
     H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
-    use_rms = cfg.use_rmsnorm
 
-    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), use_rms, cfg.norm_eps)
+    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm, cfg.norm_eps)
     qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
     q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
     q = q.reshape(B, T, H, hd)
     k = k.reshape(B, T, Hkv, hd)
     v = v.reshape(B, T, Hkv, hd)
-    # activations: heads on tensor axis (Megatron), seq on sequence axis
-    q = shard_constraint(q, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
-    k = shard_constraint(k, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
-    v = shard_constraint(v, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
+    if constrain:
+        # activations: heads on tensor axis (Megatron), seq on sequence axis
+        q = shard_constraint(q, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
+        k = shard_constraint(k, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
+        v = shard_constraint(v, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
     if cfg.use_rotary:
         rd = int(cfg.rotary_pct * hd) // 2 * 2
         q = _rope(q, positions, rd, cfg.rope_theta)
@@ -340,32 +345,48 @@ def _block(x, p, cfg: GPTConfig, positions, dropout_rng=None, attn_fn=None):
     # alibi uses in-sequence distances (standard unpadded formulation)
     bias = _alibi_bias(cfg, t_pos, t_pos) if cfg.use_alibi else None
     attn = _attention(q, k, v, causal, cfg, attn_fn=attn_fn, bias=bias)
-    attn = attn.reshape(B, T, D)
-    attn_out = attn @ p["attn_out_w"] + p["attn_out_b"]
+    attn_out = attn.reshape(B, T, D) @ p["attn_out_w"] + p["attn_out_b"]
+    return attn_out, k, v
 
+
+def _residual_mlp(x, attn_out, p, cfg: GPTConfig, constrain=True, mlp_fn=None):
+    """Residual second half of a block; `mlp_fn` lets MoE swap the dense MLP."""
+    if mlp_fn is None:
+        mlp_fn = lambda h: _mlp(h, p, cfg, constrain)
+    use_rms = cfg.use_rmsnorm
     if cfg.parallel_residual:
         # NeoX/GPT-J: both halves read the block INPUT (GPT-J ties ln2 == ln1)
         h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
-        x = x + attn_out + _mlp(h2, p, cfg)
-    else:
-        x = x + attn_out
-        h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
-        x = x + _mlp(h2, p, cfg)
+        return x + attn_out + mlp_fn(h2)
+    x = x + attn_out
+    h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
+    return x + mlp_fn(h2)
+
+
+def _embed(params, tokens, positions, cfg: GPTConfig):
+    """Token embedding + (absolute) position embedding + BLOOM emb LayerNorm."""
+    x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
+    if not cfg.use_rotary and not cfg.use_alibi:
+        x = x + jnp.take(params["wpe"], positions, axis=0).astype(cfg.dtype)
+    if cfg.use_emb_ln:  # BLOOM word-embedding LayerNorm
+        x = _norm(x, params["emb_ln_scale"], params.get("emb_ln_bias"),
+                  use_rms=False, eps=cfg.norm_eps)
+    return x
+
+
+def _block(x, p, cfg: GPTConfig, positions, dropout_rng=None, attn_fn=None):
+    """One transformer block. x: [B, T, D]."""
+    attn_out, _, _ = _attn_half(x, p, cfg, positions, attn_fn=attn_fn)
+    x = _residual_mlp(x, attn_out, p, cfg)
     return shard_constraint(x, BATCH_AXES, SEQ_AXIS, None)
 
 
 def gpt_forward(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None):
     """tokens: [B, T] int32 → logits [B, T, vocab]."""
     B, T = tokens.shape
-    dtype = cfg.dtype
-    x = jnp.take(params["wte"], tokens, axis=0).astype(dtype)
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
-    if not cfg.use_rotary and not cfg.use_alibi:
-        x = x + jnp.take(params["wpe"], positions, axis=0).astype(dtype)
-    if cfg.use_emb_ln:  # BLOOM word-embedding LayerNorm
-        x = _norm(x, params["emb_ln_scale"], params.get("emb_ln_bias"),
-                  use_rms=False, eps=cfg.norm_eps)
+    x = _embed(params, tokens, positions, cfg)
     x = shard_constraint(x, BATCH_AXES, SEQ_AXIS, None)
 
     block_fn = partial(_block, cfg=cfg, positions=positions, attn_fn=attn_fn)
@@ -431,9 +452,10 @@ def init_kv_cache(cfg: GPTConfig, batch_size, max_len, dtype=jnp.bfloat16):
             "length": jnp.zeros((batch_size,), jnp.int32)}
 
 
-def _block_decode(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
-    """Single-token decode for one block. x: [B, 1, D]; cache_[kv]: [B, Hkv, M, hd];
-    pos: [B] current position."""
+def _decode_attn_half(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
+    """Single-token attention half: writes k/v at `pos` into the head-major
+    cache and attends over it. x: [B, 1, D]; cache_[kv]: [B, Hkv, M, hd];
+    pos: [B]. Returns (attn_out, cache_k, cache_v)."""
     B, _, D = x.shape
     H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
     M = cache_k.shape[2]
@@ -479,14 +501,13 @@ def _block_decode(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         attn = jnp.einsum("bkgm,bkmd->bkgd", probs, cache_v).reshape(B, 1, D)
     attn_out = attn @ p["attn_out_w"] + p["attn_out_b"]
+    return attn_out, cache_k, cache_v
 
-    if cfg.parallel_residual:
-        h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
-        x = x + attn_out + _mlp(h2, p, cfg, constrain=False)
-    else:
-        x = x + attn_out
-        h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
-        x = x + _mlp(h2, p, cfg, constrain=False)
+
+def _block_decode(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
+    """Single-token decode for one block."""
+    attn_out, cache_k, cache_v = _decode_attn_half(x, p, cache_k, cache_v, pos, cfg)
+    x = _residual_mlp(x, attn_out, p, cfg, constrain=False)
     return x, cache_k, cache_v
 
 
@@ -500,35 +521,15 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
     def prefill_fn(params, tokens, cache, pad_mask):
         B, T = tokens.shape
         # single pass: compute activations AND populate the KV cache in one scan
-        x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
-        if not cfg.use_rotary:
-            x = x + jnp.take(params["wpe"], positions, axis=0).astype(cfg.dtype)
+        x = _embed(params, tokens, positions, cfg)
 
         def body(x, inputs):
             p, ck, cv = inputs
-            h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm, cfg.norm_eps)
-            qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
-            H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
-            q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
-            q = q.reshape(B, T, H, hd)
-            k = k.reshape(B, T, Hkv, hd)
-            v = v.reshape(B, T, Hkv, hd)
-            if cfg.use_rotary:
-                rd = int(cfg.rotary_pct * hd) // 2 * 2
-                q = _rope(q, positions, rd, cfg.rope_theta)
-                k = _rope(k, positions, rd, cfg.rope_theta)
+            attn_out, k, v = _attn_half(x, p, cfg, positions)
             ck = ck.at[:, :, :T].set(jnp.moveaxis(k, 1, 2).astype(ck.dtype))
             cv = cv.at[:, :, :T].set(jnp.moveaxis(v, 1, 2).astype(cv.dtype))
-            causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
-            attn = _attention(q, k, v, causal, cfg).reshape(B, T, cfg.d_model)
-            x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
-            h = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.use_rmsnorm, cfg.norm_eps)
-            if cfg.use_swiglu:
-                up = jax.nn.silu(h @ p["mlp_gate_w"]) * (h @ p["mlp_up_w"])
-            else:
-                up = jax.nn.gelu(h @ p["mlp_up_w"] + p["mlp_up_b"])
-            x = x + up @ p["mlp_down_w"] + p["mlp_out_b"]
+            x = _residual_mlp(x, attn_out, p, cfg)
             return x, (ck, cv)
 
         x, (ks, vs) = jax.lax.scan(
@@ -544,9 +545,7 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
 
     def decode_fn(params, token, pos, cache):
         B = token.shape[0]
-        x = jnp.take(params["wte"], token[:, None], axis=0).astype(cfg.dtype)
-        if not cfg.use_rotary:
-            x = x + jnp.take(params["wpe"], pos[:, None], axis=0).astype(cfg.dtype)
+        x = _embed(params, token[:, None], pos[:, None], cfg)
 
         def body(x, inputs):
             p, ck, cv = inputs

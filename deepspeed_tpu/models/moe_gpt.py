@@ -25,9 +25,11 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.mesh import (BATCH_AXES, EXPERT_AXIS, SEQ_AXIS,
                                      TENSOR_AXIS, shard_constraint)
-from deepspeed_tpu.models.gpt import (GPTConfig, _block, _block_decode, _norm,
-                                      _attention, _rope, init_gpt_params,
-                                      gpt_param_specs, init_kv_cache)
+from deepspeed_tpu.models.gpt import (GPTConfig, _attn_half, _block,
+                                      _block_decode, _decode_attn_half, _embed,
+                                      _norm, _residual_mlp,
+                                      init_gpt_params, gpt_param_specs,
+                                      init_kv_cache)
 from deepspeed_tpu.parallel.moe import top1_gating
 from deepspeed_tpu.runtime.engine import ModelSpec
 
@@ -109,10 +111,8 @@ def moe_gpt_forward(params, tokens, cfg: MoEGPTConfig, training=True, rng=None):
     """[B, T] → (logits, total_l_aux). Python loop over layers (MoE layers break
     the homogeneous scan; L is moderate for MoE models)."""
     B, T = tokens.shape
-    x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
-    if not cfg.use_rotary and not cfg.use_alibi:
-        x = x + jnp.take(params["wpe"], positions, axis=0).astype(cfg.dtype)
+    x = _embed(params, tokens, positions, cfg)
     x = shard_constraint(x, BATCH_AXES, SEQ_AXIS, None)
 
     l_aux_total = jnp.asarray(0.0, jnp.float32)
@@ -135,28 +135,18 @@ def moe_gpt_forward(params, tokens, cfg: MoEGPTConfig, training=True, rng=None):
 
 
 def _moe_block(x, p, mp, cfg, positions, training):
-    """Transformer block with MoE MLP (attention identical to gpt._block)."""
-    import math
-    B, T, D = x.shape
-    H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
-    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm, cfg.norm_eps)
-    qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
-    q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
-    q = q.reshape(B, T, H, hd)
-    k = k.reshape(B, T, Hkv, hd)
-    v = v.reshape(B, T, Hkv, hd)
-    if cfg.use_rotary:
-        rd = int(cfg.rotary_pct * hd) // 2 * 2
-        q = _rope(q, positions, rd, cfg.rope_theta)
-        k = _rope(k, positions, rd, cfg.rope_theta)
-    causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
-    attn = _attention(q, k, v, causal, cfg).reshape(B, T, D)
-    x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
+    """Transformer block with MoE MLP (attention half shared with gpt._block,
+    so alibi/sliding-window/parallel-residual behave identically)."""
+    aux = []
 
-    h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.use_rmsnorm, cfg.norm_eps)
-    moe_out, l_aux = _moe_mlp(h2, mp, cfg, training)
-    x = x + moe_out
-    return shard_constraint(x, BATCH_AXES, SEQ_AXIS, None), l_aux
+    def moe_fn(h):
+        out, l_aux = _moe_mlp(h, mp, cfg, training)
+        aux.append(l_aux)
+        return out
+
+    attn_out, _, _ = _attn_half(x, p, cfg, positions)
+    x = _residual_mlp(x, attn_out, p, cfg, mlp_fn=moe_fn)
+    return shard_constraint(x, BATCH_AXES, SEQ_AXIS, None), aux[0]
 
 
 def moe_gpt_loss(params, batch, rng, cfg: MoEGPTConfig):
@@ -215,39 +205,20 @@ def make_moe_gpt_decode_model(cfg: MoEGPTConfig, params=None, name="moe-gpt", se
 
     def prefill_fn(params, tokens, cache, pad_mask):
         B, T = tokens.shape
-        x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
-        if not cfg.use_rotary and not cfg.use_alibi:
-            x = x + jnp.take(params["wpe"], positions, axis=0).astype(cfg.dtype)
+        x = _embed(params, tokens, positions, cfg)
         ks, vs = [], []
-        H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
         for lid in range(cfg.n_layer):
             p = jax.tree_util.tree_map(lambda a: a[lid], params["blocks"])
-            h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm,
-                      cfg.norm_eps)
-            qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
-            q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
-            q = q.reshape(B, T, H, hd)
-            k = k.reshape(B, T, Hkv, hd)
-            v = v.reshape(B, T, Hkv, hd)
-            if cfg.use_rotary:
-                rd = int(cfg.rotary_pct * hd) // 2 * 2
-                q = _rope(q, positions, rd, cfg.rope_theta)
-                k = _rope(k, positions, rd, cfg.rope_theta)
-            M = cache["k"].shape[3]
+            attn_out, k, v = _attn_half(x, p, cfg, positions)
             ks.append(jnp.moveaxis(k, 1, 2))
             vs.append(jnp.moveaxis(v, 1, 2))
-            causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
-            attn = _attention(q, k, v, causal, cfg).reshape(B, T, cfg.d_model)
-            x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
-            h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.use_rmsnorm,
-                       cfg.norm_eps)
             if lid in moe_ids:
-                out, _ = _moe_mlp(h2, params["moe"][str(lid)], cfg, training=False)
-                x = x + out
+                mp = params["moe"][str(lid)]
+                moe_fn = lambda h, mp=mp: _moe_mlp(h, mp, cfg, training=False)[0]
+                x = _residual_mlp(x, attn_out, p, cfg, mlp_fn=moe_fn)
             else:
-                from deepspeed_tpu.models.gpt import _mlp
-                x = x + _mlp(h2, p, cfg)
+                x = _residual_mlp(x, attn_out, p, cfg)
         x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm,
                   cfg.norm_eps)
         head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
@@ -261,9 +232,7 @@ def make_moe_gpt_decode_model(cfg: MoEGPTConfig, params=None, name="moe-gpt", se
 
     def decode_fn(params, token, pos, cache):
         B = token.shape[0]
-        x = jnp.take(params["wte"], token[:, None], axis=0).astype(cfg.dtype)
-        if not cfg.use_rotary and not cfg.use_alibi:
-            x = x + jnp.take(params["wpe"], pos[:, None], axis=0).astype(cfg.dtype)
+        x = _embed(params, token[:, None], pos[:, None], cfg)
         new_k, new_v = [], []
         for lid in range(cfg.n_layer):
             p = jax.tree_util.tree_map(lambda a: a[lid], params["blocks"])
@@ -293,34 +262,7 @@ def make_moe_gpt_decode_model(cfg: MoEGPTConfig, params=None, name="moe-gpt", se
 
 def _moe_block_decode(x, p, mp, cache_k, cache_v, pos, cfg):
     """_block_decode with the MLP replaced by single-token MoE routing."""
-    import math as _math
-    B, _, D = x.shape
-    H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
-    M = cache_k.shape[2]
-    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm, cfg.norm_eps)
-    qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
-    q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
-    q = q.reshape(B, 1, H, hd)
-    k = k.reshape(B, 1, Hkv, hd)
-    v = v.reshape(B, 1, Hkv, hd)
-    if cfg.use_rotary:
-        rd = int(cfg.rotary_pct * hd) // 2 * 2
-        q = _rope(q, pos[:, None], rd, cfg.rope_theta)
-        k = _rope(k, pos[:, None], rd, cfg.rope_theta)
-    onehot = jax.nn.one_hot(pos, M, dtype=k.dtype)
-    k_new = jnp.moveaxis(k, 1, 2)
-    v_new = jnp.moveaxis(v, 1, 2)
-    cache_k = cache_k * (1 - onehot)[:, None, :, None] + onehot[:, None, :, None] * k_new
-    cache_v = cache_v * (1 - onehot)[:, None, :, None] + onehot[:, None, :, None] * v_new
-    scale = 1.0 / _math.sqrt(hd)
-    valid = (jnp.arange(M)[None, :] <= pos[:, None])
-    G = H // Hkv
-    qg = q.reshape(B, Hkv, G, hd)
-    logits = jnp.einsum("bkgd,bkmd->bkgm", qg, cache_k).astype(jnp.float32) * scale
-    logits = jnp.where(valid[:, None, None, :], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    attn = jnp.einsum("bkgm,bkmd->bkgd", probs, cache_v).reshape(B, 1, D)
-    x = x + attn @ p["attn_out_w"] + p["attn_out_b"]
-    h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), cfg.use_rmsnorm, cfg.norm_eps)
-    x = x + _moe_mlp_decode(h2, mp, cfg)
+    attn_out, cache_k, cache_v = _decode_attn_half(x, p, cache_k, cache_v, pos, cfg)
+    x = _residual_mlp(x, attn_out, p, cfg, constrain=False,
+                      mlp_fn=lambda h: _moe_mlp_decode(h, mp, cfg))
     return x, cache_k, cache_v

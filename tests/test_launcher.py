@@ -157,3 +157,43 @@ class TestElasticAgent:
             bad += 1
         with pytest.raises(ElasticityIncompatibleWorldSize):
             compute_elastic_config(self.DS_CONFIG, world_size=bad)
+
+
+class TestCliSuite:
+    """bin/ CLI suite (reference: bin/ds_elastic, bin/ds_ssh, bin/ds_report)."""
+
+    def test_ds_elastic_cli(self, tmp_path, capsys):
+        from deepspeed_tpu.elasticity.cli import main
+        cfg = tmp_path / "ds.json"
+        cfg.write_text(json.dumps({
+            "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                           "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                           "max_gpus": 32}}))
+        assert main(["-c", str(cfg), "-w", "2"]) == 0
+        out = capsys.readouterr().out
+        assert "final_batch_size" in out and "micro_batch_size" in out
+
+    def test_ds_ssh_hostfile_missing(self, tmp_path, capsys):
+        from deepspeed_tpu.launcher.ds_ssh import main
+        assert main(["-f", str(tmp_path / "nope"), "echo", "hi"]) == 1
+
+    def test_bin_scripts_exist_and_shim(self):
+        import pathlib
+        bin_dir = pathlib.Path(__file__).parent.parent / "bin"
+        for name in ("dstpu", "dstpu_report", "dstpu_bench", "dstpu_elastic",
+                     "dstpu_ssh"):
+            script = bin_dir / name
+            assert script.exists(), name
+            assert "main" in script.read_text()
+
+    def test_pyproject_entry_points_resolve(self):
+        import importlib
+        import pathlib
+        import tomllib
+        root = pathlib.Path(__file__).parent.parent
+        with open(root / "pyproject.toml", "rb") as f:
+            proj = tomllib.load(f)
+        for target in proj["project"]["scripts"].values():
+            mod_name, func = target.split(":")
+            mod = importlib.import_module(mod_name)
+            assert callable(getattr(mod, func))
