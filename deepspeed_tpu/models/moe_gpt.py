@@ -257,7 +257,8 @@ def make_moe_gpt_decode_model(cfg: MoEGPTConfig, params=None, name="moe-gpt", se
         return init_kv_cache(cfg, batch_size, max_len, dtype)
 
     return DecodeModelSpec(prefill_fn=prefill_fn, decode_fn=decode_fn,
-                           init_cache=init_cache, params=params, name=name)
+                           init_cache=init_cache, params=params,
+                           param_specs=moe_gpt_param_specs(cfg), name=name)
 
 
 def _moe_block_decode(x, p, mp, cache_k, cache_v, pos, cfg):

@@ -777,6 +777,18 @@ class Engine:
         m = self._last_metrics
         return float(m["grad_norm"]) if "grad_norm" in m else None
 
+    def sparse_gradients_enabled(self):
+        return bool(self.config.sparse_gradients)
+
+    def sparse_allreduce(self, sparse_tensor, axis=None):
+        """Sum a row-sparse (embedding) gradient over the DP axes by exchanging
+        (indices, values) instead of the dense buffer (reference
+        `sparse_allreduce_no_retain`, engine.py:2427). Accepts a
+        `runtime.sparse_tensor.SparseTensor`; see `sparse_embedding_grad` for
+        producing one from a loss."""
+        from deepspeed_tpu.runtime.sparse_tensor import sparse_all_reduce
+        return sparse_all_reduce(sparse_tensor, axis=axis)
+
     def deepspeed_io(self, dataset, batch_size=None, collate_fn=None, shuffle=True):
         """Build the training dataloader (reference `engine.deepspeed_io`,
         engine.py:1661): global batch = micro_bs × dp × gas per train_batch call."""

@@ -143,3 +143,31 @@ def test_moe_decode_parity_arch_flags():
         ref.append(np.asarray(nxt))
         cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(out, np.stack(ref, axis=1))
+
+
+def test_moe_inference_expert_parallel():
+    """Expert-parallel MoE inference: expert weights sharded over the `expert`
+    mesh axis (reference `inference/engine.py:260` _create_ep_parallel_group +
+    `moe_inference.py` containers); generation matches the ep=1 rollout."""
+    from deepspeed_tpu.models.moe_gpt import MoEGPTConfig, make_moe_gpt_decode_model
+    cfg = MoEGPTConfig(n_layer=2, n_head=4, d_model=64, max_seq_len=64,
+                       vocab_size=128, dtype=jnp.float32, remat=False,
+                       num_experts=4, moe_freq=2)
+    toks = np.random.default_rng(5).integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+
+    _mk_mesh(data=1)
+    spec1 = make_moe_gpt_decode_model(cfg, seed=6)
+    eng1 = init_inference(model=spec1, config={"dtype": "float32",
+                                               "kv_cache_dtype": "float32",
+                                               "greedy": True})
+    ref = eng1.generate(toks, max_new_tokens=4)
+
+    _mk_mesh(expert=4, data=2)
+    spec = make_moe_gpt_decode_model(cfg, seed=6)
+    engine = init_inference(model=spec, config={"dtype": "float32",
+                                                "kv_cache_dtype": "float32",
+                                                "greedy": True})
+    wup = engine.params["moe"]["1"]["w_up"]
+    assert "expert" in str(wup.sharding.spec), wup.sharding
+    out = engine.generate(toks, max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
