@@ -1,1 +1,4 @@
 from deepspeed_tpu.autotuning.autotuner import Autotuner
+from deepspeed_tpu.autotuning.tuner import (BaseTuner, CostModel,
+                                            GridSearchTuner, ModelBasedTuner,
+                                            RandomTuner, make_tuner)

@@ -46,7 +46,7 @@ class Autotuner:
 
     # ---- single experiment ----
 
-    def _run_experiment(self, stage, micro_batch):
+    def _run_experiment(self, stage, micro_batch, extra=None):
         import jax
         import deepspeed_tpu
         from deepspeed_tpu.comm import mesh as mesh_mod
@@ -56,6 +56,8 @@ class Autotuner:
         cfg["train_micro_batch_size_per_gpu"] = micro_batch
         cfg.setdefault("zero_optimization", {})["stage"] = stage
         cfg["gradient_accumulation_steps"] = 1
+        for k, v in (extra or {}).items():
+            cfg[k] = v
         engine = None
         try:
             model = self.model_factory()
@@ -113,6 +115,40 @@ class Autotuner:
             else:
                 hi = mid - 1
         return best
+
+    def _run_config(self, exp):
+        """Tuner protocol adapter: run one experiment dict of config overrides
+        ({"zero_stage": s, "micro_batch": m, **flat config keys}) and return
+        the metric value (higher is better) or None if infeasible."""
+        rec = self._run_experiment(exp.get("zero_stage", 0),
+                                   exp.get("micro_batch", 1),
+                                   extra={k: v for k, v in exp.items()
+                                          if k not in ("zero_stage", "micro_batch")})
+        if rec["status"] != "ok":
+            return None
+        return (rec["samples_per_sec"] if self.metric == "throughput"
+                else -rec["step_ms"])
+
+    def tune_space(self, exps, tuner_type="model_based", sample_size=1,
+                   n_trials=None, early_stopping=None, **tuner_kw):
+        """Explore an explicit experiment list with a tuner (reference
+        `autotuning/tuner/`: gridsearch | random | model_based). Each exp is a
+        dict of overrides; returns (tuned_config, best_record)."""
+        from deepspeed_tpu.autotuning.tuner import make_tuner
+        tuner = make_tuner(tuner_type, exps, self._run_config, **tuner_kw)
+        best_exp, best_val = tuner.tune(sample_size=sample_size, n_trials=n_trials,
+                                        early_stopping=early_stopping)
+        if best_exp is None:
+            raise RuntimeError("autotuning: no feasible configuration found")
+        tuned = copy.deepcopy(self.base_config)
+        tuned["train_micro_batch_size_per_gpu"] = best_exp.get("micro_batch", 1)
+        tuned.setdefault("zero_optimization", {})["stage"] = best_exp.get("zero_stage", 0)
+        for k, v in best_exp.items():
+            if k not in ("zero_stage", "micro_batch"):
+                tuned[k] = v
+        logger.info(f"autotune({tuner_type}) best: {best_exp} -> {best_val:.2f}")
+        return tuned, {"exp": best_exp, "metric_val": best_val,
+                       "trials": len(tuner.observed)}
 
     def tune(self):
         """Reference `Autotuner.tune` (`autotuner.py:404`)."""

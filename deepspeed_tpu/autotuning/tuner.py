@@ -1,0 +1,179 @@
+"""Experiment tuners for autotuning — grid / random / cost-model-guided.
+
+Reference: `deepspeed/autotuning/tuner/` — `index_based_tuner.py`
+(RandomTuner, GridSearchTuner over an experiment list), `model_based_tuner.py`
+(ModelBasedTuner guided by a fitted cost model) and `cost_model.py`
+(XGBoostCostModel). The TPU build keeps the same tuner protocol but fits a
+dependency-free ridge regression on one-hot/numeric experiment features
+instead of xgboost — the search spaces here (ZeRO stage × micro-batch ×
+offload flags) are small enough that a linear surrogate ranks them well.
+
+Protocol: `run_fn(exp: dict) -> float | None` returns the measured metric
+(higher is better; e.g. samples/sec) or None when the config is infeasible
+(OOM). `tuner.tune(...)` explores the experiment list and tracks the best.
+"""
+
+import random
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class CostModel:
+    """Ridge regression over featurized experiment dicts (reference
+    `cost_model.py` XGBoostCostModel role)."""
+
+    def __init__(self, l2: float = 1e-3):
+        self.l2 = l2
+        self._keys = None
+        self._vocab = {}
+        self._w = None
+
+    def _featurize(self, exps: List[Dict]):
+        if self._keys is None:
+            self._keys = sorted({k for e in exps for k in e})
+            for k in self._keys:
+                vals = {e[k] for e in exps if k in e and not isinstance(e[k], (int, float, bool))}
+                if vals:
+                    self._vocab[k] = sorted(vals, key=str)
+        feats = []
+        for e in exps:
+            row = []
+            for k in self._keys:
+                v = e.get(k, 0)
+                if k in self._vocab:
+                    row.extend(1.0 if v == c else 0.0 for c in self._vocab[k])
+                else:
+                    row.append(float(v))
+            feats.append(row)
+        x = np.asarray(feats, np.float64)
+        return np.concatenate([x, np.ones((x.shape[0], 1))], axis=1)  # bias col
+
+    def fit(self, exps: List[Dict], y):
+        x = self._featurize(exps)
+        y = np.asarray(y, np.float64)
+        a = x.T @ x + self.l2 * np.eye(x.shape[1])
+        self._w = np.linalg.solve(a, x.T @ y)
+        return self
+
+    def predict(self, exps: List[Dict]):
+        assert self._w is not None, "fit() first"
+        return self._featurize(exps) @ self._w
+
+
+class BaseTuner:
+    """Sequential explorer over an experiment list (reference `base_tuner.py`)."""
+
+    def __init__(self, exps: List[Dict], run_fn: Callable[[Dict], Optional[float]],
+                 metric: str = "throughput"):
+        self.all_exps = list(exps)
+        self.remaining = list(exps)
+        self.run_fn = run_fn
+        self.metric = metric
+        self.observed: List[Dict] = []
+        self.observed_vals: List[float] = []
+        self.best_exp: Optional[Dict] = None
+        self.best_metric_val: Optional[float] = None
+
+    def has_next(self):
+        return bool(self.remaining)
+
+    def next_batch(self, sample_size=1) -> List[Dict]:
+        raise NotImplementedError
+
+    def update(self):
+        """Hook after each measured batch (model refit etc.)."""
+
+    def tune(self, sample_size=1, n_trials=None, early_stopping=None):
+        """Run up to `n_trials` experiments; stop after `early_stopping`
+        consecutive non-improving trials. Returns (best_exp, best_val)."""
+        budget = n_trials if n_trials is not None else len(self.all_exps)
+        stale = 0
+        while self.has_next() and budget > 0:
+            batch = self.next_batch(min(sample_size, budget))
+            for exp in batch:
+                val = self.run_fn(exp)
+                budget -= 1
+                if val is None:
+                    continue
+                self.observed.append(exp)
+                self.observed_vals.append(float(val))
+                if self.best_metric_val is None or val > self.best_metric_val:
+                    self.best_exp, self.best_metric_val = exp, float(val)
+                    stale = 0
+                else:
+                    stale += 1
+            self.update()
+            if early_stopping is not None and stale >= early_stopping:
+                break
+        return self.best_exp, self.best_metric_val
+
+
+class GridSearchTuner(BaseTuner):
+    """In-order sweep (reference `index_based_tuner.py` GridSearchTuner)."""
+
+    def next_batch(self, sample_size=1):
+        batch, self.remaining = (self.remaining[:sample_size],
+                                 self.remaining[sample_size:])
+        return batch
+
+
+class RandomTuner(BaseTuner):
+    """Uniform random order (reference RandomTuner)."""
+
+    def __init__(self, exps, run_fn, metric="throughput", seed=0):
+        super().__init__(exps, run_fn, metric)
+        self._rng = random.Random(seed)
+
+    def next_batch(self, sample_size=1):
+        n = min(sample_size, len(self.remaining))
+        picks = self._rng.sample(range(len(self.remaining)), n)
+        batch = [self.remaining[i] for i in picks]
+        for i in sorted(picks, reverse=True):
+            del self.remaining[i]
+        return batch
+
+
+class ModelBasedTuner(BaseTuner):
+    """Cost-model-guided search (reference `model_based_tuner.py`): explore
+    randomly for `warmup_trials`, then repeatedly fit the cost model on the
+    observations and run the highest-predicted remaining candidates."""
+
+    def __init__(self, exps, run_fn, metric="throughput", warmup_trials=3, seed=0):
+        super().__init__(exps, run_fn, metric)
+        self.warmup_trials = warmup_trials
+        self._rng = random.Random(seed)
+        self._model = None
+
+    def next_batch(self, sample_size=1):
+        n = min(sample_size, len(self.remaining))
+        if len(self.observed) < self.warmup_trials or self._model is None:
+            picks = self._rng.sample(range(len(self.remaining)), n)
+        else:
+            pred = self._model.predict(self.remaining)
+            picks = list(np.argsort(pred)[::-1][:n])
+        batch = [self.remaining[i] for i in picks]
+        for i in sorted(picks, reverse=True):
+            del self.remaining[int(i)]
+        return batch
+
+    def update(self):
+        if len(self.observed) >= max(2, self.warmup_trials):
+            model = CostModel()
+            # featurization vocabulary spans the full space so unseen
+            # categorical values predict cleanly
+            model._featurize(self.all_exps)
+            self._model = model.fit(self.observed, self.observed_vals)
+
+
+TUNERS = {
+    "gridsearch": GridSearchTuner,
+    "random": RandomTuner,
+    "model_based": ModelBasedTuner,
+}
+
+
+def make_tuner(tuner_type, exps, run_fn, **kw):
+    if tuner_type not in TUNERS:
+        raise ValueError(f"unknown tuner '{tuner_type}' (have {sorted(TUNERS)})")
+    return TUNERS[tuner_type](exps, run_fn, **kw)

@@ -2,6 +2,12 @@ from deepspeed_tpu.runtime.data_pipeline.curriculum import (
     CurriculumScheduler,
     apply_seqlen_curriculum,
 )
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+    DataAnalyzer,
+    load_accumulated,
+    load_metric_to_sample,
+    load_sample_to_metric,
+)
 from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
 from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
     RandomLTDScheduler,

@@ -1,0 +1,141 @@
+"""Offline data analysis (map-reduce metric indexing).
+
+Reference: `DataAnalyzer` (`deepspeed/runtime/data_pipeline/data_sampling/
+data_analyzer.py`) — each worker walks a shard of the dataset computing
+per-sample metrics (e.g. sequence length, vocab rarity), writes index files,
+and a reduce step merges them into (a) `sample_to_metric`: metric value per
+sample, aligned with the dataset, and (b) `metric_to_sample`: value → sample
+ids. Curriculum learning (`DeepSpeedDataSampler`) consumes the merged output
+as its `difficulties` array.
+
+Storage is plain .npy per worker + a merged .npy / .json — the reference's
+indexed-dataset binary format is a torch-ecosystem artifact, not a capability.
+"""
+
+import json
+import os
+from typing import Callable, Dict, Sequence
+
+import numpy as np
+
+SINGLE_VALUE = "single_value_per_sample"   # one number per sample (indexable)
+ACCUMULATE = "accumulate_value"            # running reduction over samples
+
+
+class DataAnalyzer:
+    """Map-reduce per-sample metric computation over dataset shards.
+
+    `metric_functions[name](sample) -> scalar` (SINGLE_VALUE) or
+    `-> np.ndarray` contribution (ACCUMULATE, summed). `worker_id` /
+    `num_workers` shard the dataset by contiguous ranges, mirroring the
+    reference's batch-start/end split.
+    """
+
+    def __init__(self, dataset, metric_names: Sequence[str],
+                 metric_functions: Dict[str, Callable],
+                 metric_types: Dict[str, str] = None,
+                 num_workers: int = 1, worker_id: int = 0,
+                 save_path: str = "./data_analysis"):
+        self.dataset = dataset
+        self.metric_names = list(metric_names)
+        self.metric_functions = metric_functions
+        self.metric_types = metric_types or {n: SINGLE_VALUE for n in metric_names}
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.save_path = save_path
+
+    # -- map ------------------------------------------------------------
+
+    def _shard_range(self):
+        n = len(self.dataset)
+        per = (n + self.num_workers - 1) // self.num_workers
+        start = self.worker_id * per
+        return start, min(start + per, n)
+
+    def _worker_file(self, metric, worker_id):
+        return os.path.join(self.save_path, metric,
+                            f"worker{worker_id}_of_{self.num_workers}.npz")
+
+    def run_map(self):
+        """Compute this worker's shard and persist per-metric partial results."""
+        start, end = self._shard_range()
+        results = {}
+        for name in self.metric_names:
+            fn = self.metric_functions[name]
+            if self.metric_types[name] == SINGLE_VALUE:
+                ids = np.arange(start, end, dtype=np.int64)
+                vals = np.asarray([fn(self.dataset[i]) for i in range(start, end)])
+                results[name] = ("single", ids, vals)
+            else:
+                acc = None
+                for i in range(start, end):
+                    contrib = np.asarray(fn(self.dataset[i]))
+                    acc = contrib if acc is None else acc + contrib
+                results[name] = ("accum", np.zeros(0, np.int64),
+                                 acc if acc is not None else np.zeros(0))
+        for name, (kind, ids, vals) in results.items():
+            path = self._worker_file(name, self.worker_id)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            np.savez(path, kind=kind, ids=ids, values=vals)
+        return results
+
+    # -- reduce ----------------------------------------------------------
+
+    def run_reduce(self):
+        """Merge all workers' partials into the final per-metric index:
+        `<save_path>/<metric>/sample_to_metric.npy` (SINGLE_VALUE, aligned
+        with the dataset), `metric_to_sample.json` (value → sample ids), or
+        `accumulated.npy` (ACCUMULATE)."""
+        for name in self.metric_names:
+            kinds, ids_all, vals_all = [], [], []
+            for w in range(self.num_workers):
+                with np.load(self._worker_file(name, w), allow_pickle=False) as z:
+                    kinds.append(str(z["kind"]))
+                    ids_all.append(z["ids"])
+                    vals_all.append(z["values"])
+            mdir = os.path.join(self.save_path, name)
+            if kinds[0] == "single":
+                ids = np.concatenate(ids_all)
+                vals = np.concatenate(vals_all)
+                order = np.argsort(ids)
+                sample_to_metric = vals[order]
+                np.save(os.path.join(mdir, "sample_to_metric.npy"), sample_to_metric)
+                index = {}
+                for sid, val in zip(ids[order].tolist(), sample_to_metric.tolist()):
+                    index.setdefault(str(val), []).append(sid)
+                with open(os.path.join(mdir, "metric_to_sample.json"), "w") as f:
+                    json.dump(index, f)
+            else:
+                total = None
+                for v in vals_all:
+                    if v.size == 0:  # empty shard (more workers than samples)
+                        continue
+                    total = v if total is None else total + v
+                np.save(os.path.join(mdir, "accumulated.npy"),
+                        total if total is not None else np.zeros(0))
+
+    def run(self):
+        """Single-process convenience: map all shards then reduce."""
+        orig = self.worker_id
+        try:
+            for w in range(self.num_workers):
+                self.worker_id = w
+                self.run_map()
+        finally:
+            self.worker_id = orig
+        self.run_reduce()
+
+
+def load_sample_to_metric(save_path, metric_name):
+    """The merged difficulty array for `DeepSpeedDataSampler(difficulties=...)`."""
+    return np.load(os.path.join(save_path, metric_name, "sample_to_metric.npy"))
+
+
+def load_metric_to_sample(save_path, metric_name):
+    with open(os.path.join(save_path, metric_name, "metric_to_sample.json")) as f:
+        raw = json.load(f)
+    return {float(k): v for k, v in raw.items()}
+
+
+def load_accumulated(save_path, metric_name):
+    return np.load(os.path.join(save_path, metric_name, "accumulated.npy"))

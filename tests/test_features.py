@@ -248,3 +248,146 @@ class TestReviewRegressions:
         # sampling path recompiled (not reusing greedy closure) and draws differ
         assert not (np.array_equal(s1, greedy1) and np.array_equal(s2, greedy1))
         assert not np.array_equal(s1, s2)
+
+
+class TestDataAnalyzer:
+    """Offline map-reduce metric indexing (reference: data_sampling DataAnalyzer)."""
+
+    def _dataset(self):
+        rng = __import__("numpy").random.default_rng(0)
+        return [rng.integers(0, 100, rng.integers(3, 20)).tolist() for _ in range(23)]
+
+    def test_map_reduce_matches_single_pass(self, tmp_path):
+        import numpy as np
+        from deepspeed_tpu.runtime.data_pipeline import (DataAnalyzer,
+                                                         load_sample_to_metric,
+                                                         load_metric_to_sample,
+                                                         load_accumulated)
+        ds = self._dataset()
+        analyzer = DataAnalyzer(
+            ds, metric_names=["seqlen", "token_hist"],
+            metric_functions={"seqlen": len,
+                              "token_hist": lambda s: np.bincount(s, minlength=100)},
+            metric_types={"seqlen": "single_value_per_sample",
+                          "token_hist": "accumulate_value"},
+            num_workers=3, save_path=str(tmp_path))
+        analyzer.run()
+
+        s2m = load_sample_to_metric(str(tmp_path), "seqlen")
+        assert s2m.shape == (23,)
+        np.testing.assert_array_equal(s2m, [len(s) for s in ds])
+
+        m2s = load_metric_to_sample(str(tmp_path), "seqlen")
+        for val, ids in m2s.items():
+            for i in ids:
+                assert len(ds[i]) == val
+
+        hist = load_accumulated(str(tmp_path), "token_hist")
+        expected = np.zeros(100, np.int64)
+        for s in ds:
+            expected += np.bincount(s, minlength=100)
+        np.testing.assert_array_equal(hist, expected)
+
+    def test_feeds_curriculum_sampler(self, tmp_path):
+        import numpy as np
+        from deepspeed_tpu.runtime.data_pipeline import (DataAnalyzer,
+                                                         DeepSpeedDataSampler,
+                                                         load_sample_to_metric)
+        ds = self._dataset()
+        DataAnalyzer(ds, ["seqlen"], {"seqlen": len},
+                     num_workers=2, save_path=str(tmp_path)).run()
+        difficulties = load_sample_to_metric(str(tmp_path), "seqlen")
+        sampler = DeepSpeedDataSampler(
+            dataset_len=len(ds), batch_size=4, difficulties=difficulties,
+            curriculum_config={"curriculum_type": "fixed_linear",
+                               "min_difficulty": 3, "max_difficulty": 20,
+                               "schedule_config": {"total_curriculum_step": 10,
+                                                   "difficulty_step": 1}})
+        idx = sampler.next_indices()
+        assert len(idx) == 4
+        # early steps must draw from the easiest (shortest) samples: within the
+        # current difficulty limit, or the 4 easiest when the pool would starve
+        limit = sampler.scheduler.current_difficulty
+        assert all(difficulties[i] <= max(limit, np.sort(difficulties)[3]) for i in idx)
+
+
+class TestTuners:
+    """Tuner suite (reference: autotuning/tuner/{index_based,model_based,cost_model})."""
+
+    SPACE = [{"zero_stage": s, "micro_batch": m}
+             for s in (0, 1, 2, 3) for m in (1, 2, 4, 8, 16)]
+
+    @staticmethod
+    def _synthetic_metric(exp):
+        # throughput peaks at stage 2 and grows with mbs until an OOM cliff
+        if exp["micro_batch"] > 8 and exp["zero_stage"] < 2:
+            return None  # infeasible (OOM)
+        base = {0: 50, 1: 60, 2: 100, 3: 80}[exp["zero_stage"]]
+        return base * exp["micro_batch"] ** 0.5
+
+    def _best_val(self):
+        vals = [self._synthetic_metric(e) for e in self.SPACE]
+        return max(v for v in vals if v is not None)
+
+    def test_gridsearch_finds_best(self):
+        from deepspeed_tpu.autotuning import GridSearchTuner
+        t = GridSearchTuner(self.SPACE, self._synthetic_metric)
+        best, val = t.tune()
+        assert val == self._best_val()
+        assert best["zero_stage"] == 2 and best["micro_batch"] == 16
+
+    def test_random_tuner_explores_all(self):
+        from deepspeed_tpu.autotuning import RandomTuner
+        t = RandomTuner(self.SPACE, self._synthetic_metric, seed=1)
+        best, val = t.tune()
+        assert val == self._best_val()
+
+    def test_model_based_beats_budgeted_random(self):
+        """With a tight trial budget the surrogate must steer to the optimum."""
+        from deepspeed_tpu.autotuning import ModelBasedTuner
+        t = ModelBasedTuner(self.SPACE, self._synthetic_metric,
+                            warmup_trials=5, seed=0)
+        best, val = t.tune(n_trials=12)
+        assert val >= 0.9 * self._best_val(), (best, val)
+
+    def test_cost_model_ranks(self):
+        from deepspeed_tpu.autotuning import CostModel
+        obs = [e for e in self.SPACE if self._synthetic_metric(e) is not None]
+        y = [self._synthetic_metric(e) for e in obs]
+        m = CostModel().fit(obs, y)
+        pred = m.predict(obs)
+        # top-3 predicted contains the actual argmax
+        top = np.argsort(pred)[::-1][:3]
+        assert int(np.argmax(y)) in top.tolist()
+
+    def test_early_stopping(self):
+        from deepspeed_tpu.autotuning import GridSearchTuner
+        calls = []
+
+        def run(exp):
+            calls.append(exp)
+            return 1.0  # flat: never improves after first
+
+        t = GridSearchTuner(self.SPACE, run)
+        t.tune(early_stopping=3)
+        assert len(calls) < len(self.SPACE)
+
+    def test_make_tuner_rejects_unknown(self):
+        from deepspeed_tpu.autotuning import make_tuner
+        with pytest.raises(ValueError):
+            make_tuner("bayesian", self.SPACE, self._synthetic_metric)
+
+
+def test_data_analyzer_more_workers_than_samples(tmp_path):
+    """Empty shards (workers > samples) must not break the accumulate reduce."""
+    import numpy as np
+    from deepspeed_tpu.runtime.data_pipeline import DataAnalyzer, load_accumulated
+    ds = [[1, 2], [2, 3], [3, 4]]
+    DataAnalyzer(ds, ["hist"], {"hist": lambda s: np.bincount(s, minlength=10)},
+                 metric_types={"hist": "accumulate_value"},
+                 num_workers=4, save_path=str(tmp_path)).run()
+    hist = load_accumulated(str(tmp_path), "hist")
+    expected = np.zeros(10, np.int64)
+    for s in ds:
+        expected += np.bincount(s, minlength=10)
+    np.testing.assert_array_equal(hist, expected)
