@@ -53,11 +53,9 @@ class Autotuner:
         mesh_mod._CURRENT_MESH = None
         mesh_mod._CURRENT_SPEC = None
         cfg = copy.deepcopy(self.base_config)
-        cfg["train_micro_batch_size_per_gpu"] = micro_batch
-        cfg.setdefault("zero_optimization", {})["stage"] = stage
         cfg["gradient_accumulation_steps"] = 1
-        for k, v in (extra or {}).items():
-            cfg[k] = v
+        self._apply_exp(cfg, dict(extra or {}, zero_stage=stage,
+                                  micro_batch=micro_batch))
         engine = None
         try:
             model = self.model_factory()
@@ -116,12 +114,36 @@ class Autotuner:
                 hi = mid - 1
         return best
 
+    def _base_stage(self):
+        return self.base_config.get("zero_optimization", {}).get("stage", 0)
+
+    def _base_mbs(self):
+        return self.base_config.get("train_micro_batch_size_per_gpu", 1)
+
+    def _apply_exp(self, tuned, exp):
+        """Write an experiment's overrides into a config dict. Keys other than
+        zero_stage/micro_batch are dotted config paths
+        (e.g. "zero_optimization.offload_optimizer.device")."""
+        if "micro_batch" in exp:
+            tuned["train_micro_batch_size_per_gpu"] = exp["micro_batch"]
+        if "zero_stage" in exp:
+            tuned.setdefault("zero_optimization", {})["stage"] = exp["zero_stage"]
+        for k, v in exp.items():
+            if k in ("zero_stage", "micro_batch"):
+                continue
+            node = tuned
+            *parents, leaf = k.split(".")
+            for p in parents:
+                node = node.setdefault(p, {})
+            node[leaf] = v
+        return tuned
+
     def _run_config(self, exp):
         """Tuner protocol adapter: run one experiment dict of config overrides
-        ({"zero_stage": s, "micro_batch": m, **flat config keys}) and return
-        the metric value (higher is better) or None if infeasible."""
-        rec = self._run_experiment(exp.get("zero_stage", 0),
-                                   exp.get("micro_batch", 1),
+        and return the metric value (higher is better) or None if infeasible.
+        Keys absent from the experiment inherit the base config."""
+        rec = self._run_experiment(exp.get("zero_stage", self._base_stage()),
+                                   exp.get("micro_batch", self._base_mbs()),
                                    extra={k: v for k, v in exp.items()
                                           if k not in ("zero_stage", "micro_batch")})
         if rec["status"] != "ok":
@@ -133,19 +155,16 @@ class Autotuner:
                    n_trials=None, early_stopping=None, **tuner_kw):
         """Explore an explicit experiment list with a tuner (reference
         `autotuning/tuner/`: gridsearch | random | model_based). Each exp is a
-        dict of overrides; returns (tuned_config, best_record)."""
+        dict of overrides — `zero_stage`, `micro_batch`, or dotted config paths
+        like "zero_optimization.offload_optimizer.device"; omitted keys inherit
+        the base config. Returns (tuned_config, best_record)."""
         from deepspeed_tpu.autotuning.tuner import make_tuner
         tuner = make_tuner(tuner_type, exps, self._run_config, **tuner_kw)
         best_exp, best_val = tuner.tune(sample_size=sample_size, n_trials=n_trials,
                                         early_stopping=early_stopping)
         if best_exp is None:
             raise RuntimeError("autotuning: no feasible configuration found")
-        tuned = copy.deepcopy(self.base_config)
-        tuned["train_micro_batch_size_per_gpu"] = best_exp.get("micro_batch", 1)
-        tuned.setdefault("zero_optimization", {})["stage"] = best_exp.get("zero_stage", 0)
-        for k, v in best_exp.items():
-            if k not in ("zero_stage", "micro_batch"):
-                tuned[k] = v
+        tuned = self._apply_exp(copy.deepcopy(self.base_config), best_exp)
         logger.info(f"autotune({tuner_type}) best: {best_exp} -> {best_val:.2f}")
         return tuned, {"exp": best_exp, "metric_val": best_val,
                        "trials": len(tuner.observed)}

@@ -23,11 +23,16 @@ class CostModel:
     """Ridge regression over featurized experiment dicts (reference
     `cost_model.py` XGBoostCostModel role)."""
 
-    def __init__(self, l2: float = 1e-3):
+    def __init__(self, l2: float = 1e-3, space: List[Dict] = None):
+        """`space`: the full candidate list; fixes the featurization vocabulary
+        up front so categorical values unseen in the training observations
+        still featurize (and predict) consistently."""
         self.l2 = l2
         self._keys = None
         self._vocab = {}
         self._w = None
+        if space:
+            self._featurize(space)
 
     def _featurize(self, exps: List[Dict]):
         if self._keys is None:
@@ -62,14 +67,15 @@ class CostModel:
 
 
 class BaseTuner:
-    """Sequential explorer over an experiment list (reference `base_tuner.py`)."""
+    """Sequential explorer over an experiment list (reference `base_tuner.py`).
 
-    def __init__(self, exps: List[Dict], run_fn: Callable[[Dict], Optional[float]],
-                 metric: str = "throughput"):
+    Metric semantics live entirely in `run_fn`: it returns a higher-is-better
+    value (negate latencies), or None for infeasible configs."""
+
+    def __init__(self, exps: List[Dict], run_fn: Callable[[Dict], Optional[float]]):
         self.all_exps = list(exps)
         self.remaining = list(exps)
         self.run_fn = run_fn
-        self.metric = metric
         self.observed: List[Dict] = []
         self.observed_vals: List[float] = []
         self.best_exp: Optional[Dict] = None
@@ -121,8 +127,8 @@ class GridSearchTuner(BaseTuner):
 class RandomTuner(BaseTuner):
     """Uniform random order (reference RandomTuner)."""
 
-    def __init__(self, exps, run_fn, metric="throughput", seed=0):
-        super().__init__(exps, run_fn, metric)
+    def __init__(self, exps, run_fn, seed=0):
+        super().__init__(exps, run_fn)
         self._rng = random.Random(seed)
 
     def next_batch(self, sample_size=1):
@@ -139,8 +145,8 @@ class ModelBasedTuner(BaseTuner):
     randomly for `warmup_trials`, then repeatedly fit the cost model on the
     observations and run the highest-predicted remaining candidates."""
 
-    def __init__(self, exps, run_fn, metric="throughput", warmup_trials=3, seed=0):
-        super().__init__(exps, run_fn, metric)
+    def __init__(self, exps, run_fn, warmup_trials=3, seed=0):
+        super().__init__(exps, run_fn)
         self.warmup_trials = warmup_trials
         self._rng = random.Random(seed)
         self._model = None
@@ -159,11 +165,8 @@ class ModelBasedTuner(BaseTuner):
 
     def update(self):
         if len(self.observed) >= max(2, self.warmup_trials):
-            model = CostModel()
-            # featurization vocabulary spans the full space so unseen
-            # categorical values predict cleanly
-            model._featurize(self.all_exps)
-            self._model = model.fit(self.observed, self.observed_vals)
+            self._model = CostModel(space=self.all_exps).fit(self.observed,
+                                                             self.observed_vals)
 
 
 TUNERS = {

@@ -391,3 +391,40 @@ def test_data_analyzer_more_workers_than_samples(tmp_path):
     for s in ds:
         expected += np.bincount(s, minlength=10)
     np.testing.assert_array_equal(hist, expected)
+
+
+def test_tune_space_inherits_base_config(monkeypatch):
+    """Experiments that omit zero_stage/micro_batch inherit the base config,
+    and extra keys are dotted config paths (not silently dropped)."""
+    from deepspeed_tpu.autotuning import Autotuner
+    tuner = Autotuner(model_factory=None,
+                      base_config={"train_micro_batch_size_per_gpu": 8,
+                                   "zero_optimization": {"stage": 2}},
+                      batch_factory=None)
+    seen = []
+
+    def fake_run(stage, micro_batch, extra=None):
+        seen.append((stage, micro_batch, dict(extra or {})))
+        return {"stage": stage, "micro_batch": micro_batch, "status": "ok",
+                "samples_per_sec": 10.0 + len(seen), "step_ms": 1.0}
+
+    monkeypatch.setattr(tuner, "_run_experiment", fake_run)
+    space = [{"zero_optimization.offload_optimizer.device": "cpu"},
+             {"zero_optimization.offload_optimizer.device": "none"}]
+    tuned, best = tuner.tune_space(space, tuner_type="gridsearch")
+    # base stage/mbs inherited, not reset to 0/1
+    assert all(s == 2 and m == 8 for s, m, _ in seen)
+    assert tuned["train_micro_batch_size_per_gpu"] == 8
+    assert tuned["zero_optimization"]["stage"] == 2
+    # dotted path landed nested in the tuned config
+    assert tuned["zero_optimization"]["offload_optimizer"]["device"] in ("cpu", "none")
+
+
+def test_apply_exp_dotted_paths():
+    from deepspeed_tpu.autotuning import Autotuner
+    t = Autotuner(model_factory=None, base_config={}, batch_factory=None)
+    cfg = t._apply_exp({}, {"zero_stage": 3, "micro_batch": 4,
+                            "activation_checkpointing.policy": "full"})
+    assert cfg["zero_optimization"]["stage"] == 3
+    assert cfg["train_micro_batch_size_per_gpu"] == 4
+    assert cfg["activation_checkpointing"]["policy"] == "full"
