@@ -58,25 +58,32 @@ def _unflatten_into(template, flat):
     return rec(template, ())
 
 
-def save_universal_checkpoint(engine, save_dir, tag="universal"):
-    """Gather full fp32 weights from the engine (whatever its ZeRO/TP/PP layout)
-    and write the flat npz artifact."""
-    out_dir = pathlib.Path(save_dir) / tag
+def _write_universal(flat, out_dir, extra_meta=None):
+    """Single writer of the on-disk universal format (npz + meta json)."""
+    out_dir = pathlib.Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
-    fp32 = engine.get_fp32_state_dict()
-    flat = {k: np.asarray(v, np.float32) for k, v in _flatten(fp32).items()}
     np.savez(out_dir / UNIVERSAL_FILE, **flat)
     meta = {
         "format_version": 1,
         "param_shapes": {k: list(v.shape) for k, v in flat.items()},
-        "global_steps": engine.global_steps,
-        "zero_stage": engine.zero_stage,
-        "mesh": str(engine.spec),
     }
+    meta.update(extra_meta or {})
     with open(out_dir / META_FILE, "w") as f:
         json.dump(meta, f, indent=2)
     log_dist(f"universal checkpoint -> {out_dir} ({len(flat)} tensors)", ranks=[0])
     return str(out_dir)
+
+
+def save_universal_checkpoint(engine, save_dir, tag="universal"):
+    """Gather full fp32 weights from the engine (whatever its ZeRO/TP/PP layout)
+    and write the flat npz artifact."""
+    fp32 = engine.get_fp32_state_dict()
+    flat = {k: np.asarray(v, np.float32) for k, v in _flatten(fp32).items()}
+    return _write_universal(flat, pathlib.Path(save_dir) / tag, {
+        "global_steps": engine.global_steps,
+        "zero_stage": engine.zero_stage,
+        "mesh": str(engine.spec),
+    })
 
 
 def load_universal_checkpoint(engine, load_dir, tag="universal", strict=True):
@@ -126,3 +133,61 @@ def get_fp32_state_dict_from_universal(load_dir, tag="universal"):
     in_dir = pathlib.Path(load_dir) / tag
     with np.load(in_dir / UNIVERSAL_FILE) as data:
         return {k: data[k] for k in data.files}
+
+
+def convert_checkpoint_to_universal(ckpt_dir, out_dir, tag=None, out_tag="universal"):
+    """Fully offline converter (no engine needed) — the `ds_to_universal.py`
+    CLI role (`checkpoint/ds_to_universal.py:254`): reconstruct the fp32 param
+    tree from a saved checkpoint and write the flat universal artifact.
+
+    Restores the checkpoint's structured TrainState directly (orbax format
+    only — the npz fallback engine stores positional leaves whose param/master
+    split is unrecoverable offline) so keys match `save_universal_checkpoint`
+    / `load_universal_checkpoint` exactly."""
+    import os
+    from deepspeed_tpu.checkpoint.zero_to_fp32 import (_read_latest,
+                                                       _restore_state_tree)
+    tag = tag or _read_latest(ckpt_dir)
+    if tag is None:
+        raise FileNotFoundError(f"no 'latest' file in {ckpt_dir}; pass --tag")
+    state_path = os.path.join(ckpt_dir, str(tag), "state")
+    restored, fmt = _restore_state_tree(state_path)
+    if fmt != "orbax":
+        raise ValueError(
+            "offline universal conversion needs an orbax-format checkpoint "
+            "(checkpoint.engine='orbax'); the npz engine stores positional "
+            "leaves that cannot be mapped back to parameter names offline — "
+            "use convert_to_universal(ckpt_dir, out_dir, engine) instead")
+    master = restored.get("master") if isinstance(restored, dict) \
+        else getattr(restored, "master", None)
+    params = restored.get("params") if isinstance(restored, dict) \
+        else getattr(restored, "params", None)
+    source = master if master is not None else params
+    if source is None:
+        raise ValueError("checkpoint has neither 'master' nor 'params' trees")
+    flat = {k: np.asarray(v, np.float32) for k, v in _flatten(source).items()}
+    return _write_universal(flat, pathlib.Path(out_dir) / out_tag,
+                            {"source_checkpoint": str(ckpt_dir), "tag": str(tag)})
+
+
+def main(argv=None):
+    """`ds_to_universal` CLI (reference bin-level converter)."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        description="convert a deepspeed-tpu checkpoint to a universal "
+                    "(topology-independent) checkpoint")
+    parser.add_argument("--input_folder", required=True,
+                        help="checkpoint root (contains `latest` / tag dirs)")
+    parser.add_argument("--output_folder", required=True,
+                        help="where to write the universal artifact")
+    parser.add_argument("--tag", default=None, help="checkpoint tag (default: latest)")
+    parser.add_argument("--out_tag", default="universal")
+    args = parser.parse_args(argv)
+    out = convert_checkpoint_to_universal(args.input_folder, args.output_folder,
+                                          tag=args.tag, out_tag=args.out_tag)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -52,14 +52,25 @@ def _flatten(tree, prefix=()):
 
 
 def _restore_state_tree(state_path):
-    """Load a saved TrainState directory (orbax or npz) as host numpy trees."""
+    """Load a saved TrainState directory (orbax or npz) as host numpy trees.
+
+    Restores every leaf as np.ndarray (no device placement), so consolidation
+    works on ANY machine — including one with a different device count than
+    the training job that wrote the checkpoint (the whole point of the
+    reference's offline zero_to_fp32 script)."""
     npz = os.path.join(state_path, "state.npz")
     if os.path.exists(npz):
         with np.load(npz) as data:
             return {k: data[k] for k in data.files}, "npz"
+    import jax
     import orbax.checkpoint as ocp
-    ckptr = ocp.StandardCheckpointer()
-    restored = ckptr.restore(os.path.abspath(state_path))
+    path = os.path.abspath(state_path)
+    ckptr = ocp.PyTreeCheckpointer()
+    meta = ckptr.metadata(path)
+    tree = getattr(meta, "item_metadata", meta)
+    restore_args = jax.tree_util.tree_map(
+        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree)
+    restored = ckptr.restore(path, args=ocp.args.PyTreeRestore(restore_args=restore_args))
     return restored, "orbax"
 
 

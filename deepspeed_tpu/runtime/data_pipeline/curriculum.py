@@ -77,18 +77,19 @@ def apply_seqlen_curriculum(batch, difficulty, ignore_index=-1, bucketize=None):
             if k in out:
                 out[k] = np.asarray(out[k])[:, :difficulty]
         return out
-    if difficulty >= T:
-        return out
     labels = out.get("labels")
     if labels is None:
-        # causal LM: derive shifted labels, mask positions past the difficulty
+        # causal LM: ALWAYS derive shifted labels (stable batch contract across
+        # the whole ramp — at full difficulty the mask is simply all-keep, so
+        # the loss_fn's shapes and keys never change mid-training)
         tokens_np = np.asarray(tokens)
         inputs = tokens_np[:, :-1]
         labels = tokens_np[:, 1:].astype(np.int32).copy()
-        labels[:, max(difficulty - 1, 0):] = ignore_index
+        if difficulty < T:
+            labels[:, max(difficulty - 1, 0):] = ignore_index
         out["tokens"] = inputs
         out["labels"] = labels
-    else:
+    elif difficulty < T:
         labels = np.asarray(labels).astype(np.int32).copy()
         labels[:, difficulty:] = ignore_index
         out["labels"] = labels

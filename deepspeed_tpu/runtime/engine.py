@@ -210,6 +210,17 @@ class Engine:
         # flops profiler (lazy)
         self._flops_profiler = None
 
+        # curriculum learning: legacy seqlen scheduling applied in train_batch
+        # (reference `engine.forward` truncation, engine.py:1792-1795; v2 config
+        # block data_efficiency.data_sampling.curriculum_learning)
+        de = self.config.data_efficiency
+        cl = (de.data_sampling or {}).get("curriculum_learning", {}) \
+            if de and de.enabled else {}
+        self.curriculum_scheduler = None
+        if cl.get("enabled"):
+            from deepspeed_tpu.runtime.data_pipeline.curriculum import CurriculumScheduler
+            self.curriculum_scheduler = CurriculumScheduler(cl)
+
     @staticmethod
     def _factor_zero_subgroup(config):
         """MiCS/hpZ: factor the data axis into data × zero so params shard over an
@@ -652,6 +663,16 @@ class Engine:
                 it = self._data_iterator
             assert it is not None, "train_batch needs a batch or data_iter/training_data"
             batch = next(it)
+        if self.curriculum_scheduler is not None and isinstance(batch, dict) \
+                and ("tokens" in batch or "input_ids" in batch):
+            # label-mask formulation keeps shapes static under jit (no
+            # per-difficulty recompiles, unlike the reference's truncation);
+            # applies both to bare-token batches (labels derived) and to
+            # batches that already carry labels (masked in place)
+            from deepspeed_tpu.runtime.data_pipeline.curriculum import \
+                apply_seqlen_curriculum
+            difficulty = self.curriculum_scheduler.update_difficulty(self.global_steps)
+            batch = apply_seqlen_curriculum(batch, difficulty)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         if self.host_optimizer is not None:

@@ -1,0 +1,37 @@
+"""Profiler range annotations — analog of the reference's nvtx shim
+(`deepspeed/utils/nvtx.py` `instrument_w_nvtx`, accelerator
+`range_push/range_pop`). On TPU these map to `jax.profiler` trace
+annotations, which show up in xprof/TensorBoard traces."""
+
+import functools
+
+import jax
+
+
+def range_push(msg):
+    """Start a named range (reference accelerator.range_push)."""
+    t = jax.profiler.TraceAnnotation(msg)
+    t.__enter__()
+    return t
+
+
+def range_pop(t):
+    """End a range started with range_push."""
+    t.__exit__(None, None, None)
+
+
+def instrument_w_nvtx(func):
+    """Decorator: wrap `func` in a named profiler range (reference
+    `utils/nvtx.py:instrument_w_nvtx`)."""
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(func.__qualname__):
+            return func(*args, **kwargs)
+
+    return wrapped
+
+
+def annotate(name):
+    """Context manager for a named trace range."""
+    return jax.profiler.TraceAnnotation(name)
