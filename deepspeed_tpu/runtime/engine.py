@@ -675,6 +675,7 @@ class Engine:
             batch = apply_seqlen_curriculum(batch, difficulty)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
+        placed = None
         if self.host_optimizer is not None:
             metrics = self._host_train_batch(batch)
         else:
@@ -682,6 +683,19 @@ class Engine:
             self.state, metrics = self._train_step(self.state, placed)
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
+        # auto-profile at profile_step (reference engine.forward:1782 /
+        # step:2162 flops_profiler_profile_step hook); outside the timer
+        # window — cost analysis recompiles the step from scratch
+        fp_cfg = self.config.flops_profiler
+        if fp_cfg.enabled and self._flops_profiler is None \
+                and self.global_steps + 1 >= fp_cfg.profile_step:
+            if placed is not None:
+                self._run_flops_profile(placed)
+            else:
+                logger.warning("flops_profiler: not supported with the host "
+                               "(CPU-offload) optimizer step; skipping")
+                from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+                self._flops_profiler = FlopsProfiler(ds_engine=self)
         self._after_step(metrics, count_micro=True)
         return metrics["loss"]
 
@@ -817,6 +831,23 @@ class Engine:
                             self.gradient_accumulation_steps_value)
         return TpuDataLoader(dataset, bs, collate_fn=collate_fn, shuffle=shuffle,
                              seed=self.config.seed)
+
+    def _run_flops_profile(self, placed_batch):
+        """Cost-analyze the compiled train step and log the profile report."""
+        from deepspeed_tpu.profiling.flops_profiler import (FlopsProfiler,
+                                                            cost_analysis)
+        prof = FlopsProfiler(ds_engine=self)
+        try:
+            prof.analysis = cost_analysis(self._train_step, self.state, placed_batch)
+            fp = self.config.flops_profiler
+            prof.print_model_profile(profile_step=self.global_steps + 1,
+                                     module_depth=fp.module_depth,
+                                     top_modules=fp.top_modules,
+                                     detailed=fp.detailed,
+                                     output_file=fp.output_file)
+        except Exception as e:
+            logger.warning(f"flops profiler failed: {e}")
+        self._flops_profiler = prof
 
     def _build_monitor(self):
         try:

@@ -226,3 +226,29 @@ def test_curriculum_applies_with_existing_labels():
     b_end = apply_seqlen_curriculum({"tokens": tokens}, 999)
     assert b_end["tokens"].shape == b_mid["tokens"].shape == (2, 15)
     assert "labels" in b_end and (b_end["labels"] >= 0).all()
+
+
+def test_engine_auto_flops_profile():
+    """flops_profiler auto-invokes at profile_step (reference engine hook)."""
+    import deepspeed_tpu
+    import jax.numpy as jnp
+    import numpy as np
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+
+    def loss_fn(p, b):
+        return jnp.mean((b["x"] @ p["w"]) ** 2)
+
+    eng, *_ = deepspeed_tpu.initialize(
+        model=loss_fn, model_parameters={"w": jnp.zeros((64, 64), jnp.float32)},
+        config={"train_micro_batch_size_per_gpu": 2, "mesh": {"data": 1},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "flops_profiler": {"enabled": True, "profile_step": 2}})
+    b = {"x": np.random.default_rng(0).normal(0, 1, (2, 64)).astype(np.float32)}
+    eng.train_batch(b)
+    assert eng._flops_profiler is None          # before profile_step
+    eng.train_batch(b)
+    assert eng._flops_profiler is not None      # ran at step 2
+    assert eng._flops_profiler.get_total_flops() > 0
+    eng.train_batch(b)                          # runs once only

@@ -217,3 +217,46 @@ class TestRingAttention:
         for a, b, name in zip(g_ring, g_ref, "qkv"):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4,
                                        err_msg=f"d{name}")
+
+
+class TestRingAttentionInModel:
+    """Long-context path: GPT wired with ring attention over the sequence axis
+    (context parallelism — capability the reference lacks; its long-context
+    answer is Ulysses + sparse attention only, SURVEY.md §2.3)."""
+
+    def test_gpt_with_ring_attention_matches_default(self):
+        from functools import partial
+        from deepspeed_tpu.models.gpt import GPTConfig, gpt_loss, init_gpt_params
+        from deepspeed_tpu.parallel.ring import ring_attention
+        mesh = _mk_mesh(data=2, sequence=4)
+        cfg = GPTConfig(n_layer=2, n_head=4, d_model=64, d_ff=256, max_seq_len=64,
+                        vocab_size=256, dtype=jnp.float32, remat=False)
+        params = init_gpt_params(cfg, seed=0)
+        toks = jnp.asarray(np.random.default_rng(0).integers(0, 256, (4, 33)),
+                           jnp.int32)
+        batch = {"tokens": toks}
+        ring_fn = partial(ring_attention, mesh=mesh)
+        loss_ring = jax.jit(lambda p: gpt_loss(p, batch, None, cfg=cfg,
+                                               attn_fn=ring_fn))(params)
+        loss_ref = jax.jit(lambda p: gpt_loss(p, batch, None, cfg=cfg))(params)
+        np.testing.assert_allclose(float(loss_ring), float(loss_ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gpt_ring_attention_trains(self):
+        from functools import partial
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_model
+        from deepspeed_tpu.parallel.ring import ring_attention
+        _mk_mesh(data=2, sequence=4)
+        cfg = GPTConfig(n_layer=2, n_head=4, d_model=64, d_ff=256, max_seq_len=64,
+                        vocab_size=256, dtype=jnp.float32, remat=False)
+        model = make_gpt_model(cfg=cfg, name="ring-gpt",
+                               attn_fn=partial(ring_attention, mesh=None))
+        eng, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2}})
+        batch = {"tokens": np.random.default_rng(0).integers(
+            0, 256, (4, 33)).astype(np.int32)}
+        losses = [float(eng.train_batch(batch)) for _ in range(4)]
+        assert losses[-1] < losses[0], losses
