@@ -200,7 +200,8 @@ class MeshConfig(ConfigModel):
     `runtime/pipe/topology.py`): DP/TP/PP/SP/EP group objects collapse into named mesh
     axes. Sizes of -1 mean "absorb remaining devices" (at most one axis may be -1;
     default: data).
-    Axis order is outer→inner = DCN→ICI friendly: pipe, data, expert, sequence, tensor.
+    Axis order is outer→inner = DCN→ICI friendly: pipe, data, zero, expert,
+    sequence, tensor.
     """
     data: int = -1
     zero: int = 1     # inner factor of the data domain (MiCS/hpZ sub-group size)

@@ -106,12 +106,31 @@ OPTIMIZER_REGISTRY = {
 }
 
 
+# Reference config type strings that name implementation variants of the same
+# optimizer (fused CUDA kernels / AVX host step) — on TPU there is one XLA-fused
+# implementation each, so they alias (reference: ops/adam/fused_adam.py:18,
+# ops/adam/cpu_adam.py:13, ops/lamb/fused_lamb.py:14, ops/lion/*).
+OPTIMIZER_ALIASES = {
+    "fusedadam": ADAM_OPTIMIZER,
+    "fusedadamw": ADAMW_OPTIMIZER,
+    "fusedlamb": LAMB_OPTIMIZER,
+    "fusedlion": LION_OPTIMIZER,
+    "deepspeedcpuadam": ADAM_OPTIMIZER,
+    "deepspeedcpulion": LION_OPTIMIZER,
+    "deepspeedcpuadagrad": ADAGRAD_OPTIMIZER,
+    "onebitadam": ONEBIT_ADAM_OPTIMIZER,
+    "zerooneadam": ZERO_ONE_ADAM_OPTIMIZER,
+    "onebitlamb": ONEBIT_LAMB_OPTIMIZER,
+}
+
+
 def build_optimizer(opt_config, lr_schedule: Optional[Callable[[int], float]] = None):
     """Build an optax optimizer from an OptimizerConfig block.
 
     `lr_schedule` (from the scheduler block) overrides the static `lr` param.
     """
     name = opt_config.type.lower()
+    name = OPTIMIZER_ALIASES.get(name, name)
     if name not in OPTIMIZER_REGISTRY:
         raise ValueError(f"Unknown optimizer '{opt_config.type}'. "
                          f"Known: {sorted(OPTIMIZER_REGISTRY)}")

@@ -1,0 +1,59 @@
+"""Train a Mixture-of-Experts GPT with expert parallelism.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/train_moe.py --cpu --experts 4 --ep 4 --steps 4
+"""
+
+import argparse
+import importlib.util
+import os
+import sys
+
+if importlib.util.find_spec("deepspeed_tpu") is None:  # running from a checkout
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--experts", type=int, default=8)
+    p.add_argument("--ep", type=int, default=1, help="expert-parallel axis size")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--micro_batch", type=int, default=4)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.moe_gpt import MoEGPTConfig, make_moe_gpt_model
+
+    cfg = MoEGPTConfig(n_layer=4, n_head=8, d_model=256, d_ff=1024,
+                       max_seq_len=256, vocab_size=8192, dtype=jnp.bfloat16,
+                       num_experts=args.experts, moe_freq=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=make_moe_gpt_model(cfg),
+        config={
+            "train_micro_batch_size_per_gpu": args.micro_batch,
+            "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1},
+            "mesh": {"data": -1, "expert": args.ep},
+            "steps_per_print": 5,
+        })
+
+    rng = np.random.default_rng(0)
+    for step in range(args.steps):
+        batch = {"tokens": rng.integers(
+            0, cfg.vocab_size, (engine.train_batch_size(), 129)).astype(np.int32)}
+        loss = engine.train_batch(batch)
+        if step % 5 == 0:
+            print(f"step {step}: loss {float(loss):.4f}")
+    print(f"final loss: {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

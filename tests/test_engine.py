@@ -135,3 +135,14 @@ def test_fp16_overflow_skips_step():
     assert engine.skipped_steps == 1
     assert int(engine.state.step) == 0
     np.testing.assert_array_equal(jax.device_get(engine.state.params["layer_0"]["w"]), w0)
+
+
+def test_optimizer_type_aliases():
+    """Reference config type strings (FusedAdam, DeepSpeedCPUAdam, ...) resolve
+    (reference: ops/adam/fused_adam.py:18, cpu_adam.py:13)."""
+    from deepspeed_tpu.config.core import OptimizerConfig
+    from deepspeed_tpu.ops.optim import build_optimizer
+    for t in ("FusedAdam", "FusedLamb", "FusedLion", "DeepSpeedCPUAdam",
+              "DeepSpeedCPULion", "DeepSpeedCPUAdagrad", "OneBitAdam", "AdamW"):
+        opt = build_optimizer(OptimizerConfig(type=t, params={"lr": 1e-3}))
+        assert opt is not None, t
