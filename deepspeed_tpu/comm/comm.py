@@ -317,3 +317,143 @@ def reduce_scatter_lax(x, axis_name, scatter_dimension=0, tiled=True):
 
 def all_to_all_lax(x, axis_name, split_axis, concat_axis, tiled=True):
     return jax.lax.all_to_all(x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
+
+
+# ------------------------------------------------------------------
+# reference-parity surface (deepspeed.comm facade, comm/comm.py:13-21) —
+# ops whose distinct CUDA/NCCL semantics collapse under SPMD global arrays
+# ------------------------------------------------------------------
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, axis=None, group=None):
+    """Reference `reduce`: result on dst rank. Global arrays are process-
+    consistent in JAX, so every process holds the reduced value; `dst` is
+    accepted for signature parity."""
+    return all_reduce(tensor, op=op, axis=axis, group=group)
+
+
+def gather(tensor, gather_list=None, dst=0, axis=None, group=None):
+    """Reference `gather` (to dst) — SPMD form: all ranks get the concat."""
+    return all_gather(tensor, axis=axis, group=group)
+
+
+def scatter(tensor, scatter_list=None, src=0, axis=None, group=None):
+    """Shard across `axis` (reference `scatter(tensor, scatter_list, src)`
+    from the src rank; here the global array is simply laid out sharded).
+    With `scatter_list`, the per-rank chunks are concatenated and sharded so
+    rank i's shard is chunk i; otherwise `tensor`'s leading dim is split."""
+    data = (jnp.concatenate([jnp.asarray(t) for t in scatter_list], axis=0)
+            if scatter_list is not None else jnp.asarray(tensor))
+    axes = _axis_tuple(axis if axis is not None else group)
+    mesh = mesh_mod.get_mesh()
+    if mesh_mod.axis_size(axes) == 1:
+        return data
+    sharding = NamedSharding(mesh, P(axes))
+    return _timed("scatter", lambda x: jax.device_put(x, sharding), data)
+
+
+def all_to_all_single(output=None, input=None, output_split_sizes=None,
+                      input_split_sizes=None, axis=None, group=None):
+    """Reference `all_to_all_single` (one tensor split/concat on dim 0).
+    Uneven splits have no static-shape SPMD formulation — fail loudly."""
+    if output_split_sizes is not None or input_split_sizes is not None:
+        raise NotImplementedError(
+            "all_to_all_single: uneven output/input_split_sizes are not "
+            "supported (static-shape SPMD) — pad to even splits")
+    tensor = input if input is not None else output
+    return all_to_all(tensor, axis=axis, group=group, split_axis=0, concat_axis=0)
+
+
+def all_gather_into_tensor(output_tensor=None, input_tensor=None, axis=None,
+                           group=None):
+    """Reference `all_gather_into_tensor` (flat single-tensor all-gather)."""
+    return all_gather(input_tensor, axis=axis, group=group)
+
+
+def reduce_scatter_tensor(output=None, input=None, op=ReduceOp.SUM, axis=None,
+                          group=None):
+    """Reference `reduce_scatter_tensor` (flat single-tensor variant)."""
+    return reduce_scatter(input, op=op, axis=axis, group=group)
+
+
+def inference_all_reduce(tensor, op=ReduceOp.SUM, axis=None, group=None):
+    """Reference `inference_all_reduce` (comm/torch.py:157): TP-group allreduce
+    on the decode path. Defaults to the tensor axis."""
+    axes = axis if axis is not None else \
+        (group if group is not None else (mesh_mod.TENSOR_AXIS,))
+    return all_reduce(tensor, op=op, axis=axes)
+
+
+def all_reduce_coalesced(tensors, op=ReduceOp.SUM, axis=None, group=None):
+    """Reference `all_reduce_coalesced`: one call over many tensors. XLA fuses
+    the per-leaf collectives scheduled together."""
+    return [all_reduce(t, op=op, axis=axis, group=group) for t in tensors]
+
+
+def all_gather_coalesced(tensors, axis=None, group=None):
+    return [all_gather(t, axis=axis, group=group) for t in tensors]
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
+    """Reference `monitored_barrier` — plain barrier on TPU (XLA collectives
+    already fail loudly on rank mismatch)."""
+    return barrier()
+
+
+def get_global_rank(group=None, group_rank=0):
+    """Reference `get_global_rank`: with axis-addressed groups the group rank
+    IS defined by mesh position; identity for the default (full) domain."""
+    return group_rank
+
+
+def get_world_group():
+    """Reference `get_world_group` — the full data domain's axis names."""
+    return mesh_mod.ZERO_AXES
+
+
+def new_group(ranks=None):
+    """Reference `new_group`: process-group objects are replaced by mesh axis
+    names here (pass axis="tensor"/"data"/... to any collective). Returns the
+    default domain so legacy call sites keep working; configure the mesh
+    instead for custom topologies."""
+    logger.warning("comm.new_group: groups are mesh axes on TPU; returning the "
+                   "default data domain — configure the `mesh` block instead")
+    return mesh_mod.ZERO_AXES
+
+
+# --- p2p (reference deepspeed/comm isend/irecv, runtime/pipe/p2p.py) --------
+# Eager cross-rank p2p does not exist under SPMD: a "send" is a ppermute in a
+# compiled program. Inside shard_map, use `p2p_shift`; the eager wrappers
+# raise with that guidance rather than silently doing the wrong thing.
+
+
+def p2p_shift(x, axis_name, shift=1):
+    """In-jit neighbor exchange: rank i's block goes to rank (i+shift) % n
+    (the pipeline engine's SendActivation/RecvActivation pair, fused)."""
+    n = mesh_mod.axis_size((axis_name,)) if isinstance(axis_name, str) \
+        else mesh_mod.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def _no_eager_p2p(name):
+    raise NotImplementedError(
+        f"comm.{name}: eager point-to-point does not exist under compiled "
+        "SPMD — express the exchange inside the jitted step with "
+        "comm.p2p_shift (lax.ppermute), as parallel/pipeline.py does")
+
+
+def send(tensor, dst, group=None, tag=0):
+    _no_eager_p2p("send")
+
+
+def recv(tensor, src, group=None, tag=0):
+    _no_eager_p2p("recv")
+
+
+def isend(tensor, dst, group=None, tag=0):
+    _no_eager_p2p("isend")
+
+
+def irecv(tensor, src, group=None, tag=0):
+    _no_eager_p2p("irecv")

@@ -161,12 +161,22 @@ def from_hf_llama(model_or_sd, hf_config=None, dtype=jnp.float32):
         k = _unpermute_rope_rows(sd[b + "self_attn.k_proj.weight"], Hkv, hd)
         v = sd[b + "self_attn.v_proj.weight"]
         qkv = np.concatenate([q, k, v], axis=0).T          # [D, (H+2Hkv)*hd]
+        # attention biases: InternLM / LlamaConfig(attention_bias=True); the
+        # q/k biases get the same per-head row un-permutation as the weights
+        if b + "self_attn.q_proj.bias" in sd:
+            qb = _unpermute_rope_rows(sd[b + "self_attn.q_proj.bias"], H, hd).ravel()
+            kb = _unpermute_rope_rows(sd[b + "self_attn.k_proj.bias"], Hkv, hd).ravel()
+            vb = sd[b + "self_attn.v_proj.bias"]
+            qkv_b = np.concatenate([qb, kb, vb])
+        else:
+            qkv_b = np.zeros(qkv.shape[1], np.float32)
+        out_b = sd.get(b + "self_attn.o_proj.bias", np.zeros(D, np.float32))
         layers.append({
             "ln1_scale": sd[b + "input_layernorm.weight"],
             "attn_qkv_w": qkv,
-            "attn_qkv_b": np.zeros(qkv.shape[1], np.float32),
+            "attn_qkv_b": qkv_b,
             "attn_out_w": sd[b + "self_attn.o_proj.weight"].T,
-            "attn_out_b": np.zeros(D, np.float32),
+            "attn_out_b": out_b,
             "ln2_scale": sd[b + "post_attention_layernorm.weight"],
             "mlp_gate_w": sd[b + "mlp.gate_proj.weight"].T,
             "mlp_up_w": sd[b + "mlp.up_proj.weight"].T,
@@ -534,6 +544,75 @@ def from_hf_bert(model_or_sd, hf_config=None, dtype=jnp.float32):
     return cfg, params
 
 
+def from_hf_internlm(model_or_sd, hf_config=None, dtype=jnp.float32):
+    """InternLMForCausalLM → (GPTConfig, params) (reference container:
+    `containers/internlm.py`). InternLM is the LLaMA layout with attention
+    biases — same key naming (`model.layers.N.self_attn.*`), handled by the
+    bias-aware LLaMA conversion."""
+    return from_hf_llama(model_or_sd, hf_config=hf_config, dtype=dtype)
+
+
+def from_hf_distilbert(model_or_sd, hf_config=None, dtype=jnp.float32):
+    """DistilBertForMaskedLM → (BertConfig, params) (reference container:
+    `containers/distil_bert.py`). Post-LN encoder, no token-type embeddings,
+    MLM head tied to the word embeddings."""
+    from deepspeed_tpu.models.bert import BertConfig
+    sd = _state_dict(model_or_sd)
+    if hf_config is None:
+        hf_config = getattr(model_or_sd, "config", None)
+    assert hf_config is not None
+
+    D = hf_config.dim
+    cfg = BertConfig(
+        vocab_size=hf_config.vocab_size,
+        n_layer=hf_config.n_layers,
+        n_head=hf_config.n_heads,
+        d_model=D,
+        d_ff=hf_config.hidden_dim,
+        max_seq_len=hf_config.max_position_embeddings,
+        type_vocab_size=1,                      # distilbert has no segments
+        norm_eps=1e-12,
+        pre_layer_norm=False, dtype=dtype, remat=False)
+
+    layers = []
+    for i in range(cfg.n_layer):
+        b = f"distilbert.transformer.layer.{i}."
+        q, k, v = (sd[b + f"attention.{n}_lin.weight"] for n in ("q", "k", "v"))
+        qb, kb, vb = (sd[b + f"attention.{n}_lin.bias"] for n in ("q", "k", "v"))
+        layers.append({
+            "attn_qkv_w": np.concatenate([q, k, v], axis=0).T,
+            "attn_qkv_b": np.concatenate([qb, kb, vb]),
+            "attn_out_w": sd[b + "attention.out_lin.weight"].T,
+            "attn_out_b": sd[b + "attention.out_lin.bias"],
+            "ln1_scale": sd[b + "sa_layer_norm.weight"],
+            "ln1_bias": sd[b + "sa_layer_norm.bias"],
+            "mlp_up_w": sd[b + "ffn.lin1.weight"].T,
+            "mlp_up_b": sd[b + "ffn.lin1.bias"],
+            "mlp_down_w": sd[b + "ffn.lin2.weight"].T,
+            "mlp_down_b": sd[b + "ffn.lin2.bias"],
+            "ln2_scale": sd[b + "output_layer_norm.weight"],
+            "ln2_bias": sd[b + "output_layer_norm.bias"],
+        })
+    V = cfg.vocab_size
+    params = {
+        "word_emb": jnp.asarray(sd["distilbert.embeddings.word_embeddings.weight"], dtype),
+        "pos_emb": jnp.asarray(sd["distilbert.embeddings.position_embeddings.weight"], dtype),
+        "type_emb": jnp.zeros((1, D), dtype),
+        "emb_ln_scale": jnp.asarray(sd["distilbert.embeddings.LayerNorm.weight"], dtype),
+        "emb_ln_bias": jnp.asarray(sd["distilbert.embeddings.LayerNorm.bias"], dtype),
+        "blocks": {k2: v2.astype(dtype) for k2, v2 in _stack(layers).items()},
+        "mlm_dense_w": jnp.asarray(sd["vocab_transform.weight"].T, dtype),
+        "mlm_dense_b": jnp.asarray(sd["vocab_transform.bias"], dtype),
+        "mlm_ln_scale": jnp.asarray(sd["vocab_layer_norm.weight"], dtype),
+        "mlm_ln_bias": jnp.asarray(sd["vocab_layer_norm.bias"], dtype),
+        "mlm_bias": jnp.asarray(sd.get("vocab_projector.bias", np.zeros(V)), dtype),
+        "pooler_w": jnp.zeros((D, D), dtype),   # distilbert has no pooler
+        "pooler_b": jnp.zeros((D,), dtype),
+    }
+    logger.info(f"adapted HF DistilBERT: {cfg.n_layer}L d={cfg.d_model} vocab={V}")
+    return cfg, params
+
+
 # ----------------------------------------------------------------------
 # dispatch
 # ----------------------------------------------------------------------
@@ -541,7 +620,14 @@ def from_hf_bert(model_or_sd, hf_config=None, dtype=jnp.float32):
 _ADAPTERS = {
     "gpt2": from_hf_gpt2,
     "llama": from_hf_llama,
+    "mistral": from_hf_mistral,
+    "internlm": from_hf_internlm,
+    "opt": from_hf_opt,
+    "bloom": from_hf_bloom,
+    "gpt_neox": from_hf_gpt_neox,
+    "gptj": from_hf_gptj,
     "bert": from_hf_bert,
+    "distilbert": from_hf_distilbert,
 }
 
 
@@ -559,7 +645,8 @@ def hf_decode_model(model, dtype=jnp.float32):
     """HF model → DecodeModelSpec (inference engine input, causal LMs only)."""
     from deepspeed_tpu.models.gpt import make_gpt_decode_model
     mt = getattr(model.config, "model_type", None)
-    assert mt != "bert", "BERT is an encoder — use hf_train_model / bert_encode"
+    assert mt not in ("bert", "distilbert"), \
+        "BERT-family models are encoders — use hf_train_model / bert_encode"
     cfg, params = adapt_hf_model(model, dtype=dtype)
     spec = make_gpt_decode_model(cfg=cfg, params=params,
                                  name=getattr(model.config, "model_type", "hf"))
@@ -574,7 +661,7 @@ def hf_train_model(model, dtype=jnp.float32):
     mt = getattr(model.config, "model_type", "hf")
     cfg, params = adapt_hf_model(model, dtype=dtype)
     cfg = dataclasses.replace(cfg, remat=True, dtype=jnp.bfloat16)
-    if mt == "bert":
+    if mt in ("bert", "distilbert"):
         from deepspeed_tpu.models.bert import (bert_param_specs, bert_mlm_loss,
                                                bert_encode)
         from deepspeed_tpu.runtime.engine import ModelSpec

@@ -72,3 +72,55 @@ def test_hf_decode_model_generates():
         ref = hf.generate(torch.tensor(toks), max_new_tokens=6, do_sample=False,
                           pad_token_id=0)
     np.testing.assert_array_equal(out, ref[:, 8:].numpy())
+
+
+def test_llama_attention_bias_internlm_style_parity():
+    """InternLM layout == LLaMA keys + attention biases (containers/internlm.py);
+    exercised via LlamaConfig(attention_bias=True)."""
+    hf_cfg = transformers.LlamaConfig(vocab_size=128, hidden_size=64,
+                                      intermediate_size=112, num_hidden_layers=2,
+                                      num_attention_heads=4, num_key_value_heads=4,
+                                      max_position_embeddings=64,
+                                      attention_bias=True,
+                                      tie_word_embeddings=False)
+    torch.manual_seed(2)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    # biases are zero-init; randomize so the test actually checks them
+    with torch.no_grad():
+        for layer in hf.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj, layer.self_attn.o_proj):
+                proj.bias.normal_(0, 0.05)
+    from deepspeed_tpu.inference.adapters import from_hf_internlm
+    cfg, params = from_hf_internlm(hf)
+    assert float(np.abs(np.asarray(params["blocks"]["attn_qkv_b"])).max()) > 0
+    toks = np.random.default_rng(3).integers(0, 128, (2, 16)).astype(np.int64)
+    _logits_parity(hf, cfg, params, toks)
+
+
+def test_distilbert_adapter_mlm_parity():
+    from deepspeed_tpu.inference.adapters import from_hf_distilbert
+    from deepspeed_tpu.models.bert import bert_encode, bert_mlm_logits
+    hf_cfg = transformers.DistilBertConfig(vocab_size=128, dim=64, n_layers=2,
+                                           n_heads=4, hidden_dim=128,
+                                           max_position_embeddings=64)
+    torch.manual_seed(4)
+    hf = transformers.DistilBertForMaskedLM(hf_cfg)
+    cfg, params = from_hf_distilbert(hf)
+    toks = np.random.default_rng(5).integers(0, 128, (2, 16)).astype(np.int64)
+    hf.eval()
+    with torch.no_grad():
+        ref = hf(torch.tensor(toks)).logits.float().numpy()
+    seq = bert_encode(params, jnp.asarray(toks), cfg)
+    ours = np.asarray(bert_mlm_logits(params, seq, cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-3, rtol=1e-3)
+
+
+def test_adapter_dispatch_covers_container_families():
+    """Every reference injection-container family we claim has a dispatch entry
+    (module_inject/containers/: gpt2, llama/llama2, opt, bloom, gptneox, gptj,
+    internlm, bert, distil_bert + mistral)."""
+    from deepspeed_tpu.inference.adapters import _ADAPTERS
+    for mt in ("gpt2", "llama", "mistral", "internlm", "opt", "bloom",
+               "gpt_neox", "gptj", "bert", "distilbert"):
+        assert mt in _ADAPTERS, mt

@@ -146,3 +146,94 @@ def test_optimizer_type_aliases():
               "DeepSpeedCPULion", "DeepSpeedCPUAdagrad", "OneBitAdam", "AdamW"):
         opt = build_optimizer(OptimizerConfig(type=t, params={"lr": 1e-3}))
         assert opt is not None, t
+
+
+class TestCommParitySurface:
+    """Reference deepspeed.comm facade ops (comm/comm.py:13-21) under SPMD."""
+
+    def _mesh(self, **axes):
+        from deepspeed_tpu.comm import mesh as mesh_mod
+        from deepspeed_tpu.config.core import MeshConfig
+        mesh_mod._CURRENT_MESH = None
+        mesh_mod._CURRENT_SPEC = None
+        return mesh_mod.init_mesh(MeshConfig(**{**dict(data=8, zero=1, tensor=1,
+                                                       sequence=1, expert=1,
+                                                       pipe=1), **axes}))
+
+    def test_reduce_gather_scatter(self):
+        import deepspeed_tpu.comm as comm
+        self._mesh(data=8)
+        # leading dim = per-rank shards (the collectives' contract)
+        x = jnp.ones((8,), jnp.float32)
+        np.testing.assert_allclose(np.asarray(comm.reduce(x, axis="data")),
+                                   np.full(8, 8.0))
+        np.testing.assert_allclose(np.asarray(comm.gather(x, axis="data")),
+                                   np.ones(8))
+        sc = comm.scatter(jnp.arange(16, dtype=jnp.float32), axis="data")
+        assert "data" in str(sc.sharding.spec)
+
+    def test_single_tensor_variants(self):
+        import deepspeed_tpu.comm as comm
+        self._mesh(data=8)
+        x = jnp.arange(64, dtype=jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(comm.all_gather_into_tensor(input_tensor=x, axis="data")),
+            np.asarray(comm.all_gather(x, axis="data")))
+        np.testing.assert_allclose(
+            np.asarray(comm.all_to_all_single(input=x, axis="data")),
+            np.asarray(comm.all_to_all(x, axis="data")))
+        outs = comm.all_reduce_coalesced([x, x * 2], axis="data")
+        assert len(outs) == 2
+
+    def test_inference_all_reduce_tensor_axis(self):
+        import deepspeed_tpu.comm as comm
+        self._mesh(data=2, tensor=4)
+        x = jnp.ones((8,), jnp.float32)
+        out = comm.inference_all_reduce(x)
+        assert out.shape == x.shape
+
+    def test_p2p_eager_raises_with_guidance(self):
+        import deepspeed_tpu.comm as comm
+        for fn in (comm.send, comm.recv, comm.isend, comm.irecv):
+            with pytest.raises(NotImplementedError, match="p2p_shift"):
+                fn(jnp.zeros(4), 0)
+
+    def test_p2p_shift_in_shard_map(self):
+        import deepspeed_tpu.comm as comm
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+        mesh = self._mesh(data=8)
+        x = jnp.arange(8, dtype=jnp.float32)
+
+        def body(x):
+            return comm.p2p_shift(x, "data", shift=1)
+
+        out = shard_map(body, mesh=mesh, in_specs=(P(("data",)),),
+                        out_specs=P(("data",)), check_vma=False)(x)
+        np.testing.assert_allclose(np.asarray(out), np.roll(np.arange(8), 1))
+
+    def test_new_group_warns_and_defaults(self):
+        import deepspeed_tpu.comm as comm
+        self._mesh(data=8)
+        assert comm.new_group([0, 1]) == comm.get_world_group()
+
+    def test_scatter_list_and_group_semantics(self):
+        import deepspeed_tpu.comm as comm
+        self._mesh(data=8)
+        chunks = [jnp.full((2,), float(i)) for i in range(8)]
+        out = comm.scatter(None, scatter_list=chunks, axis="data")
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.repeat(np.arange(8, dtype=np.float32), 2))
+        with pytest.raises(NotImplementedError, match="split_sizes"):
+            comm.all_to_all_single(input=jnp.arange(8.0), axis="data",
+                                   input_split_sizes=[1, 7])
+
+    def test_inference_all_reduce_honors_group(self):
+        import deepspeed_tpu.comm as comm
+        self._mesh(data=2, tensor=4)
+        x = jnp.ones((8,), jnp.float32)
+        # group="data" (2-way) must NOT silently become the 4-way tensor axis
+        out = comm.inference_all_reduce(x, group="data")
+        np.testing.assert_allclose(np.asarray(out), np.full(8, 2.0))
+        out_t = comm.inference_all_reduce(x)
+        np.testing.assert_allclose(np.asarray(out_t), np.full(8, 4.0))
