@@ -384,14 +384,58 @@ def inference_all_reduce(tensor, op=ReduceOp.SUM, axis=None, group=None):
     return all_reduce(tensor, op=op, axis=axes)
 
 
+@functools.lru_cache(maxsize=128)
+def _make_coalesced(mesh, axes, op, n):
+    """One compiled program reducing/gathering n tensors together — the
+    coalescing is real (single dispatch, XLA schedules the collectives as a
+    group), unlike a python loop of eager calls."""
+    if op is None:
+        def local(*xs):
+            return tuple(jax.lax.all_gather(x, axes, axis=0, tiled=True)
+                         for x in xs)
+        out_spec = (P(),) * n
+    else:
+        red = _reduce_fn(op)
+
+        def local(*xs):
+            return tuple(red(x, axes) for x in xs)
+        out_spec = (P(axes),) * n
+    return jax.jit(shard_map(local, mesh=mesh, in_specs=(P(axes),) * n,
+                             out_specs=out_spec, check_vma=False))
+
+
 def all_reduce_coalesced(tensors, op=ReduceOp.SUM, axis=None, group=None):
-    """Reference `all_reduce_coalesced`: one call over many tensors. XLA fuses
-    the per-leaf collectives scheduled together."""
-    return [all_reduce(t, op=op, axis=axis, group=group) for t in tensors]
+    """Reference `all_reduce_coalesced`: many tensors, ONE compiled dispatch."""
+    axes = _axis_tuple(axis if axis is not None else group)
+    mesh = mesh_mod.get_mesh()
+    if mesh_mod.axis_size(axes) == 1 or not tensors:
+        return [jnp.asarray(t) for t in tensors]
+    fn = _make_coalesced(mesh, axes, op, len(tensors))
+    t0 = time.perf_counter()
+    outs = fn(*[jnp.asarray(t) for t in tensors])
+    if comms_logger.enabled:
+        jax.block_until_ready(outs)
+        comms_logger.append("all_reduce_coalesced",
+                            sum(_nbytes(t) for t in tensors),
+                            time.perf_counter() - t0)
+    return list(outs)
 
 
 def all_gather_coalesced(tensors, axis=None, group=None):
-    return [all_gather(t, axis=axis, group=group) for t in tensors]
+    """Reference `all_gather_coalesced`: many tensors, ONE compiled dispatch."""
+    axes = _axis_tuple(axis if axis is not None else group)
+    mesh = mesh_mod.get_mesh()
+    if mesh_mod.axis_size(axes) == 1 or not tensors:
+        return [jnp.asarray(t) for t in tensors]
+    fn = _make_coalesced(mesh, axes, None, len(tensors))
+    t0 = time.perf_counter()
+    outs = fn(*[jnp.asarray(t) for t in tensors])
+    if comms_logger.enabled:
+        jax.block_until_ready(outs)
+        comms_logger.append("all_gather_coalesced",
+                            sum(_nbytes(t) for t in tensors),
+                            time.perf_counter() - t0)
+    return list(outs)
 
 
 def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
@@ -401,9 +445,16 @@ def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
 
 
 def get_global_rank(group=None, group_rank=0):
-    """Reference `get_global_rank`: with axis-addressed groups the group rank
-    IS defined by mesh position; identity for the default (full) domain."""
-    return group_rank
+    """Reference `get_global_rank`. Identity for the world/default domain;
+    for a sub-axis group the mapping depends on mesh position, which a flat
+    group_rank cannot express — fail loudly rather than return a wrong rank
+    (same policy as the eager p2p stubs)."""
+    if group is None or _axis_tuple(group) in (tuple(mesh_mod.ZERO_AXES),
+                                               tuple(mesh_mod.ALL_AXES)):
+        return group_rank
+    raise NotImplementedError(
+        "get_global_rank for a sub-axis group: ranks are mesh coordinates on "
+        "TPU — derive positions from comm.mesh.get_mesh().devices instead")
 
 
 def get_world_group():

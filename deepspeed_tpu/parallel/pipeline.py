@@ -188,6 +188,91 @@ def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
     return loss_fn
 
 
+def pipeline_forward_fn(embed_fn, block_fn, head_fn, num_stages, num_microbatches):
+    """Pipelined forward-only schedule (reference `InferenceSchedule`,
+    `runtime/pipe/schedule.py:135`): microbatches stream through the stages,
+    the last stage applies `head_fn(params, act, micro_batch, rng) -> out
+    [mb, ...]`, and the concatenated outputs are broadcast to every pipe rank
+    (psum from the single contributing stage — the reference's result bcast).
+
+    Returns forward(params, batch, rng) -> outputs with leading dim M*mb.
+    """
+    PP = num_stages
+    M = num_microbatches
+
+    def local(params, batch, rng):
+        p_idx = jax.lax.axis_index(PIPE_AXIS)
+        blocks = params["blocks"]
+
+        def stage_apply(x, rng):
+            def layer_body(h, lp):
+                return block_fn(lp, h, rng), None
+            out, _ = jax.lax.scan(layer_body, x, blocks)
+            return out
+
+        def mb_view(i):
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, i * (a.shape[0] // M),
+                                                       a.shape[0] // M, axis=0),
+                batch)
+
+        mb0 = mb_view(0)
+        act0 = embed_fn(params["embed"], mb0, rng)
+        zeros_act = jnp.zeros_like(act0)
+        out0 = head_fn(params, act0, mb0, rng)
+        out_buf0 = jnp.zeros((M * out0.shape[0],) + out0.shape[1:], out0.dtype)
+
+        n_ticks = M + PP - 1
+        perm_fwd = [(j, j + 1) for j in range(PP - 1)]
+
+        def tick(carry, t):
+            buf, out_buf = carry
+            mb_idx = t - p_idx
+            active = (mb_idx >= 0) & (mb_idx < M)
+            mb_i = jnp.clip(t, 0, M - 1)
+            embedded = embed_fn(params["embed"], mb_view(mb_i), rng)
+            x_in = jnp.where(p_idx == 0, embedded, buf)
+            y = stage_apply(x_in, rng)
+            y = jnp.where(active, y, zeros_act)
+            out_idx = jnp.clip(t - (PP - 1), 0, M - 1)
+            take = active & (p_idx == PP - 1)
+            out = head_fn(params, y, mb_view(out_idx), rng)
+            out = jnp.where(take, out, jnp.zeros_like(out))
+            start = out_idx * out.shape[0]
+            cur = jax.lax.dynamic_slice_in_dim(out_buf, start, out.shape[0], axis=0)
+            out_buf = jax.lax.dynamic_update_slice_in_dim(out_buf, cur + out,
+                                                          start, axis=0)
+            buf = jax.lax.ppermute(y, PIPE_AXIS, perm_fwd)
+            return (buf, out_buf), None
+
+        (buf, out_buf), _ = jax.lax.scan(tick, (zeros_act, out_buf0),
+                                         jnp.arange(n_ticks))
+        # only the last stage wrote non-zeros; broadcast to all pipe ranks
+        return jax.lax.psum(out_buf, PIPE_AXIS)
+
+    def forward(params, batch, rng=None):
+        mesh = mesh_mod.get_mesh()
+        shards = mesh_mod.axis_size(BATCH_AXES)
+        lead = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        assert lead % (shards * M) == 0, (
+            f"pipelined forward: batch dim {lead} must divide into "
+            f"{shards} data shard(s) x {M} microbatches")
+        param_specs = {
+            "embed": jax.tree_util.tree_map(lambda _: P(), params["embed"]),
+            "blocks": jax.tree_util.tree_map(
+                lambda l: P(*([PIPE_AXIS] + [None] * (l.ndim - 1))), params["blocks"]),
+            "head": jax.tree_util.tree_map(lambda _: P(), params["head"]),
+        }
+        batch_spec = jax.tree_util.tree_map(lambda _: P(BATCH_AXES), batch)
+        with mesh_mod.constraints_disabled():
+            fn = shard_map(local, mesh=mesh,
+                           in_specs=(param_specs, batch_spec, P()),
+                           out_specs=P(BATCH_AXES), check_vma=False)
+            return fn(params, batch, rng)
+
+    return forward
+
+
 def pipeline_param_specs(params):
     """PartitionSpecs matching pipeline_loss_fn's layout."""
     return {
@@ -224,14 +309,22 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
     if not cfg.tie_embeddings:
         params["head"]["lm_head"] = raw["lm_head"]
 
-    def embed_fn(ep, micro_batch, rng):
-        tokens = micro_batch["tokens"][:, :-1]
-        B, T = tokens.shape
+    def _embed_tokens(ep, tokens):
+        T = tokens.shape[1]
         x = jnp.take(ep["wte"], tokens, axis=0).astype(cfg.dtype)
         if not cfg.use_rotary:
             pos = jnp.arange(T, dtype=jnp.int32)[None]
             x = x + jnp.take(ep["wpe"], pos, axis=0).astype(cfg.dtype)
         return x
+
+    def _head_logits(full_params, x):
+        hp = full_params["head"]
+        head_w = hp.get("lm_head", full_params["embed"]["wte"])  # tied by default
+        x = _norm(x, hp["lnf_scale"], hp.get("lnf_bias"), cfg.use_rmsnorm)
+        return jnp.einsum("btd,vd->btv", x, head_w.astype(x.dtype))
+
+    def embed_fn(ep, micro_batch, rng):
+        return _embed_tokens(ep, micro_batch["tokens"][:, :-1])
 
     def block_fn(lp, x, rng):
         B, T, D = x.shape
@@ -239,11 +332,8 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
         return _block(x, lp, cfg=cfg, positions=positions)
 
     def head_loss_fn(full_params, x, micro_batch, rng):
-        hp = full_params["head"]
-        head_w = hp.get("lm_head", full_params["embed"]["wte"])  # tied by default
         labels = micro_batch["tokens"][:, 1:]
-        x = _norm(x, hp["lnf_scale"], hp.get("lnf_bias"), cfg.use_rmsnorm)
-        logits = jnp.einsum("btd,vd->btv", x, head_w.astype(x.dtype)).astype(jnp.float32)
+        logits = _head_logits(full_params, x).astype(jnp.float32)
         logz = jax.nn.logsumexp(logits, axis=-1)
         safe = jnp.maximum(labels, 0)
         gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
@@ -254,5 +344,24 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
                                num_stages=num_stages,
                                num_microbatches=num_microbatches,
                                remat_blocks=cfg.remat)
-    return ModelSpec(loss_fn=loss_fn, params=params,
+
+    # pipelined inference forward (reference InferenceSchedule): full-sequence
+    # logits, microbatches streamed through the stages
+    def fwd_embed_fn(ep, micro_batch, rng):
+        return _embed_tokens(ep, micro_batch["tokens"])
+
+    def fwd_head_fn(full_params, x, micro_batch, rng):
+        return _head_logits(full_params, x)
+
+    pipelined_fwd = pipeline_forward_fn(fwd_embed_fn, block_fn, fwd_head_fn,
+                                        num_stages=num_stages,
+                                        num_microbatches=num_microbatches)
+
+    def apply_fn(params, tokens, rng=None):
+        # uniform ModelSpec.apply_fn contract: raw [B, T] token array
+        # (models/gpt.py gpt_forward signature); dict batches also accepted
+        batch = tokens if isinstance(tokens, dict) else {"tokens": tokens}
+        return pipelined_fwd(params, batch, rng)
+
+    return ModelSpec(loss_fn=loss_fn, params=params, apply_fn=apply_fn,
                      param_specs=pipeline_param_specs(params), name=name)

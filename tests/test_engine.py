@@ -237,3 +237,15 @@ class TestCommParitySurface:
         np.testing.assert_allclose(np.asarray(out), np.full(8, 2.0))
         out_t = comm.inference_all_reduce(x)
         np.testing.assert_allclose(np.asarray(out_t), np.full(8, 4.0))
+
+    def test_coalesced_single_dispatch_and_global_rank(self):
+        import deepspeed_tpu.comm as comm
+        self._mesh(data=8)
+        xs = [jnp.ones((8,), jnp.float32), jnp.full((16,), 2.0)]
+        outs = comm.all_reduce_coalesced(xs, axis="data")
+        np.testing.assert_allclose(np.asarray(outs[0]), np.full(8, 8.0))
+        gath = comm.all_gather_coalesced(xs, axis="data")
+        assert gath[0].shape == (8,) and gath[1].shape == (16,)
+        assert comm.get_global_rank(None, 3) == 3
+        with pytest.raises(NotImplementedError):
+            comm.get_global_rank("tensor", 1)
