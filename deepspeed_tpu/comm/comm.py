@@ -404,8 +404,7 @@ def _make_coalesced(mesh, axes, op, n):
                              out_specs=out_spec, check_vma=False))
 
 
-def all_reduce_coalesced(tensors, op=ReduceOp.SUM, axis=None, group=None):
-    """Reference `all_reduce_coalesced`: many tensors, ONE compiled dispatch."""
+def _coalesced(op_name, tensors, op, axis, group):
     axes = _axis_tuple(axis if axis is not None else group)
     mesh = mesh_mod.get_mesh()
     if mesh_mod.axis_size(axes) == 1 or not tensors:
@@ -415,27 +414,19 @@ def all_reduce_coalesced(tensors, op=ReduceOp.SUM, axis=None, group=None):
     outs = fn(*[jnp.asarray(t) for t in tensors])
     if comms_logger.enabled:
         jax.block_until_ready(outs)
-        comms_logger.append("all_reduce_coalesced",
-                            sum(_nbytes(t) for t in tensors),
+        comms_logger.append(op_name, sum(_nbytes(t) for t in tensors),
                             time.perf_counter() - t0)
     return list(outs)
+
+
+def all_reduce_coalesced(tensors, op=ReduceOp.SUM, axis=None, group=None):
+    """Reference `all_reduce_coalesced`: many tensors, ONE compiled dispatch."""
+    return _coalesced("all_reduce_coalesced", tensors, op, axis, group)
 
 
 def all_gather_coalesced(tensors, axis=None, group=None):
     """Reference `all_gather_coalesced`: many tensors, ONE compiled dispatch."""
-    axes = _axis_tuple(axis if axis is not None else group)
-    mesh = mesh_mod.get_mesh()
-    if mesh_mod.axis_size(axes) == 1 or not tensors:
-        return [jnp.asarray(t) for t in tensors]
-    fn = _make_coalesced(mesh, axes, None, len(tensors))
-    t0 = time.perf_counter()
-    outs = fn(*[jnp.asarray(t) for t in tensors])
-    if comms_logger.enabled:
-        jax.block_until_ready(outs)
-        comms_logger.append("all_gather_coalesced",
-                            sum(_nbytes(t) for t in tensors),
-                            time.perf_counter() - t0)
-    return list(outs)
+    return _coalesced("all_gather_coalesced", tensors, None, axis, group)
 
 
 def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):

@@ -92,6 +92,36 @@ def partition_layers(n_layers, n_stages, method="uniform", costs=None):
 # ----------------------------------------------------------------------
 
 
+def _pipe_inner_specs(params):
+    """shard_map in_specs for the pipeline param layout (embed/head replicated,
+    blocks leading-dim sharded on pipe) — one source of truth for both the
+    training (1F1B) and inference schedules."""
+    return {
+        "embed": jax.tree_util.tree_map(lambda _: P(), params["embed"]),
+        "blocks": jax.tree_util.tree_map(
+            lambda l: P(*([PIPE_AXIS] + [None] * (l.ndim - 1))), params["blocks"]),
+        "head": jax.tree_util.tree_map(lambda _: P(), params["head"]),
+    }
+
+
+def _mb_view(batch, i, M):
+    """Microbatch i of a microbatch-major local batch."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.dynamic_slice_in_dim(a, i * (a.shape[0] // M),
+                                               a.shape[0] // M, axis=0),
+        batch)
+
+
+def _make_stage_apply(block_fn, blocks):
+    """Apply this stage's stacked layers (scan over the local block slice)."""
+    def stage_apply(x, rng):
+        def layer_body(h, lp):
+            return block_fn(lp, h, rng), None
+        out, _ = jax.lax.scan(layer_body, x, blocks)
+        return out
+    return stage_apply
+
+
 def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
                      num_microbatches, remat_blocks=True):
     """Builds loss_fn(params, batch, rng) running the pipelined schedule.
@@ -115,20 +145,10 @@ def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
     def local(params, batch, rng):
         # inside shard_map over ('pipe',): blocks leaf leading dim = layers/stage
         p_idx = jax.lax.axis_index(PIPE_AXIS)
-        blocks = params["blocks"]
+        stage_apply = _make_stage_apply(block_fn, params["blocks"])
 
-        def stage_apply(x, rng):
-            def layer_body(h, lp):
-                return block_fn(lp, h, rng), None
-            out, _ = jax.lax.scan(layer_body, x, blocks)
-            return out
-
-        # micro-batch views
         def mb_view(i):
-            return jax.tree_util.tree_map(
-                lambda a: jax.lax.dynamic_slice_in_dim(a, i * (a.shape[0] // M),
-                                                       a.shape[0] // M, axis=0),
-                batch)
+            return _mb_view(batch, i, M)
 
         mb0 = mb_view(0)
         act0 = embed_fn(params["embed"], mb0, rng)
@@ -171,17 +191,11 @@ def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
 
     def loss_fn(params, batch, rng):
         mesh = mesh_mod.get_mesh()
-        param_specs = {
-            "embed": jax.tree_util.tree_map(lambda _: P(), params["embed"]),
-            "blocks": jax.tree_util.tree_map(
-                lambda l: P(*([PIPE_AXIS] + [None] * (l.ndim - 1))), params["blocks"]),
-            "head": jax.tree_util.tree_map(lambda _: P(), params["head"]),
-        }
         # batch stays data-sharded on its leading dim (composes PP × DP)
         batch_spec = jax.tree_util.tree_map(lambda _: P(BATCH_AXES), batch)
         with mesh_mod.constraints_disabled():
             fn = shard_map(local, mesh=mesh,
-                           in_specs=(param_specs, batch_spec, P()),
+                           in_specs=(_pipe_inner_specs(params), batch_spec, P()),
                            out_specs=P(), check_vma=False)
             return fn(params, batch, rng)
 
@@ -202,19 +216,10 @@ def pipeline_forward_fn(embed_fn, block_fn, head_fn, num_stages, num_microbatche
 
     def local(params, batch, rng):
         p_idx = jax.lax.axis_index(PIPE_AXIS)
-        blocks = params["blocks"]
-
-        def stage_apply(x, rng):
-            def layer_body(h, lp):
-                return block_fn(lp, h, rng), None
-            out, _ = jax.lax.scan(layer_body, x, blocks)
-            return out
+        stage_apply = _make_stage_apply(block_fn, params["blocks"])
 
         def mb_view(i):
-            return jax.tree_util.tree_map(
-                lambda a: jax.lax.dynamic_slice_in_dim(a, i * (a.shape[0] // M),
-                                                       a.shape[0] // M, axis=0),
-                batch)
+            return _mb_view(batch, i, M)
 
         mb0 = mb_view(0)
         act0 = embed_fn(params["embed"], mb0, rng)
@@ -257,16 +262,10 @@ def pipeline_forward_fn(embed_fn, block_fn, head_fn, num_stages, num_microbatche
         assert lead % (shards * M) == 0, (
             f"pipelined forward: batch dim {lead} must divide into "
             f"{shards} data shard(s) x {M} microbatches")
-        param_specs = {
-            "embed": jax.tree_util.tree_map(lambda _: P(), params["embed"]),
-            "blocks": jax.tree_util.tree_map(
-                lambda l: P(*([PIPE_AXIS] + [None] * (l.ndim - 1))), params["blocks"]),
-            "head": jax.tree_util.tree_map(lambda _: P(), params["head"]),
-        }
         batch_spec = jax.tree_util.tree_map(lambda _: P(BATCH_AXES), batch)
         with mesh_mod.constraints_disabled():
             fn = shard_map(local, mesh=mesh,
-                           in_specs=(param_specs, batch_spec, P()),
+                           in_specs=(_pipe_inner_specs(params), batch_spec, P()),
                            out_specs=P(BATCH_AXES), check_vma=False)
             return fn(params, batch, rng)
 

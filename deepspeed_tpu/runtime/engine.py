@@ -156,13 +156,51 @@ class Engine:
             optimizer = build_optimizer(config.optimizer, self.schedule_fn)
         self.optimizer = optimizer  # optax GradientTransformation
         off_cfg = config.zero_optimization.offload_optimizer
-        # "cpu": optimizer state in pinned host memory, step stays compiled.
-        # "nvme": ZeRO-Infinity tier — fp32 master + moments on host/disk, the
-        # step runs in C++ (csrc/cpu_optim) while only bit16 params live on device.
+        # "cpu": optimizer state in pinned host memory; the compiled step
+        #   streams it through HBM — fast, but the fp32 state must FIT through
+        #   HBM transiently. Models too big for that (and "nvme", and forced
+        #   CPU-optimizer configs) take the ZeRO-Infinity tier: fp32 master +
+        #   moments owned by the C++ host optimizer (csrc/cpu_optim), the step
+        #   runs on the host while only bit16 params live on device — the
+        #   reference ZeRO-Offload's "step on CPU" semantics.
+        self.nvme_offload = off_cfg is not None and off_cfg.device == "nvme"
+        cpu_off = off_cfg is not None and off_cfg.device == "cpu"
+        force_host_step = bool(
+            config.zero_force_ds_cpu_optimizer
+            or (config.optimizer and
+                config.optimizer.type.lower().startswith("deepspeedcpu")))
+        if cpu_off and not force_host_step:
+            try:
+                from deepspeed_tpu.platform import get_accelerator
+                hbm = get_accelerator().total_memory()
+            except Exception:
+                hbm = 0
+            if not hbm:  # stats unavailable (e.g. tunneled runtimes): assume v5e
+                hbm = 16 * 2**30
+            # params bf16 + fp32 master + adam m/v transit HBM in the update —
+            # PER DEVICE: ZeRO partitions the state over the data domain
+            shards = max(mesh_mod.axis_size(mesh_mod.ZERO_AXES), 1)
+            est = 14 * tree_num_params(model.params) // shards
+            opt_name = (config.optimizer.type.lower() if config.optimizer else "adam")
+            host_kind_known = any(k in opt_name for k in ("adam", "lion", "adagrad"))
+            if est > 0.6 * hbm:
+                if host_kind_known:
+                    log_dist(f"offload_optimizer(cpu): per-device fp32 state "
+                             f"(~{est/2**30:.1f}G) cannot stream through "
+                             f"{hbm/2**30:.1f}G HBM — using the host (C++) "
+                             "optimizer step", ranks=[0])
+                    force_host_step = True
+                else:
+                    logger.warning(
+                        f"offload_optimizer(cpu): per-device fp32 state "
+                        f"(~{est/2**30:.1f}G) likely exceeds HBM during the "
+                        f"streamed update, but optimizer '{opt_name}' has no "
+                        "host (C++) implementation — keeping the streamed step "
+                        "(may OOM); use adam/lion/adagrad for host offload")
+        self.nvme_offload = self.nvme_offload or (cpu_off and force_host_step)
         self.offload_optimizer_states = bool(
             getattr(optimizer, "offload_to_host", False)
-            or (off_cfg is not None and off_cfg.device == "cpu"))
-        self.nvme_offload = off_cfg is not None and off_cfg.device == "nvme"
+            or (cpu_off and not force_host_step))
         self.host_optimizer = None
 
         # ---- loss fn
@@ -280,10 +318,15 @@ class Engine:
         step = jax.device_put(jnp.asarray(0, jnp.int32), rep)
         rng = jax.device_put(jax.random.PRNGKey(self.config.seed), rep)
 
+        # the step program's in/out shardings must carry the ACTUAL placement —
+        # pinned host memory when the "cpu" offload tier is active
+        opt_state_shardings = (self._host_opt_shardings()
+                               if self.offload_optimizer_states
+                               else self.opt_shardings)
         self.state_shardings = TrainState(
             params=self.param_shardings,
             master=self.master_shardings if master is not None else None,
-            opt_state=self.opt_shardings,
+            opt_state=opt_state_shardings,
             scaler=LossScaleState(rep, rep, rep, rep),
             step=rep,
             rng=rep,
@@ -326,13 +369,16 @@ class Engine:
             step=jax.device_put(jnp.asarray(0, jnp.int32), rep),
             rng=jax.device_put(jax.random.PRNGKey(self.config.seed), rep))
 
+    def _host_opt_shardings(self):
+        """Pinned-host variants of the optimizer-state shardings (one source
+        of truth for the offload tier's placement)."""
+        return jax.tree_util.tree_map(lambda s: s.with_memory_kind("pinned_host"),
+                                      self.opt_shardings)
+
     def _to_host(self, tree):
         """Move a pytree to pinned host memory (ZeRO-Offload optimizer states)."""
-        def host_shard(s):
-            return s.with_memory_kind("pinned_host")
-        host_shardings = jax.tree_util.tree_map(host_shard, self.opt_shardings)
         try:
-            return jax.device_put(tree, host_shardings)
+            return jax.device_put(tree, self._host_opt_shardings())
         except Exception as e:  # CPU backend has no pinned_host memory space
             logger.warning(f"optimizer-state host offload unavailable on this platform ({e}); "
                            "keeping states in device memory")
@@ -373,6 +419,10 @@ class Engine:
         param_shardings = self.param_shardings
         schedule_fn = self.schedule_fn
 
+        offload_opt = bool(getattr(self, "offload_optimizer_states", False))
+        opt_dev_shardings = self.opt_shardings
+        opt_host_shardings = self._host_opt_shardings() if offload_opt else None
+
         def apply_grads(state, grads, loss):
             # ZeRO: constrain grads → reduce-scatter (stage>=2) or allreduce layout
             grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
@@ -385,12 +435,20 @@ class Engine:
                 grads = jax.tree_util.tree_map(lambda g: g * factor.astype(g.dtype), grads)
 
             target = state.master if keep_master else state.params
-            updates, new_opt = optimizer.update(grads, state.opt_state, target)
+            # "cpu" offload tier: states live in pinned host memory between
+            # steps; stream them through HBM for the update (the reference
+            # instead runs the step on the CPU — ZeRO-Offload's overlap is
+            # XLA's to schedule here)
+            opt_in = (jax.device_put(state.opt_state, opt_dev_shardings)
+                      if offload_opt else state.opt_state)
+            updates, new_opt = optimizer.update(grads, opt_in, target)
             new_target = optax.apply_updates(target, updates)
 
             # masked skip-step on overflow (reference: FP16_Optimizer.step overflow path)
             new_target = masked_update(new_target, target, finite)
-            new_opt = masked_update(new_opt, state.opt_state, finite)
+            new_opt = masked_update(new_opt, opt_in, finite)
+            if offload_opt:
+                new_opt = jax.device_put(new_opt, opt_host_shardings)
 
             if keep_master:
                 new_params = tree_cast(new_target, compute_dtype)
