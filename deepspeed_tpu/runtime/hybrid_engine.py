@@ -7,9 +7,10 @@ reference juggles this with param gather/release and module swapping.
 
 TPU-native: params are global sharded arrays, so "flipping" is free — the decode
 program simply reads the CURRENT training params (XLA re-gathers per program as
-its sharding demands); no LoRA fuse/unfuse or cache retake machinery needed.
-`HybridEngine` = training Engine + a decode path compiled against the live
-params, with the reference's `generate()` surface.
+its sharding demands); no cache retake machinery needed. LoRA-based RLHF uses
+`runtime/lora.py` (apply/fuse/unfuse — the reference's LoRA lifecycle as pure
+functions). `HybridEngine` = training Engine + a decode path compiled against
+the live params, with the reference's `generate()` surface.
 """
 
 from typing import Optional

@@ -1,0 +1,130 @@
+"""LoRA adapters — low-rank deltas with fuse/unfuse.
+
+Reference: the Hybrid Engine's LoRA handling (`runtime/hybrid_engine.py:32`
+fuses LoRA weights into the base matrices before injected-kernel inference and
+unfuses for the next training phase).
+
+TPU formulation: the adapter is a pytree mirroring the params tree with
+{"a": [in, r], "b": [r, out]} at adapted 2-D leaves. Three pure functions
+cover the reference's lifecycle:
+  * `apply_lora`  — W_eff = W + scale·(a@b), traced into the forward (training:
+    only the adapter leaves get gradients; the base stays frozen)
+  * `fuse_lora`   — materialize W + scale·(a@b) once (inference/generation)
+  * `unfuse_lora` — subtract it back out (resume training after generate)
+"""
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # which 2-D leaves get adapters: path predicate ("/"-joined key path)
+    match: Optional[Callable[[str], bool]] = None
+
+    @property
+    def scaling(self):
+        return self.alpha / self.rank
+
+
+def _default_match(path):
+    # attention + mlp projection matrices in the model zoo's naming; NOT the
+    # embeddings (wte/wpe) or tied output head
+    leaf = path.rsplit("/", 1)[-1]
+    return leaf in ("attn_qkv_w", "attn_out_w", "mlp_up_w", "mlp_down_w",
+                    "mlp_gate_w")
+
+
+def init_lora(params, cfg: LoRAConfig, seed=0):
+    """Adapter tree for every matched 2-D leaf: a ~ N(0, 1/r) (kaiming-style),
+    b = 0 — so the adapted model starts EXACTLY at the base model."""
+    match = cfg.match or _default_match
+    rng = np.random.default_rng(seed)
+
+    def build(tree, path=()):
+        if isinstance(tree, dict):
+            out = {}
+            for k, v in tree.items():
+                sub = build(v, path + (str(k),))
+                if sub is not None:
+                    out[k] = sub
+            return out or None
+        leaf = tree
+        p = "/".join(path)
+        if getattr(leaf, "ndim", 0) == 2 and match(p):
+            din, dout = leaf.shape[-2], leaf.shape[-1]
+            a = jnp.asarray(rng.normal(0, 1.0 / cfg.rank, (din, cfg.rank)),
+                            jnp.float32)
+            return {"a": a.astype(leaf.dtype),
+                    "b": jnp.zeros((cfg.rank, dout), leaf.dtype)}
+        if getattr(leaf, "ndim", 0) == 3 and match(p):
+            # stacked-block leaves [L, din, dout] (the zoo's scan layout)
+            L, din, dout = leaf.shape
+            a = jnp.asarray(rng.normal(0, 1.0 / cfg.rank, (L, din, cfg.rank)),
+                            jnp.float32)
+            return {"a": a.astype(leaf.dtype),
+                    "b": jnp.zeros((L, cfg.rank, dout), leaf.dtype)}
+        return None
+
+    return build(params) or {}
+
+
+def _delta(ad, scaling):
+    a, b = ad["a"], ad["b"]
+    if a.ndim == 3:
+        return scaling * jnp.einsum("lir,lro->lio", a, b)
+    return scaling * (a @ b)
+
+
+def _map_adapted(params, lora, fn):
+    """Rebuild params applying fn(leaf, adapter) where an adapter exists."""
+    def rec(p, l):
+        if isinstance(p, dict):
+            return {k: rec(v, (l or {}).get(k)) for k, v in p.items()}
+        return p if not isinstance(l, dict) or "a" not in l else fn(p, l)
+
+    return rec(params, lora)
+
+
+def apply_lora(params, lora, cfg: LoRAConfig):
+    """Effective weights for the forward pass (traced; grads flow to a/b)."""
+    s = cfg.scaling
+    return _map_adapted(params, lora,
+                        lambda w, ad: w + _delta(ad, s).astype(w.dtype))
+
+
+def fuse_lora(params, lora, cfg: LoRAConfig):
+    """Materialize the merged weights (reference fuse before generate)."""
+    return apply_lora(params, lora, cfg)
+
+
+def unfuse_lora(params, lora, cfg: LoRAConfig):
+    """Inverse of fuse_lora (reference unfuse after generate).
+
+    Subtraction happens in fp32 to minimize rounding drift, but in low
+    precision (bf16 base) repeated fuse/unfuse cycles still accumulate error —
+    prefer keeping the pristine base tree and re-deriving with `apply_lora`
+    (free under XLA) over round-tripping through the fused weights."""
+    s = cfg.scaling
+    return _map_adapted(
+        params, lora,
+        lambda w, ad: (w.astype(jnp.float32) - _delta(ad, s).astype(jnp.float32)
+                       ).astype(w.dtype))
+
+
+def lora_loss_fn(base_loss_fn, frozen_params, cfg: LoRAConfig):
+    """loss_fn(lora, batch[, rng]) training ONLY the adapter. The base is
+    frozen because it is a closed-over constant, not the differentiated
+    argument; stop_gradient inside the trace documents and enforces that."""
+
+    def loss_fn(lora, batch, rng=None):
+        frozen = jax.lax.stop_gradient(frozen_params)
+        return base_loss_fn(apply_lora(frozen, lora, cfg), batch, rng)
+
+    return loss_fn
