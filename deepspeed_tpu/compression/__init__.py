@@ -1,3 +1,5 @@
-from deepspeed_tpu.compression.compress import init_compression, redundancy_clean
+from deepspeed_tpu.compression.compress import (apply_layer_reduction,
+                                                init_compression,
+                                                redundancy_clean)
 from deepspeed_tpu.compression.basic_layer import fake_quantize, prune_magnitude
 from deepspeed_tpu.compression.scheduler import CompressionScheduler

@@ -14,6 +14,7 @@ stored params permanently.
 import re
 
 import jax
+import jax.numpy as jnp
 
 from deepspeed_tpu.compression.basic_layer import fake_quantize, prune_magnitude
 from deepspeed_tpu.utils.logging import logger
@@ -75,24 +76,60 @@ def _build_param_transform(groups):
     return transform
 
 
+def apply_layer_reduction(params, lr_cfg):
+    """Student initialization from teacher layers (reference
+    `compression/compress.py` layer_reduction + `student_initialization`:
+    copy the listed teacher layers into the shallower student). The model
+    zoo stacks blocks on a leading layer axis and scans over it, so the
+    student is a pure slice — forward/loss work unchanged at the new depth."""
+    keep = lr_cfg.get("teacher_layer")
+    if keep is None:
+        keep = list(range(int(lr_cfg.get("keep_number_layer", 0))))
+    assert keep, "layer_reduction: set teacher_layer or keep_number_layer"
+    assert "blocks" in params, (
+        "layer_reduction needs the stacked-blocks param layout "
+        "(params['blocks'] leaves with a leading layer axis, as the model zoo "
+        f"produces); got keys {sorted(params)}")
+    depth = jax.tree_util.tree_leaves(params["blocks"])[0].shape[0]
+    assert max(keep) < depth and min(keep) >= 0, (
+        f"layer_reduction: teacher_layer {keep} out of range for a "
+        f"{depth}-layer teacher (jnp indexing would silently clamp)")
+    idx = jnp.asarray(keep, jnp.int32)
+    out = dict(params)
+    out["blocks"] = jax.tree_util.tree_map(lambda a: a[idx], params["blocks"])
+    logger.info(f"layer_reduction: student keeps teacher layers {keep}")
+    return out
+
+
 def init_compression(model, deepspeed_config, teacher_model=None, mpu=None):
     """Returns a ModelSpec with the compression transforms woven into the loss.
     `model` is a ModelSpec (reference takes an nn.Module)."""
     from deepspeed_tpu.config.core import TpuTrainConfig
     from deepspeed_tpu.runtime.engine import ModelSpec
     cfg = TpuTrainConfig.load(deepspeed_config)
+    lr_cfg = cfg.compression_training.layer_reduction
     groups = _extract_groups(cfg.compression_training)
-    if not groups:
+    if not groups and not lr_cfg.get("enabled"):
         logger.warning("init_compression: no enabled compression blocks")
         return model
-    transform = _build_param_transform(groups)
+
+    params = model.params
+    if lr_cfg.get("enabled"):
+        src = teacher_model.params if teacher_model is not None else params
+        params = apply_layer_reduction(src, lr_cfg)
+
     inner_loss = model.loss_fn
+    if groups:
+        transform = _build_param_transform(groups)
 
-    def compressed_loss(params, batch, rng=None):
-        return inner_loss(transform(params), batch, rng)
+        def compressed_loss(params, batch, rng=None):
+            return inner_loss(transform(params), batch, rng)
+    else:
+        compressed_loss = inner_loss
 
-    logger.info(f"compression enabled: {[g[0] for g in groups]}")
-    return ModelSpec(loss_fn=compressed_loss, params=model.params,
+    logger.info(f"compression enabled: {[g[0] for g in groups]}"
+                + (" + layer_reduction" if lr_cfg.get("enabled") else ""))
+    return ModelSpec(loss_fn=compressed_loss, params=params,
                      param_specs=model.param_specs, apply_fn=model.apply_fn,
                      has_aux=model.has_aux, name=model.name + "+compress")
 

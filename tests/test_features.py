@@ -428,3 +428,45 @@ def test_apply_exp_dotted_paths():
     assert cfg["zero_optimization"]["stage"] == 3
     assert cfg["train_micro_batch_size_per_gpu"] == 4
     assert cfg["activation_checkpointing"]["policy"] == "full"
+
+
+def test_layer_reduction_student_init():
+    """Distillation student init (reference layer_reduction +
+    student_initialization): student = slice of teacher's stacked blocks."""
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.compression import init_compression, apply_layer_reduction
+    from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_model, gpt_forward
+    _reset()
+    cfg = GPTConfig(n_layer=4, n_head=4, d_model=64, d_ff=256, max_seq_len=64,
+                    vocab_size=256, dtype=jnp.float32, remat=False)
+    teacher = make_gpt_model(cfg=cfg, name="teacher")
+    ds_cfg = {"train_micro_batch_size_per_gpu": 2, "mesh": {"data": 8},
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "compression_training": {"layer_reduction": {
+                  "enabled": True, "teacher_layer": [0, 3]}}}
+    student = init_compression(teacher, ds_cfg)
+    assert student.params["blocks"]["attn_qkv_w"].shape[0] == 2
+    # student layer 1 == teacher layer 3 weights
+    np.testing.assert_array_equal(
+        np.asarray(student.params["blocks"]["attn_qkv_w"][1]),
+        np.asarray(teacher.params["blocks"]["attn_qkv_w"][3]))
+    # trains end-to-end at the reduced depth
+    eng, *_ = deepspeed_tpu.initialize(model=student, config=ds_cfg)
+    batch = {"tokens": np.random.default_rng(0).integers(
+        0, 256, (16, 17)).astype(np.int32)}
+    losses = [float(eng.train_batch(batch)) for _ in range(4)]
+    assert losses[-1] < losses[0]
+
+
+def test_layer_reduction_validates_inputs():
+    from deepspeed_tpu.compression import apply_layer_reduction
+    from deepspeed_tpu.models.gpt import GPTConfig, init_gpt_params
+    params = init_gpt_params(GPTConfig(n_layer=4, n_head=4, d_model=64,
+                                       vocab_size=256, max_seq_len=64,
+                                       dtype=jnp.float32), seed=0)
+    with pytest.raises(AssertionError, match="out of range"):
+        apply_layer_reduction(params, {"teacher_layer": [0, 4]})
+    with pytest.raises(AssertionError, match="stacked-blocks"):
+        apply_layer_reduction({"layer_0": {"w": jnp.zeros((4, 4))}},
+                              {"teacher_layer": [0]})
