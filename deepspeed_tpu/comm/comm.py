@@ -499,3 +499,21 @@ def isend(tensor, dst, group=None, tag=0):
 
 def irecv(tensor, src, group=None, tag=0):
     _no_eager_p2p("irecv")
+
+
+def is_available():
+    """Reference `comm.is_available` (torch.distributed availability probe)."""
+    return True
+
+
+def destroy_process_group(group=None):
+    """Reference `destroy_process_group`: tear down the installed mesh (and
+    multi-process runtime state) so a fresh init_distributed can follow."""
+    global _INITIALIZED
+    mesh_mod.clear_mesh()
+    if jax.process_count() > 1:
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:  # already down / never brought up
+            logger.warning(f"jax.distributed.shutdown: {e}")
+    _INITIALIZED = False

@@ -144,6 +144,13 @@ def has_mesh() -> bool:
     return _CURRENT_MESH is not None
 
 
+def clear_mesh():
+    """Uninstall the global mesh (engine teardown / test isolation)."""
+    global _CURRENT_MESH, _CURRENT_SPEC
+    _CURRENT_MESH = None
+    _CURRENT_SPEC = None
+
+
 def init_mesh(mesh_config=None, devices=None, n_devices=None) -> Mesh:
     """Build + install the global mesh from a MeshConfig (or default: all-data)."""
     from deepspeed_tpu.config.core import MeshConfig

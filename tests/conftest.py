@@ -28,8 +28,7 @@ def _reset_global_mesh():
     """Each test starts without an installed global mesh."""
     from deepspeed_tpu.comm import mesh as mesh_mod
     yield
-    mesh_mod._CURRENT_MESH = None
-    mesh_mod._CURRENT_SPEC = None
+    mesh_mod.clear_mesh()
 
 
 @pytest.fixture

@@ -249,3 +249,14 @@ class TestCommParitySurface:
         assert comm.get_global_rank(None, 3) == 3
         with pytest.raises(NotImplementedError):
             comm.get_global_rank("tensor", 1)
+
+    def test_destroy_process_group(self):
+        import deepspeed_tpu.comm as comm
+        from deepspeed_tpu.comm import mesh as mesh_mod
+        self._mesh(data=8)
+        assert comm.is_available()
+        comm.destroy_process_group()
+        assert not mesh_mod.has_mesh()
+        # fresh bring-up works after teardown
+        comm.init_distributed()
+        assert mesh_mod.has_mesh()

@@ -435,8 +435,8 @@ def test_layer_reduction_student_init():
     student_initialization): student = slice of teacher's stacked blocks."""
     import jax
     import deepspeed_tpu
-    from deepspeed_tpu.compression import init_compression, apply_layer_reduction
-    from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_model, gpt_forward
+    from deepspeed_tpu.compression import init_compression
+    from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_model
     _reset()
     cfg = GPTConfig(n_layer=4, n_head=4, d_model=64, d_ff=256, max_seq_len=64,
                     vocab_size=256, dtype=jnp.float32, remat=False)
