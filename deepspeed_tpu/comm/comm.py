@@ -435,13 +435,25 @@ def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
     return barrier()
 
 
+def _data_domain_is_world() -> bool:
+    """True when the mesh has no model-parallel axes, i.e. the data domain
+    (ZERO_AXES) spans every device."""
+    if not mesh_mod.has_mesh():
+        return True
+    return all(mesh_mod.axis_size(a) == 1
+               for a in (mesh_mod.PIPE_AXIS, mesh_mod.EXPERT_AXIS,
+                         mesh_mod.TENSOR_AXIS))
+
+
 def get_global_rank(group=None, group_rank=0):
-    """Reference `get_global_rank`. Identity for the world/default domain;
-    for a sub-axis group the mapping depends on mesh position, which a flat
-    group_rank cannot express — fail loudly rather than return a wrong rank
-    (same policy as the eager p2p stubs)."""
-    if group is None or _axis_tuple(group) in (tuple(mesh_mod.ZERO_AXES),
-                                               tuple(mesh_mod.ALL_AXES)):
+    """Reference `get_global_rank`. Identity for the world group (and for the
+    data domain when it spans the whole mesh); for a sub-axis group the
+    mapping depends on mesh position, which a flat group_rank cannot express —
+    fail loudly rather than return a wrong rank (same policy as the eager p2p
+    stubs)."""
+    if group is None or _axis_tuple(group) == tuple(mesh_mod.ALL_AXES):
+        return group_rank
+    if _axis_tuple(group) == tuple(mesh_mod.ZERO_AXES) and _data_domain_is_world():
         return group_rank
     raise NotImplementedError(
         "get_global_rank for a sub-axis group: ranks are mesh coordinates on "
@@ -449,8 +461,10 @@ def get_global_rank(group=None, group_rank=0):
 
 
 def get_world_group():
-    """Reference `get_world_group` — the full data domain's axis names."""
-    return mesh_mod.ZERO_AXES
+    """Reference `get_world_group` — all mesh axes (every device), matching
+    the reference's all-ranks world-group semantics even when the mesh has
+    tensor/pipe/expert axes."""
+    return mesh_mod.ALL_AXES
 
 
 def new_group(ranks=None):

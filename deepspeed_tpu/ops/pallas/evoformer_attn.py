@@ -227,9 +227,14 @@ def evoformer_attention(q, k, v, biases=(), sm_scale=None,
 
     if interpret is None:
         interpret = _use_interpret()
-    use_pallas = (S % min(block_q, S) == 0 and S % min(block_k, S) == 0
-                  and S >= 8)
-    mode = (min(block_q, S), min(block_k, S), interpret) if use_pallas else None
+    bq, bk = min(block_q, S), min(block_k, S)
+    use_pallas = S % bq == 0 and S % bk == 0 and S >= 8
+    if use_pallas and not interpret:
+        # On real hardware require tile-aligned shapes (8-sublane blocks,
+        # 128-lane head dim) — same conservatism as flash_attention; anything
+        # else falls back to the XLA path until hardware-verified.
+        use_pallas = bq % 8 == 0 and bk % 8 == 0 and D % 128 == 0
+    mode = (bq, bk, interpret) if use_pallas else None
     if mode is None:
         out = _evo_core(qi, ki, vi, mask, pair, float(sm_scale), 0, 0, "jnp")
     else:

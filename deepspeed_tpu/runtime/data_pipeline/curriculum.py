@@ -88,6 +88,8 @@ def apply_seqlen_curriculum(batch, difficulty, ignore_index=-1, bucketize=None):
         if difficulty < T:
             labels[:, max(difficulty - 1, 0):] = ignore_index
         out["tokens"] = inputs
+        if "input_ids" in out:  # keep the alternative key consistent with labels
+            out["input_ids"] = inputs
         out["labels"] = labels
     elif difficulty < T:
         labels = np.asarray(labels).astype(np.int32).copy()

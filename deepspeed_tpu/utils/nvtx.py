@@ -7,16 +7,33 @@ import functools
 
 import jax
 
+# LIFO of open ranges so range_pop() matches the reference accelerator API
+# (`accelerator/abstract_accelerator.py` range_pop takes no arguments).
+_RANGE_STACK = []
+
 
 def range_push(msg):
     """Start a named range (reference accelerator.range_push)."""
     t = jax.profiler.TraceAnnotation(msg)
     t.__enter__()
+    _RANGE_STACK.append(t)
     return t
 
 
-def range_pop(t):
-    """End a range started with range_push."""
+def range_pop(t=None):
+    """End a range started with range_push. With no argument, pops the most
+    recently pushed range (reference API); a handle may also be passed."""
+    if t is None:
+        if not _RANGE_STACK:
+            return
+        t = _RANGE_STACK.pop()
+    else:
+        # remove the handle wherever it sits so a later argless pop never
+        # exits it a second time
+        try:
+            _RANGE_STACK.remove(t)
+        except ValueError:
+            pass
     t.__exit__(None, None, None)
 
 

@@ -214,8 +214,16 @@ class TestCommParitySurface:
 
     def test_new_group_warns_and_defaults(self):
         import deepspeed_tpu.comm as comm
+        from deepspeed_tpu.comm import mesh as mesh_mod
         self._mesh(data=8)
-        assert comm.new_group([0, 1]) == comm.get_world_group()
+        # new_group falls back to the data domain; the world group spans ALL
+        # mesh axes (reference all-ranks semantics, even with tp/pp axes).
+        assert comm.new_group([0, 1]) == tuple(mesh_mod.ZERO_AXES)
+        assert comm.get_world_group() == tuple(mesh_mod.ALL_AXES)
+        # identity fast-path holds for the data domain only while it spans
+        # the whole mesh
+        assert comm.get_global_rank(comm.new_group([0, 1]), 3) == 3
+        assert comm.get_global_rank(comm.get_world_group(), 5) == 5
 
     def test_scatter_list_and_group_semantics(self):
         import deepspeed_tpu.comm as comm
