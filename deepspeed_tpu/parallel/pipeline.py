@@ -6,17 +6,21 @@ LayerSpec list partitioned by parameters/uniform), `PipelineEngine`
 TrainSchedule/1F1B) with explicit P2P (`pipe/p2p.py`).
 
 TPU-native formulation: ONE compiled SPMD program. Stage parameters are stacked
-[PP, layers_per_stage, ...] and sharded on `pipe`; the fill-drain (GPipe) schedule
-is a `lax.scan` of M + PP - 1 ticks inside `shard_map`; stage handoff is a
-`ppermute` shift — the instruction stream, P2P meta exchange and schedule
-interpreter of the reference collapse into this loop. Backward falls out of
-autodiff through the scan (activations rematerialized per-stage via
-`jax.checkpoint`), giving 1F1B-like memory behavior without hand-written
-instruction scheduling.
+[PP, layers_per_stage, ...] and sharded on `pipe`; a schedule is a `lax.scan`
+of ticks inside `shard_map`; stage handoff is a `ppermute` shift — the
+instruction stream, P2P meta exchange and schedule interpreter of the
+reference collapse into this loop. Two schedules:
 
-Embedding lives on stage 0, LM head + loss on the last stage; both are computed
-masked on every rank (SPMD) with their parameters replicated over `pipe` — the
-bubble overhead is the standard (PP-1)/M fill-drain cost.
+* `pipeline_loss_fn` — fill-drain (GPipe) forward; backward by autodiff
+  through the scan (O(M) live activations, used for eval / as a fallback).
+* `pipeline_grad_fn` — 1F1B training schedule (reference `TrainSchedule`,
+  `pipe/schedule.py:189`): forward and delayed backward micro-steps
+  interleaved in one scan, stage inputs stashed in a 2*PP ring buffer,
+  backward recomputed via `jax.vjp` — O(PP) live activations.
+
+Embedding lives on stage 0, LM head + loss on the last stage; their params are
+replicated over `pipe` but their compute runs under `lax.cond` on the owning
+stage only. Bubble overhead is the standard (PP-1)/M fill-drain cost.
 """
 
 import dataclasses
@@ -58,12 +62,23 @@ class TiedLayerSpec(LayerSpec):
         self.key = key
 
 
-def partition_layers(n_layers, n_stages, method="uniform", costs=None):
+def partition_layers(n_layers, n_stages, method="uniform", costs=None, names=None):
     """Layer → stage assignment (reference `PipelineModule` partition methods
-    `module.py:370-386`): 'uniform' (equal counts) or 'parameters' (balance by
-    per-layer cost)."""
+    `module.py:370-386`): 'uniform' (equal counts), 'parameters' (balance by
+    per-layer cost), or 'type:regex' (balance the count of layers whose name
+    matches the regex; non-matching layers ride along with their stage —
+    reference `module.py:385`)."""
     if method.startswith("type:"):
-        raise NotImplementedError("type: regex partitioning needs named layers")
+        import re
+        if names is None:
+            raise ValueError(
+                "type: regex partitioning needs layer names — pass names=[...] "
+                "(the reference matches layer class names, pipe/module.py:385)")
+        pattern = re.compile(method[len("type:"):])
+        weights = [1.0 if pattern.search(str(n)) else 0.0 for n in names]
+        if sum(weights) == 0:
+            raise ValueError(f"no layer name matches {method!r}: {names}")
+        return partition_layers(n_layers, n_stages, "parameters", costs=weights)
     if method == "parameters" and costs is not None:
         costs = np.asarray(costs, dtype=np.float64)
         target = costs.sum() / n_stages
@@ -151,8 +166,8 @@ def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
             return _mb_view(batch, i, M)
 
         mb0 = mb_view(0)
-        act0 = embed_fn(params["embed"], mb0, rng)
-        zeros_act = jnp.zeros_like(act0)
+        act_shape = jax.eval_shape(embed_fn, params["embed"], mb0, rng)
+        zeros_act = jnp.zeros(act_shape.shape, act_shape.dtype)
 
         n_ticks = M + PP - 1
         perm_fwd = [(j, j + 1) for j in range(PP - 1)]
@@ -161,19 +176,27 @@ def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
             buf, loss_sum, n_done = carry
             mb_idx = t - p_idx
             active = (mb_idx >= 0) & (mb_idx < M)
-            # stage 0 reads its microbatch; others read the handed-off activation.
-            # (masked select, not cond: divergent-per-rank cond around code the
-            # partitioner may weave collectives into deadlocks the SPMD program)
+            # Stage 0 reads its microbatch; others read the handed-off
+            # activation. Embed and head run under lax.cond so only the owning
+            # stage pays their flops — safe because both branches are
+            # collective-free (ppermute/psum stay at tick top level).
             mb_i = jnp.clip(t, 0, M - 1)
-            embedded = embed_fn(params["embed"], mb_view(mb_i), rng)
-            x_in = jnp.where(p_idx == 0, embedded, buf)
+            x_in = jax.lax.cond(
+                p_idx == 0,
+                lambda: embed_fn(params["embed"], mb_view(mb_i), rng),
+                lambda: buf)
             y = stage_apply(x_in, rng)
             y = jnp.where(active, y, zeros_act)
-            # last stage: loss of its active microbatch
+            # last stage: loss of its active microbatch (owner-only compute —
+            # the [mb,T,d]x[d,V] head matmul is a large fraction of stage flops)
             out_idx = jnp.clip(t - (PP - 1), 0, M - 1)
             take = active & (p_idx == PP - 1)
-            mb_loss = head_loss_fn(params, y, mb_view(out_idx), rng)
-            loss_sum = loss_sum + jnp.where(take, mb_loss.astype(jnp.float32), 0.0)
+            mb_loss = jax.lax.cond(
+                take,
+                lambda: head_loss_fn(params, y, mb_view(out_idx), rng).astype(
+                    jnp.float32),
+                lambda: jnp.asarray(0.0, jnp.float32))
+            loss_sum = loss_sum + mb_loss
             n_done = n_done + jnp.where(take, 1, 0)
             buf = jax.lax.ppermute(y, PIPE_AXIS, perm_fwd)
             return (buf, loss_sum, n_done), None
@@ -202,6 +225,184 @@ def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
     return loss_fn
 
 
+def pipeline_grad_fn(embed_fn, block_fn, head_loss_fn, num_stages,
+                     num_microbatches, remat_blocks=True):
+    """1F1B-structured pipelined (loss, grads) — reference `TrainSchedule`
+    (`runtime/pipe/schedule.py:189`).
+
+    One `lax.scan` interleaves a forward micro-step and a delayed backward
+    micro-step per tick. Stage INPUTS are stashed in a ring buffer of 2*PP
+    slots; the backward recomputes the stage forward inside `jax.vjp`, so live
+    activation memory is O(PP) — independent of the microbatch count M.
+    (GPipe/fill-drain autodiff through the scan keeps O(M) activations; this
+    is the 1F1B memory bound the reference schedule exists for.)
+
+    Schedule (stage s, microbatch i, PP stages):
+      forward  of (i, s) at tick t = i + s
+      backward of (i, s) at tick t = i + 2*PP - 1 - s
+    Loss + head vjp run fused in the last stage's backward; cotangents hop
+    stage s -> s-1 via reverse ppermute. Total ticks: M + 2*PP - 1; per tick
+    each rank does one stage forward + one stage backward — the steady-state
+    1F1B pattern. Embed/head/loss run under `lax.cond` so only the owning
+    stage pays their flops (branches are collective-free).
+
+    Returns grad_fn(params, batch, rng) -> (mean_loss, grads), grads in the
+    pipeline layout (blocks pipe-sharded, embed/head replicated with tied
+    contributions psummed over pipe — the reference's tied-weight allreduce),
+    averaged over the data domain.
+    """
+    PP = num_stages
+    M = num_microbatches
+    R = 2 * PP  # ring slots; a stash entry lives 2*(PP-s)-1 < R ticks
+    if remat_blocks:
+        block_fn = jax.checkpoint(block_fn)
+
+    def local(params, batch, rng):
+        p_idx = jax.lax.axis_index(PIPE_AXIS)
+        blocks = params["blocks"]
+        he = {"embed": params["embed"], "head": params["head"]}
+
+        def stage_apply_with(blk, x):
+            def layer_body(h, lp):
+                return block_fn(lp, h, rng), None
+            out, _ = jax.lax.scan(layer_body, x, blk)
+            return out
+
+        def mb_view(i):
+            return _mb_view(batch, i, M)
+
+        mb0 = mb_view(0)
+        act_shape = jax.eval_shape(embed_fn, params["embed"], mb0, rng)
+        zeros_act = jnp.zeros(act_shape.shape, act_shape.dtype)
+
+        def zeros32(tree):
+            return jax.tree_util.tree_map(
+                lambda l: jnp.zeros(l.shape, jnp.float32), tree)
+
+        carry0 = (
+            zeros_act,                                   # fwd handoff buffer
+            zeros_act,                                   # bwd cotangent buffer
+            jnp.zeros((R,) + act_shape.shape, act_shape.dtype),  # input stash
+            zeros32(blocks),                             # grad accum (blocks)
+            zeros32(he),                                 # grad accum (embed/head)
+            jnp.asarray(0.0, jnp.float32),               # loss sum
+        )
+
+        n_ticks = M + 2 * PP - 1
+        perm_fwd = [(j, j + 1) for j in range(PP - 1)]
+        perm_bwd = [(j, j - 1) for j in range(1, PP)]
+
+        def tick(carry, t):
+            fwd_buf, bwd_buf, xstash, gblocks, ghe, loss_sum = carry
+
+            # ---- forward micro-step ------------------------------------
+            f_idx = t - p_idx
+            f_active = (f_idx >= 0) & (f_idx < M)
+            mb_f = jnp.clip(f_idx, 0, M - 1)
+            x_in = jax.lax.cond(
+                p_idx == 0,
+                lambda: embed_fn(params["embed"], mb_view(mb_f), rng),
+                lambda: fwd_buf)
+            y = stage_apply_with(blocks, x_in)
+            y = jnp.where(f_active, y, zeros_act)
+            f_slot = jnp.mod(f_idx, R)
+            cur = jax.lax.dynamic_index_in_dim(xstash, f_slot, keepdims=False)
+            xstash = jax.lax.dynamic_update_index_in_dim(
+                xstash, jnp.where(f_active, x_in, cur), f_slot, 0)
+
+            # ---- backward micro-step -----------------------------------
+            b_idx = t - (2 * PP - 1 - p_idx)
+            b_active = (b_idx >= 0) & (b_idx < M)
+            mb_b = jnp.clip(b_idx, 0, M - 1)
+            mbb = mb_view(mb_b)
+            x_b = jax.lax.dynamic_index_in_dim(
+                xstash, jnp.mod(b_idx, R), keepdims=False)
+
+            def last_bwd():
+                # loss + head vjp fused into the last stage's backward
+                def f(blk, he_, x):
+                    full = {"embed": he_["embed"], "blocks": blk,
+                            "head": he_["head"]}
+                    yy = stage_apply_with(blk, x)
+                    return head_loss_fn(full, yy, mbb, rng).astype(jnp.float32)
+                loss_i, vjp = jax.vjp(f, blocks, he, x_b)
+                dblk, dhe, dx = vjp(jnp.asarray(1.0, jnp.float32))
+                return loss_i, dblk, dhe, dx
+
+            def mid_bwd():
+                # cotangent for an invalid microbatch is always zero (zeros
+                # propagate down from the last stage), so grads stay clean
+                def f(blk, x):
+                    return stage_apply_with(blk, x)
+                _, vjp = jax.vjp(f, blocks, x_b)
+                dblk, dx = vjp(bwd_buf)
+                return (jnp.asarray(0.0, jnp.float32), dblk,
+                        jax.tree_util.tree_map(jnp.zeros_like, he), dx)
+
+            loss_i, dblk, dhe, dx = jax.lax.cond(
+                b_active & (p_idx == PP - 1), last_bwd, mid_bwd)
+
+            def emb_bwd():
+                _, vjp = jax.vjp(lambda ep: embed_fn(ep, mbb, rng),
+                                 params["embed"])
+                (dep,) = vjp(dx)
+                return dep
+
+            dembed = jax.lax.cond(
+                b_active & (p_idx == 0), emb_bwd,
+                lambda: jax.tree_util.tree_map(jnp.zeros_like,
+                                               params["embed"]))
+
+            def add32(a, g):
+                return a + g.astype(jnp.float32)
+
+            gblocks = jax.tree_util.tree_map(add32, gblocks, dblk)
+            ghe = jax.tree_util.tree_map(add32, ghe, dhe)
+            ghe = {"embed": jax.tree_util.tree_map(add32, ghe["embed"], dembed),
+                   "head": ghe["head"]}
+            loss_sum = loss_sum + loss_i
+
+            fwd_buf = jax.lax.ppermute(y, PIPE_AXIS, perm_fwd)
+            bwd_buf = jax.lax.ppermute(dx, PIPE_AXIS, perm_bwd)
+            return (fwd_buf, bwd_buf, xstash, gblocks, ghe, loss_sum), None
+
+        (carry_out, _) = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
+        _, _, _, gblocks, ghe, loss_sum = carry_out
+
+        data_axes = (DATA_AXIS, ZERO_INNER_AXIS, SEQ_AXIS)
+        inv_m = 1.0 / M
+
+        def finish_rep(g, p):  # replicated leaves: tied psum over pipe
+            g = jax.lax.psum(g * inv_m, PIPE_AXIS)
+            return jax.lax.pmean(g, data_axes).astype(p.dtype)
+
+        def finish_shard(g, p):  # pipe-sharded leaves stay per-stage
+            return jax.lax.pmean(g * inv_m, data_axes).astype(p.dtype)
+
+        grads = {
+            "embed": jax.tree_util.tree_map(finish_rep, ghe["embed"],
+                                            params["embed"]),
+            "blocks": jax.tree_util.tree_map(finish_shard, gblocks, blocks),
+            "head": jax.tree_util.tree_map(finish_rep, ghe["head"],
+                                           params["head"]),
+        }
+        loss = jax.lax.psum(loss_sum, PIPE_AXIS) * inv_m
+        loss = jax.lax.pmean(loss, data_axes)
+        return loss, grads
+
+    def grad_fn(params, batch, rng):
+        mesh = mesh_mod.get_mesh()
+        batch_spec = jax.tree_util.tree_map(lambda _: P(BATCH_AXES), batch)
+        with mesh_mod.constraints_disabled():
+            fn = shard_map(local, mesh=mesh,
+                           in_specs=(_pipe_inner_specs(params), batch_spec, P()),
+                           out_specs=(P(), _pipe_inner_specs(params)),
+                           check_vma=False)
+            return fn(params, batch, rng)
+
+    return grad_fn
+
+
 def pipeline_forward_fn(embed_fn, block_fn, head_fn, num_stages, num_microbatches):
     """Pipelined forward-only schedule (reference `InferenceSchedule`,
     `runtime/pipe/schedule.py:135`): microbatches stream through the stages,
@@ -222,10 +423,11 @@ def pipeline_forward_fn(embed_fn, block_fn, head_fn, num_stages, num_microbatche
             return _mb_view(batch, i, M)
 
         mb0 = mb_view(0)
-        act0 = embed_fn(params["embed"], mb0, rng)
-        zeros_act = jnp.zeros_like(act0)
-        out0 = head_fn(params, act0, mb0, rng)
-        out_buf0 = jnp.zeros((M * out0.shape[0],) + out0.shape[1:], out0.dtype)
+        act_shape = jax.eval_shape(embed_fn, params["embed"], mb0, rng)
+        zeros_act = jnp.zeros(act_shape.shape, act_shape.dtype)
+        out_shape = jax.eval_shape(head_fn, params, zeros_act, mb0, rng)
+        out_buf0 = jnp.zeros((M * out_shape.shape[0],) + out_shape.shape[1:],
+                             out_shape.dtype)
 
         n_ticks = M + PP - 1
         perm_fwd = [(j, j + 1) for j in range(PP - 1)]
@@ -235,14 +437,18 @@ def pipeline_forward_fn(embed_fn, block_fn, head_fn, num_stages, num_microbatche
             mb_idx = t - p_idx
             active = (mb_idx >= 0) & (mb_idx < M)
             mb_i = jnp.clip(t, 0, M - 1)
-            embedded = embed_fn(params["embed"], mb_view(mb_i), rng)
-            x_in = jnp.where(p_idx == 0, embedded, buf)
+            x_in = jax.lax.cond(
+                p_idx == 0,
+                lambda: embed_fn(params["embed"], mb_view(mb_i), rng),
+                lambda: buf)
             y = stage_apply(x_in, rng)
             y = jnp.where(active, y, zeros_act)
             out_idx = jnp.clip(t - (PP - 1), 0, M - 1)
             take = active & (p_idx == PP - 1)
-            out = head_fn(params, y, mb_view(out_idx), rng)
-            out = jnp.where(take, out, jnp.zeros_like(out))
+            out = jax.lax.cond(
+                take,
+                lambda: head_fn(params, y, mb_view(out_idx), rng),
+                lambda: jnp.zeros(out_shape.shape, out_shape.dtype))
             start = out_idx * out.shape[0]
             cur = jax.lax.dynamic_slice_in_dim(out_buf, start, out.shape[0], axis=0)
             out_buf = jax.lax.dynamic_update_slice_in_dim(out_buf, cur + out,
@@ -288,8 +494,13 @@ def pipeline_param_specs(params):
 
 
 def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
-                            num_microbatches=4, seed=0):
-    """Pipeline-parallel GPT ModelSpec: blocks stacked [PP*Lp, ...] on `pipe`."""
+                            num_microbatches=4, seed=0, schedule="1f1b"):
+    """Pipeline-parallel GPT ModelSpec: blocks stacked [PP*Lp, ...] on `pipe`.
+
+    schedule: "1f1b" (default — reference TrainSchedule memory bound) trains
+    via `pipeline_grad_fn`; "gpipe" trains by autodiff through the fill-drain
+    loss (O(M) activation memory, kept for comparison/debugging).
+    """
     from deepspeed_tpu.models.gpt import (GPTConfig, GPT2_CONFIGS, init_gpt_params,
                                           _block, _norm)
     from deepspeed_tpu.runtime.engine import ModelSpec
@@ -323,7 +534,11 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
         return jnp.einsum("btd,vd->btv", x, head_w.astype(x.dtype))
 
     def embed_fn(ep, micro_batch, rng):
-        return _embed_tokens(ep, micro_batch["tokens"][:, :-1])
+        # gpt_loss contract: explicit "labels" → tokens are already the
+        # (possibly curriculum-transformed) inputs; otherwise shift in-place.
+        tokens = micro_batch.get("tokens", micro_batch.get("input_ids"))
+        inputs = tokens if micro_batch.get("labels") is not None else tokens[:, :-1]
+        return _embed_tokens(ep, inputs)
 
     def block_fn(lp, x, rng):
         B, T, D = x.shape
@@ -331,7 +546,10 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
         return _block(x, lp, cfg=cfg, positions=positions)
 
     def head_loss_fn(full_params, x, micro_batch, rng):
-        labels = micro_batch["tokens"][:, 1:]
+        labels = micro_batch.get("labels")
+        if labels is None:
+            tokens = micro_batch.get("tokens", micro_batch.get("input_ids"))
+            labels = tokens[:, 1:]
         logits = _head_logits(full_params, x).astype(jnp.float32)
         logz = jax.nn.logsumexp(logits, axis=-1)
         safe = jnp.maximum(labels, 0)
@@ -343,6 +561,13 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
                                num_stages=num_stages,
                                num_microbatches=num_microbatches,
                                remat_blocks=cfg.remat)
+    # training backward: 1F1B schedule (O(PP) live activations); the
+    # fill-drain loss_fn above stays as the cheaper eval/forward-only path
+    grad_fn = (pipeline_grad_fn(embed_fn, block_fn, head_loss_fn,
+                                num_stages=num_stages,
+                                num_microbatches=num_microbatches,
+                                remat_blocks=cfg.remat)
+               if schedule == "1f1b" else None)
 
     # pipelined inference forward (reference InferenceSchedule): full-sequence
     # logits, microbatches streamed through the stages
@@ -363,4 +588,5 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
         return pipelined_fwd(params, batch, rng)
 
     return ModelSpec(loss_fn=loss_fn, params=params, apply_fn=apply_fn,
+                     grad_fn=grad_fn,
                      param_specs=pipeline_param_specs(params), name=name)

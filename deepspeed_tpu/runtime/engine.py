@@ -55,6 +55,9 @@ class ModelSpec:
     params: Any
     param_specs: Any = None
     apply_fn: Optional[Callable] = None   # raw forward (for inference/eval use)
+    grad_fn: Optional[Callable] = None    # custom (loss, grads) — e.g. the 1F1B
+                                          # pipeline schedule computes grads with
+                                          # its own backward pass, not jax.grad
     has_aux: bool = False
     name: str = "model"
 
@@ -396,6 +399,20 @@ class Engine:
     def _micro_grad_fn(self):
         loss_fn = self._loss_fn
         scaler = self.scaler
+        custom_grad = getattr(self.model_spec, "grad_fn", None)
+
+        if custom_grad is not None:
+            # model computes its own backward (1F1B pipeline schedule); apply
+            # the loss scale to the grads directly (linear in the loss)
+            def compute(params, micro_batch, rng, scale_state):
+                loss, grads = custom_grad(params, micro_batch, rng)
+                scale = scaler.scale_loss(jnp.asarray(1.0, jnp.float32),
+                                          scale_state)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g * scale.astype(g.dtype), grads)
+                return grads, loss
+
+            return compute
 
         def compute(params, micro_batch, rng, scale_state):
             def scaled(p):
@@ -554,10 +571,16 @@ class Engine:
     def _build_train_step(self):
         gas = self.gradient_accumulation_steps_value
         zcfg = self.config.zero_optimization
-        if zcfg.zero_quantized_gradients or (zcfg.zero_quantized_weights
-                                             and self.zero_stage == 3):
+        wants_quantized = zcfg.zero_quantized_gradients or (
+            zcfg.zero_quantized_weights and self.zero_stage == 3)
+        if wants_quantized and getattr(self.model_spec, "grad_fn", None) is None:
             micro_grad = self._quantized_micro_grad_fn()
         else:
+            if wants_quantized:
+                logger.warning(
+                    "zero_quantized_gradients/weights ignored: model supplies "
+                    "a custom grad_fn (pipeline 1F1B) which computes its own "
+                    "backward pass")
             micro_grad = self._micro_grad_fn()
         apply_grads = self._apply_grads_fn()
         grad_shardings = self._grad_shardings()

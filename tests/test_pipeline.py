@@ -34,6 +34,21 @@ def test_partition_layers():
     assert parts[-1][1] == 4 and len(parts) == 2
 
 
+def test_partition_layers_type_regex():
+    """type: regex partitioning (reference pipe/module.py:385): balance the
+    count of name-matching layers; non-matching layers ride along."""
+    names = ["Embed", "Block", "Block", "Block", "Block", "Norm", "Head"]
+    parts = partition_layers(7, 2, method="type:Block", names=names)
+    counts = [sum(1 for i in range(a, b) if names[i] == "Block")
+              for a, b in parts]
+    assert counts == [2, 2], (parts, counts)
+    assert parts[0][0] == 0 and parts[-1][1] == 7
+    with pytest.raises(ValueError, match="names"):
+        partition_layers(7, 2, method="type:Block")
+    with pytest.raises(ValueError, match="matches"):
+        partition_layers(7, 2, method="type:Nope", names=names)
+
+
 def test_pipeline_loss_matches_plain_gpt():
     """pp=2 pipelined loss must equal the plain (single-program) GPT loss."""
     mesh = _mk_mesh(pipe=2, data=2)
@@ -81,6 +96,91 @@ def test_pipeline_grads_match_plain():
     # tied embedding: single leaf accumulates embed + head contributions
     np.testing.assert_allclose(np.asarray(g_pipe["embed"]["wte"]),
                                np.asarray(g_plain["wte"]), rtol=2e-3, atol=1e-5)
+
+
+def test_1f1b_grads_match_fill_drain():
+    """The 1F1B manual-vjp schedule reproduces autodiff gradients exactly."""
+    mesh = _mk_mesh(pipe=2, data=4)
+    model = make_gpt_pipeline_model(cfg=TINY, num_stages=2, num_microbatches=4)
+    batch = {"tokens": jnp.asarray(_tokens(16, 33, TINY.vocab_size))}
+    rng = jax.random.PRNGKey(0)
+
+    loss_ref, g_ref = jax.jit(jax.value_and_grad(model.loss_fn))(
+        model.params, batch, rng)
+    loss_1f1b, g_1f1b = jax.jit(model.grad_fn)(model.params, batch, rng)
+    np.testing.assert_allclose(float(loss_ref), float(loss_1f1b), rtol=1e-5)
+    flat_r = jax.tree_util.tree_leaves(g_ref)
+    flat_m = jax.tree_util.tree_leaves(g_1f1b)
+    for r, m in zip(flat_r, flat_m):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(m),
+                                   rtol=2e-4, atol=1e-6)
+
+
+def test_1f1b_memory_flat_in_microbatches():
+    """1F1B live-activation memory is O(PP), not O(M): compiled temp bytes
+    must stay ~flat as M grows 4x, while GPipe autodiff grows with M
+    (reference TrainSchedule memory bound, pipe/schedule.py:189)."""
+    mesh_mod.clear_mesh()
+    spec = mesh_mod.MeshSpec(pipe=2, data=1)
+    mesh_mod.set_mesh(mesh_mod.build_mesh(spec, devices=jax.devices()[:2]), spec)
+    cfg = GPTConfig(n_layer=4, n_head=4, d_model=128, d_ff=512, max_seq_len=128,
+                    vocab_size=512, dtype=jnp.float32, remat=True)
+
+    def temp_bytes(schedule, M):
+        m = make_gpt_pipeline_model(cfg=cfg, num_stages=2, num_microbatches=M,
+                                    schedule=schedule)
+        batch = {"tokens": jnp.zeros((2 * M, 65), jnp.int32)}
+        if schedule == "1f1b":
+            fn = lambda p: m.grad_fn(p, batch, None)[1]
+        else:
+            fn = jax.grad(lambda p: m.loss_fn(p, batch, None))
+        ma = jax.jit(fn).lower(m.params).compile().memory_analysis()
+        return ma.temp_size_in_bytes if ma else None
+
+    b4, b16 = temp_bytes("1f1b", 4), temp_bytes("1f1b", 16)
+    if b4 is None:
+        pytest.skip("memory_analysis unavailable on this backend")
+    assert b16 / b4 < 1.3, f"1F1B temp grew with M: {b4} -> {b16}"
+    g4, g16 = temp_bytes("gpipe", 4), temp_bytes("gpipe", 16)
+    assert g16 / g4 > 1.5, f"expected GPipe temp to grow with M: {g4} -> {g16}"
+    assert b16 < g16, "1F1B should use less temp memory than GPipe at M=16"
+
+
+def test_1f1b_trains_under_engine():
+    """Engine consumes ModelSpec.grad_fn (1F1B) and loss decreases."""
+    mesh = _mk_mesh(pipe=2, data=2)
+    model = make_gpt_pipeline_model(cfg=TINY, num_stages=2, num_microbatches=2)
+    assert model.grad_fn is not None
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"pipe": 2, "data": 2},
+        "steps_per_print": 1000,
+    }, mesh=mesh)
+    batch = {"tokens": _tokens(8, 33, TINY.vocab_size)}
+    losses = [float(engine.train_batch(batch)) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_honors_labels_key():
+    """head_loss_fn honors batch['labels'] (curriculum contract): masking all
+    labels to ignore-index must change the loss; explicit labels == derived."""
+    _mk_mesh(pipe=2)
+    model = make_gpt_pipeline_model(cfg=TINY, num_stages=2, num_microbatches=2)
+    toks = _tokens(4, 33, TINY.vocab_size)
+    rng = jax.random.PRNGKey(0)
+    implicit = float(model.loss_fn(model.params, {"tokens": jnp.asarray(toks)}, rng))
+    explicit = float(model.loss_fn(model.params, {
+        "tokens": jnp.asarray(toks[:, :-1]),
+        "labels": jnp.asarray(toks[:, 1:])}, rng))
+    np.testing.assert_allclose(implicit, explicit, rtol=1e-5)
+    # half-masked labels (the seqlen-curriculum transform) must differ
+    labels = toks[:, 1:].copy()
+    labels[:, 16:] = -1
+    masked = float(model.loss_fn(model.params, {
+        "tokens": jnp.asarray(toks[:, :-1]), "labels": jnp.asarray(labels)}, rng))
+    assert abs(masked - implicit) > 1e-6
 
 
 class TestPipelineInference:
