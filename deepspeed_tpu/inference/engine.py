@@ -115,38 +115,93 @@ class InferenceEngine:
                 logits = jnp.where(logits < kth, -jnp.inf, logits)
             return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
-        def generate(params, tokens, cache, prompt_len, max_new, rng):
+        def generate(params, tokens, cache, prompt_len, max_new, rng, eos_id, pad_id):
             B, T = tokens.shape
             logits, cache = prefill_fn(params, tokens, cache, None)
-            # last prompt logits
+            # last prompt logits, per sample (ragged batches: rows are
+            # right-padded, causal masking keeps pads out of these logits)
             last = jnp.take_along_axis(
                 logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0, :]
             first_tok = sample(last, rng)
+            done0 = jnp.zeros((B,), bool)
 
             def body(carry, i):
-                tok, pos, cache, rng = carry
+                tok, pos, cache, rng, done = carry
                 rng, sub = jax.random.split(rng)
                 lg, cache = decode_fn(params, tok, pos, cache)
                 nxt = sample(lg, sub)
-                return (nxt, pos + 1, cache, rng), tok
+                # eos semantics (reference generate(): stop per sequence once
+                # eos is emitted): the eos token itself is kept in the output,
+                # everything after it is pad_id. eos_id < 0 disables.
+                new_done = done | ((tok == eos_id) & (eos_id >= 0))
+                nxt = jnp.where(new_done, pad_id, nxt)
+                emit = jnp.where(done, pad_id, tok)
+                return (nxt, pos + 1, cache, rng, new_done), emit
 
-            (_, _, cache, _), toks = jax.lax.scan(
-                body, (first_tok, prompt_len, cache, rng), jnp.arange(max_new))
+            (_, _, cache, _, _), toks = jax.lax.scan(
+                body, (first_tok, prompt_len, cache, rng, done0),
+                jnp.arange(max_new))
             return jnp.moveaxis(toks, 0, 1)  # [B, max_new]
 
         return jax.jit(generate, static_argnums=(4,))
 
-    def generate(self, tokens, max_new_tokens=32, rng=None):
-        """Greedy/sampled generation with a static-shape decode loop (lax.scan)."""
+    @staticmethod
+    def _pad_ragged(tokens):
+        """Right-pad a list of variable-length sequences to [B, T_max].
+
+        Returns (tokens[B,T], prompt_lens[B]). Right padding (not left) is the
+        natural layout for a per-sample-position KV cache: each row's decode
+        starts at its own prompt_len and overwrites the pad slots, and causal
+        masking keeps trailing pads out of the prompt logits. The reference
+        relies on the HF tokenizer's left-pad + attention_mask for the same
+        ragged-batch contract (`inference/engine.py:577-606`).
+
+        The fill value is always 0, NOT pad_token_id: pad slots are provably
+        never attended, but an out-of-vocab fill (e.g. a sentinel pad id)
+        turns the embedding gather out-of-bounds, which is NaN on the TPU
+        backend. pad_token_id only masks the *output*.
+        """
+        lens = np.asarray([len(t) for t in tokens], np.int32)
+        T = int(lens.max())
+        out = np.zeros((len(tokens), T), np.int32)
+        for i, t in enumerate(tokens):
+            out[i, :lens[i]] = np.asarray(t, np.int32)
+        return out, lens
+
+    def generate(self, tokens, max_new_tokens=32, rng=None, prompt_lens=None,
+                 eos_token_id=None, pad_token_id=0, stop_on_eos=True):
+        """Greedy/sampled generation with a static-shape decode loop (lax.scan).
+
+        `tokens` may be a rectangular [B, T] batch or a list of ragged
+        sequences (padded internally). `prompt_lens` gives per-sample prompt
+        lengths for rectangular-but-right-padded input. Sequences stop at
+        `eos_token_id` (default: the model spec's) — the eos is kept, later
+        slots are `pad_token_id`.
+        """
         if self._generate_jit is None:
             self._generate_jit = self._build_generate()
+        if isinstance(tokens, (list, tuple)) and tokens and np.ndim(tokens[0]) == 1 \
+                and len({len(t) for t in tokens}) > 1:
+            tokens, prompt_lens = self._pad_ragged(tokens)
         tokens = jnp.asarray(tokens)
         B, T = tokens.shape
         max_len = T + max_new_tokens
         cache = self.model_spec.init_cache(B, max_len, jnp.dtype(self.config.kv_cache_dtype))
-        prompt_len = jnp.full((B,), T, jnp.int32)
+        if prompt_lens is None:
+            prompt_len = jnp.full((B,), T, jnp.int32)
+        else:
+            prompt_len = jnp.asarray(prompt_lens, jnp.int32)
+        eos = eos_token_id
+        if eos is None:
+            eos = getattr(self.config, "eos_token_id", None)
+        if eos is None:
+            eos = self.model_spec.eos_token_id
+        if not stop_on_eos or eos is None:
+            eos = -1
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        out = self._generate_jit(self.params, tokens, cache, prompt_len, max_new_tokens, rng)
+        out = self._generate_jit(self.params, tokens, cache, prompt_len,
+                                 max_new_tokens, rng,
+                                 jnp.int32(eos), jnp.int32(pad_token_id))
         return np.asarray(jax.device_get(out))
 
 

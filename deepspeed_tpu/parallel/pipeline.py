@@ -121,10 +121,15 @@ def _pipe_inner_specs(params):
 
 def _mb_view(batch, i, M):
     """Microbatch i of a microbatch-major local batch."""
-    return jax.tree_util.tree_map(
-        lambda a: jax.lax.dynamic_slice_in_dim(a, i * (a.shape[0] // M),
-                                               a.shape[0] // M, axis=0),
-        batch)
+    def slice_leaf(a):
+        if a.shape[0] % M != 0:
+            raise ValueError(
+                f"pipeline batch leading dim {a.shape[0]} is not divisible by "
+                f"num_microbatches={M}; trailing samples would be silently "
+                f"dropped from the loss")
+        return jax.lax.dynamic_slice_in_dim(a, i * (a.shape[0] // M),
+                                            a.shape[0] // M, axis=0)
+    return jax.tree_util.tree_map(slice_leaf, batch)
 
 
 def _make_stage_apply(block_fn, blocks):
@@ -563,6 +568,10 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
                                remat_blocks=cfg.remat)
     # training backward: 1F1B schedule (O(PP) live activations); the
     # fill-drain loss_fn above stays as the cheaper eval/forward-only path
+    schedule = schedule.lower()
+    if schedule not in ("1f1b", "gpipe"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}; "
+                         "expected '1f1b' or 'gpipe'")
     grad_fn = (pipeline_grad_fn(embed_fn, block_fn, head_loss_fn,
                                 num_stages=num_stages,
                                 num_microbatches=num_microbatches,

@@ -171,3 +171,46 @@ def test_moe_inference_expert_parallel():
     assert "expert" in str(wup.sharding.spec), wup.sharding
     out = engine.generate(toks, max_new_tokens=4)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_generate_ragged_prompts_match_per_sample():
+    """Ragged batches: each row decodes from its own prompt length and matches
+    the tokens that row would produce generated alone (reference generate()
+    handles ragged HF batches via tokenizer padding + attention_mask,
+    `inference/engine.py:577-606`)."""
+    _mk_mesh(data=1)
+    spec = make_gpt_decode_model(cfg=TINY, name="tiny")
+    engine = init_inference(model=spec, config={"dtype": "float32",
+                                                "kv_cache_dtype": "float32",
+                                                "greedy": True})
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, TINY.vocab_size, (L,)).astype(np.int32)
+               for L in (5, 8, 3)]
+    out = engine.generate(list(prompts), max_new_tokens=4)
+    assert out.shape == (3, 4)
+    for i, p in enumerate(prompts):
+        ref = engine.generate(p[None, :], max_new_tokens=4)
+        np.testing.assert_array_equal(out[i], ref[0])
+
+
+def test_generate_eos_stop_mask():
+    """Per-sample eos early stop: the eos token is kept, every later slot is
+    pad_token_id, and other rows keep generating."""
+    _mk_mesh(data=1)
+    spec = make_gpt_decode_model(cfg=TINY, name="tiny")
+    engine = init_inference(model=spec, config={"dtype": "float32",
+                                                "kv_cache_dtype": "float32",
+                                                "greedy": True})
+    toks = np.random.default_rng(2).integers(0, TINY.vocab_size, (2, 6)).astype(np.int32)
+    free = engine.generate(toks, max_new_tokens=6)
+    eos = int(free[0, 2])  # whatever row 0 emits at step 2
+    pad = TINY.vocab_size  # out-of-vocab sentinel so masking is unambiguous
+    out = engine.generate(toks, max_new_tokens=6, eos_token_id=eos, pad_token_id=pad)
+    for r in range(2):
+        hits = np.flatnonzero(free[r] == eos)
+        if hits.size:
+            cut = hits[0]
+            np.testing.assert_array_equal(out[r, :cut + 1], free[r, :cut + 1])
+            assert (out[r, cut + 1:] == pad).all()
+        else:
+            np.testing.assert_array_equal(out[r], free[r])
