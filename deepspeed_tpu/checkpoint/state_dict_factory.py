@@ -118,17 +118,43 @@ class SDLoaderBase:
                 for r in range(target_degree)]
 
 
+# Megatron-LM state-dict naming (both the old `attention.` and the newer
+# `self_attention.` module paths). Torch Linear stores [out, in]:
+# column-parallel layers (qkv, dense_h_to_4h) shard axis 0, row-parallel
+# layers (attention.dense, dense_4h_to_h) shard axis 1 — the axes in the
+# reference's merge/split tables (`state_dict_factory.py:301-402`).
+MEGATRON_RULES = [
+    ShardRule("*query_key_value.weight", 0, qkv="megatron"),
+    ShardRule("*query_key_value.bias", 0, qkv="megatron"),
+    ShardRule("*attention.dense.weight", 1),
+    ShardRule("*mlp.dense_h_to_4h.weight", 0),
+    ShardRule("*mlp.dense_h_to_4h.bias", 0),
+    ShardRule("*mlp.dense_4h_to_h.weight", 1),
+    ShardRule("*word_embeddings.weight", 0),
+    ShardRule("*lm_head.weight", 0),
+]
+
+
 class MegatronSDLoader(SDLoaderBase):
-    """Rules for Megatron-style interleaved qkv ([q1 k1 v1 q2 k2 v2] per
-    head-group, reference `state_dict_factory.py:190`)."""
+    """Checkpoint-version-aware qkv merge/split (reference
+    `MegatronSDLoader.merge_query_key_value` / `split_query_key_value`,
+    `state_dict_factory.py:220-299`). Three observed formats:
 
-    def __init__(self, num_heads: int, rules=None):
-        super().__init__(rules)
+      version 0:   [(3*np*hn), h] — Q/K/V stacked blocks per shard; merging
+                   must concat per projection, then restack ("packed").
+      version 1.0: [(np*hn*3), h] — interleaved inside each head group;
+      version 2.0: [(np*3*hn), h] — interleaved per head group.
+                   For 1.0/2.0 whole head-groups travel with their rank, so
+                   plain concat/split along axis 0 preserves ordering.
+    """
+
+    def __init__(self, num_heads: int = 0, rules=None, version: float = 0):
+        super().__init__(rules if rules is not None else MEGATRON_RULES)
         self.num_heads = num_heads
+        self.version = version
 
-    def _merge_qkv_interleaved(self, parts, axis):
-        # each shard: heads_local groups of (q,k,v) — plain concat preserves order
-        return np.concatenate(parts, axis=axis)
+    def _qkv_packed(self):
+        return self.version == 0
 
     def merge_state_dicts(self, shards):
         if len(shards) == 1:
@@ -140,11 +166,32 @@ class MegatronSDLoader(SDLoaderBase):
             if rule is None or rule.axis is None:
                 out[name] = parts[0]
             elif rule.qkv == "megatron":
-                out[name] = self._merge_qkv_interleaved(parts, rule.axis)
+                out[name] = (_merge_qkv_packed(parts, rule.axis)
+                             if self._qkv_packed()
+                             else np.concatenate(parts, axis=rule.axis))
             elif rule.qkv == "packed":
                 out[name] = _merge_qkv_packed(parts, rule.axis)
             else:
                 out[name] = np.concatenate(parts, axis=rule.axis)
+        return out
+
+    def split_state_dict(self, full, num_shards, rank):
+        if num_shards == 1:
+            return dict(full)
+        out = {}
+        for name, tensor in full.items():
+            rule = match_rule(name, self.rules)
+            if rule is None or rule.axis is None:
+                out[name] = tensor
+            elif rule.qkv == "megatron":
+                out[name] = (_split_qkv_packed(tensor, num_shards, rank, rule.axis)
+                             if self._qkv_packed()
+                             else np.array_split(tensor, num_shards,
+                                                 axis=rule.axis)[rank])
+            elif rule.qkv == "packed":
+                out[name] = _split_qkv_packed(tensor, num_shards, rank, rule.axis)
+            else:
+                out[name] = np.array_split(tensor, num_shards, axis=rule.axis)[rank]
         return out
 
 
@@ -156,5 +203,6 @@ class SDLoaderFactory:
         sd_type = sd_type.lower()
         if sd_type in ("megatron",):
             return MegatronSDLoader(num_heads=kwargs.get("num_heads", 0),
-                                    rules=kwargs.get("rules"))
+                                    rules=kwargs.get("rules"),
+                                    version=kwargs.get("version", 0))
         return SDLoaderBase(rules=kwargs.get("rules"))

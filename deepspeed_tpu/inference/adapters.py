@@ -614,6 +614,119 @@ def from_hf_distilbert(model_or_sd, hf_config=None, dtype=jnp.float32):
 
 
 # ----------------------------------------------------------------------
+# Megatron-LM GPT
+# ----------------------------------------------------------------------
+
+
+def _megatron_qkv_to_packed(w, n_heads, head_dim, version):
+    """Megatron fused query_key_value rows → contiguous (q, k, v).
+
+    Three row orderings exist across Megatron checkpoint versions (reference
+    `MegatronSDLoader.merge_query_key_value`, `state_dict_factory.py:220`):
+      0:   [3*H*hd, ...]  — already [Q; K; V] blocks
+      1.0: [H*hd*3, ...]  — per head, per hd-row, (q,k,v) triplets
+      2.0: [H*3*hd, ...]  — per head, (q,k,v) groups of hd rows
+    Returns (q, k, v) each [H*hd, in_dim] (or [H*hd] for biases).
+    """
+    in_dim = w.shape[-1] if w.ndim == 2 else 1
+    flat = (lambda t: t.reshape(n_heads * head_dim, in_dim)) if in_dim > 1 \
+        else (lambda t: t.reshape(n_heads * head_dim))
+    if version == 0:
+        q, k, v = np.split(w, 3, axis=0)
+        return q, k, v
+    if version == 1.0:
+        w = w.reshape(n_heads, head_dim, 3, -1)
+        return flat(w[:, :, 0]), flat(w[:, :, 1]), flat(w[:, :, 2])
+    if version == 2.0:
+        w = w.reshape(n_heads, 3, head_dim, -1)
+        return flat(w[:, 0]), flat(w[:, 1]), flat(w[:, 2])
+    raise ValueError(f"unsupported Megatron checkpoint version {version!r}")
+
+
+def from_megatron_gpt(model_or_sd, hf_config=None, dtype=jnp.float32, *,
+                      num_heads=None, version=None):
+    """Megatron-LM GPT state dict → (GPTConfig, params).
+
+    Reference: `module_inject/containers/megatron_gpt.py` (MegatronLayerPolicy)
+    + `runtime/state_dict_factory.py:190` (MegatronSDLoader). Handles both the
+    old `attention.` and new `self_attention.` module paths and the three qkv
+    row orderings (see `_megatron_qkv_to_packed`). The state dict may be
+    wrapped in a 'model'/'module' envelope with a 'checkpoint_version' key
+    (reference `get_checkpoint_version`, `state_dict_factory.py:425`).
+
+    `num_heads` is required for version 1.0/2.0 de-interleave (Megatron does
+    not store it in the weights); pass it directly or via an hf_config-like
+    object with `num_attention_heads`.
+    """
+    raw = model_or_sd
+    if version is None and isinstance(raw, dict):
+        version = raw.get("checkpoint_version", 0)
+    if isinstance(raw, dict):
+        for env in ("module", "model"):
+            if env in raw and isinstance(raw[env], dict):
+                raw = raw[env]
+        if "language_model" in raw:
+            raw = raw["language_model"]
+    sd = _state_dict({k: v for k, v in raw.items()
+                      if hasattr(v, "shape") or hasattr(v, "detach")})
+    version = float(version or 0)
+    if num_heads is None and hf_config is not None:
+        num_heads = getattr(hf_config, "num_attention_heads", None)
+
+    wte = sd["word_embeddings.weight"]
+    wpe = sd["position_embeddings.weight"]
+    D = wte.shape[1]
+    attn = "self_attention" if any("self_attention." in k for k in sd) else "attention"
+    n_layer = 1 + max(int(k.split(".")[2]) for k in sd
+                      if k.startswith("transformer.layers."))
+    assert num_heads, "from_megatron_gpt needs num_heads (not stored in weights)"
+    H = int(num_heads)
+    hd = D // H
+
+    cfg = GPTConfig(
+        vocab_size=wte.shape[0], n_layer=n_layer, n_head=H, d_model=D,
+        d_ff=sd[f"transformer.layers.0.mlp.dense_h_to_4h.weight"].shape[0],
+        max_seq_len=wpe.shape[0],
+        use_rotary=False, use_swiglu=False, use_rmsnorm=False,
+        tie_embeddings="lm_head.weight" not in sd,
+        dtype=dtype, remat=False)
+
+    layers = []
+    for i in range(n_layer):
+        b = f"transformer.layers.{i}."
+        qw, kw, vw = _megatron_qkv_to_packed(
+            sd[b + f"{attn}.query_key_value.weight"], H, hd, version)
+        qb, kb, vb = _megatron_qkv_to_packed(
+            sd[b + f"{attn}.query_key_value.bias"], H, hd, version)
+        layers.append({
+            "ln1_scale": sd[b + "input_layernorm.weight"],
+            "ln1_bias": sd[b + "input_layernorm.bias"],
+            "attn_qkv_w": np.concatenate([qw, kw, vw], axis=0).T,
+            "attn_qkv_b": np.concatenate([qb, kb, vb]),
+            "attn_out_w": sd[b + f"{attn}.dense.weight"].T,
+            "attn_out_b": sd[b + f"{attn}.dense.bias"],
+            "ln2_scale": sd[b + "post_attention_layernorm.weight"],
+            "ln2_bias": sd[b + "post_attention_layernorm.bias"],
+            "mlp_up_w": sd[b + "mlp.dense_h_to_4h.weight"].T,
+            "mlp_up_b": sd[b + "mlp.dense_h_to_4h.bias"],
+            "mlp_down_w": sd[b + "mlp.dense_4h_to_h.weight"].T,
+            "mlp_out_b": sd[b + "mlp.dense_4h_to_h.bias"],
+        })
+    params = {
+        "wte": jnp.asarray(wte, dtype),
+        "wpe": jnp.asarray(wpe, dtype),
+        "blocks": {k2: v2.astype(dtype) for k2, v2 in _stack(layers).items()},
+        "lnf_scale": jnp.asarray(sd["transformer.final_layernorm.weight"], dtype),
+        "lnf_bias": jnp.asarray(sd["transformer.final_layernorm.bias"], dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(sd["lm_head.weight"], dtype)
+    logger.info(f"adapted Megatron GPT: {n_layer}L d={D} H={H} "
+                f"ckpt_version={version}")
+    return cfg, params
+
+
+# ----------------------------------------------------------------------
 # dispatch
 # ----------------------------------------------------------------------
 
@@ -628,6 +741,7 @@ _ADAPTERS = {
     "gptj": from_hf_gptj,
     "bert": from_hf_bert,
     "distilbert": from_hf_distilbert,
+    "megatron": from_megatron_gpt,
 }
 
 

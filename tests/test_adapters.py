@@ -124,3 +124,108 @@ def test_adapter_dispatch_covers_container_families():
     for mt in ("gpt2", "llama", "mistral", "internlm", "opt", "bloom",
                "gpt_neox", "gptj", "bert", "distilbert"):
         assert mt in _ADAPTERS, mt
+
+
+# ----------------------------------------------------------------------
+# Megatron-LM GPT (reference `containers/megatron_gpt.py` +
+# `runtime/state_dict_factory.py:190` MegatronSDLoader)
+# ----------------------------------------------------------------------
+
+
+def _toy_megatron_sd(version, seed=0, L=2, D=32, H=4, V=64, T=16):
+    """Random Megatron GPT state dict with version-ordered fused qkv.
+
+    Returns (sd, logical) where `logical` holds the contiguous (q, k, v)
+    blocks so tests can assert ordering-independence across versions."""
+    rng = np.random.default_rng(seed)
+    hd = D // H
+    r = lambda *s: rng.normal(0, 0.02, s).astype(np.float32)
+    sd = {"word_embeddings.weight": r(V, D), "position_embeddings.weight": r(T, D),
+          "transformer.final_layernorm.weight": 1 + r(D),
+          "transformer.final_layernorm.bias": r(D)}
+    logical = []
+    for i in range(L):
+        b = f"transformer.layers.{i}."
+        q, k, v = r(D, D), r(D, D), r(D, D)
+        qb, kb, vb = r(D), r(D), r(D)
+
+        def order(t3):  # [3, H*hd, ...] contiguous blocks -> version layout
+            t3 = np.stack(t3)                       # [3, D, ...]
+            per_head = t3.reshape(3, H, hd, *t3.shape[2:])
+            if version == 0:
+                return t3.reshape(3 * D, *t3.shape[2:])
+            if version == 1.0:
+                return np.moveaxis(per_head, 0, 2).reshape(3 * D, *t3.shape[2:])
+            if version == 2.0:
+                return np.moveaxis(per_head, 0, 1).reshape(3 * D, *t3.shape[2:])
+            raise AssertionError(version)
+
+        sd[b + "attention.query_key_value.weight"] = order([q, k, v])
+        sd[b + "attention.query_key_value.bias"] = order([qb, kb, vb])
+        sd[b + "attention.dense.weight"] = r(D, D)
+        sd[b + "attention.dense.bias"] = r(D)
+        sd[b + "input_layernorm.weight"] = 1 + r(D)
+        sd[b + "input_layernorm.bias"] = r(D)
+        sd[b + "post_attention_layernorm.weight"] = 1 + r(D)
+        sd[b + "post_attention_layernorm.bias"] = r(D)
+        sd[b + "mlp.dense_h_to_4h.weight"] = r(4 * D, D)
+        sd[b + "mlp.dense_h_to_4h.bias"] = r(4 * D)
+        sd[b + "mlp.dense_4h_to_h.weight"] = r(D, 4 * D)
+        sd[b + "mlp.dense_4h_to_h.bias"] = r(D)
+        logical.append((q, k, v))
+    return sd, logical
+
+
+def test_megatron_adapter_version_orderings_agree():
+    """The three qkv checkpoint orderings must adapt to identical params."""
+    from deepspeed_tpu.inference.adapters import from_megatron_gpt
+    ref = None
+    for ver in (0, 1.0, 2.0):
+        sd, _ = _toy_megatron_sd(ver)
+        cfg, params = from_megatron_gpt(sd, num_heads=4, version=ver)
+        assert cfg.n_layer == 2 and cfg.d_model == 32 and cfg.tie_embeddings
+        if ref is None:
+            ref = params
+        else:
+            for k_, a, b in zip(["qkv_w", "qkv_b"],
+                                [params["blocks"]["attn_qkv_w"], params["blocks"]["attn_qkv_b"]],
+                                [ref["blocks"]["attn_qkv_w"], ref["blocks"]["attn_qkv_b"]]):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b), err_msg=f"{ver} {k_}")
+
+
+def test_megatron_adapter_checkpoint_envelope():
+    """'model' wrapper + checkpoint_version key are honored (reference
+    `get_checkpoint_version`, `state_dict_factory.py:425`)."""
+    from deepspeed_tpu.inference.adapters import from_megatron_gpt
+    sd, _ = _toy_megatron_sd(2.0)
+    wrapped = {"model": sd, "checkpoint_version": 2.0}
+    cfg, params = from_megatron_gpt(wrapped, num_heads=4)
+    sd0, _ = _toy_megatron_sd(0)
+    _, params0 = from_megatron_gpt(sd0, num_heads=4, version=0)
+    np.testing.assert_allclose(np.asarray(params["blocks"]["attn_qkv_w"]),
+                               np.asarray(params0["blocks"]["attn_qkv_w"]))
+
+
+@pytest.mark.parametrize("ver", [0, 2.0])
+def test_megatron_reshard_roundtrip_logits_parity(ver):
+    """TP split -> merge round-trips exactly, and the merged dict adapts to
+    the same logits as the original (reference `MegatronSDLoader`
+    merge/split_query_key_value)."""
+    from deepspeed_tpu.checkpoint.state_dict_factory import SDLoaderFactory
+    from deepspeed_tpu.inference.adapters import from_megatron_gpt
+    sd, _ = _toy_megatron_sd(ver)
+    loader = SDLoaderFactory.get_sd_loader("megatron", num_heads=4, version=ver)
+    shards = [loader.split_state_dict(sd, 2, r) for r in range(2)]
+    # column-parallel qkv really is sharded
+    k0 = "transformer.layers.0.attention.query_key_value.weight"
+    assert shards[0][k0].shape[0] == sd[k0].shape[0] // 2
+    merged = loader.merge_state_dicts(shards)
+    for k_ in sd:
+        np.testing.assert_array_equal(merged[k_], sd[k_], err_msg=k_)
+
+    cfg, params = from_megatron_gpt(sd, num_heads=4, version=ver)
+    _, params2 = from_megatron_gpt(merged, num_heads=4, version=ver)
+    toks = np.random.default_rng(3).integers(0, 64, (2, 8)).astype(np.int32)
+    l1 = np.asarray(gpt_forward(params, jnp.asarray(toks), cfg))
+    l2 = np.asarray(gpt_forward(params2, jnp.asarray(toks), cfg))
+    np.testing.assert_allclose(l1, l2)
