@@ -158,6 +158,62 @@ def init_gpt_params(cfg: GPTConfig, seed: int = 0, dtype=jnp.float32):
     return params
 
 
+def gpt_init_fn(cfg: GPTConfig, dtype=jnp.float32):
+    """jax-traceable initializer (rng -> params) mirroring `init_gpt_params`.
+
+    For the engine's zero.Init path (ModelSpec.init_fn): the returned function
+    runs under jit with stage-3 out_shardings, so each leaf is created directly
+    in its shard and a model larger than host RAM / one-chip HBM never
+    materializes whole (reference `zero/partition_parameters.py:723`)."""
+    D, F, L = cfg.d_model, cfg.d_ff, cfg.n_layer
+    proj_scale = 0.02 / math.sqrt(2 * L)
+    QKV = cfg.qkv_dim
+
+    def init(rng):
+        keys = iter(jax.random.split(rng, 16))
+        norm = lambda *shape, scale=0.02: (
+            jax.random.normal(next(keys), shape, dtype) * scale)
+        zeros = lambda *shape: jnp.zeros(shape, dtype)
+        ones = lambda *shape: jnp.ones(shape, dtype)
+        block = {
+            "ln1_scale": ones(L, D),
+            "ln2_scale": ones(L, D),
+            "attn_qkv_w": norm(L, D, QKV),
+            "attn_qkv_b": zeros(L, QKV),
+            "attn_out_w": norm(L, D, D, scale=proj_scale),
+            "attn_out_b": zeros(L, D),
+            "mlp_out_b": zeros(L, D),
+        }
+        if not cfg.use_rmsnorm:
+            block["ln1_bias"] = zeros(L, D)
+            block["ln2_bias"] = zeros(L, D)
+        if cfg.use_swiglu:
+            block["mlp_gate_w"] = norm(L, D, F)
+            block["mlp_up_w"] = norm(L, D, F)
+            block["mlp_down_w"] = norm(L, F, D, scale=proj_scale)
+        else:
+            block["mlp_up_w"] = norm(L, D, F)
+            block["mlp_up_b"] = zeros(L, F)
+            block["mlp_down_w"] = norm(L, F, D, scale=proj_scale)
+        params = {
+            "wte": norm(cfg.vocab_size, D, scale=0.02),
+            "blocks": block,
+            "lnf_scale": ones(D),
+        }
+        if not cfg.use_rmsnorm:
+            params["lnf_bias"] = zeros(D)
+        if not cfg.use_rotary and not cfg.use_alibi:
+            params["wpe"] = norm(cfg.max_seq_len, D, scale=0.01)
+        if cfg.use_emb_ln:
+            params["emb_ln_scale"] = ones(D)
+            params["emb_ln_bias"] = zeros(D)
+        if not cfg.tie_embeddings:
+            params["lm_head"] = norm(cfg.vocab_size, D, scale=0.02)
+        return params
+
+    return init
+
+
 def gpt_param_specs(cfg: GPTConfig):
     """Megatron-style TP PartitionSpecs (reference: AutoTP's shard plan,
     `module_inject/auto_tp.py` — column-parallel qkv/up, row-parallel out/down).
@@ -425,13 +481,18 @@ def gpt_loss(params, batch, rng, cfg: GPTConfig, attn_fn=None):
     return nll.sum() / jnp.maximum(mask.sum(), 1.0)
 
 
-def make_gpt_model(cfg: GPTConfig = None, name="gpt2-125m", seed=0, attn_fn=None) -> ModelSpec:
-    """ModelSpec for the training engine."""
+def make_gpt_model(cfg: GPTConfig = None, name="gpt2-125m", seed=0, attn_fn=None,
+                   abstract=False) -> ModelSpec:
+    """ModelSpec for the training engine.
+
+    `abstract=True` returns a spec with init_fn instead of concrete params —
+    the engine then materializes each leaf directly into its ZeRO/TP shard
+    (zero.Init, `zero/partition_parameters.py:723`)."""
     cfg = cfg or GPT2_CONFIGS[name]
-    params = init_gpt_params(cfg, seed=seed)
     return ModelSpec(
         loss_fn=partial(gpt_loss, cfg=cfg, attn_fn=attn_fn),
-        params=params,
+        params=None if abstract else init_gpt_params(cfg, seed=seed),
+        init_fn=gpt_init_fn(cfg) if abstract else None,
         param_specs=gpt_param_specs(cfg),
         apply_fn=partial(gpt_forward, cfg=cfg),
         name=name,

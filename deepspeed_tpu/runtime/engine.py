@@ -52,12 +52,17 @@ class ModelSpec:
     (the TP planner in parallel/tp.py produces them).
     """
     loss_fn: Callable
-    params: Any
+    params: Any = None
     param_specs: Any = None
     apply_fn: Optional[Callable] = None   # raw forward (for inference/eval use)
     grad_fn: Optional[Callable] = None    # custom (loss, grads) — e.g. the 1F1B
                                           # pipeline schedule computes grads with
                                           # its own backward pass, not jax.grad
+    init_fn: Optional[Callable] = None    # (rng) -> params, used when `params` is
+                                          # None: the engine materializes each
+                                          # leaf DIRECTLY into its ZeRO/TP shard
+                                          # (zero.Init's construction-time
+                                          # partitioning, partition_parameters.py:723)
     has_aux: bool = False
     name: str = "model"
 
@@ -69,6 +74,17 @@ class TrainState(NamedTuple):
     scaler: LossScaleState
     step: jnp.ndarray            # i32 global step counter
     rng: jnp.ndarray             # PRNG key
+
+
+def _normalize_init_fn(init_fn):
+    """init_fn() or init_fn(rng) → uniform fn(rng)."""
+    try:
+        takes_rng = len(inspect.signature(init_fn).parameters) >= 1
+    except (TypeError, ValueError):
+        takes_rng = True
+    if takes_rng:
+        return init_fn
+    return lambda rng: init_fn()
 
 
 def _wrap_loss_fn(loss_fn, has_aux):
@@ -183,7 +199,13 @@ class Engine:
             # params bf16 + fp32 master + adam m/v transit HBM in the update —
             # PER DEVICE: ZeRO partitions the state over the data domain
             shards = max(mesh_mod.axis_size(mesh_mod.ZERO_AXES), 1)
-            est = 14 * tree_num_params(model.params) // shards
+            if model.params is not None:
+                n_model = tree_num_params(model.params)
+            else:  # abstract shapes only — zero.Init path
+                n_model = tree_num_params(jax.eval_shape(
+                    _normalize_init_fn(model.init_fn),
+                    jax.random.PRNGKey(config.seed)))
+            est = 14 * n_model // shards
             opt_name = (config.optimizer.type.lower() if config.optimizer else "adam")
             host_kind_known = any(k in opt_name for k in ("adam", "lion", "adagrad"))
             if est > 0.6 * hbm:
@@ -287,13 +309,33 @@ class Engine:
 
     def _init_state(self, params, param_specs):
         policy = self.zero_policy
-        self.param_shardings = policy.param_shardings(params, param_specs)
-
-        # place params (compute dtype)
-        params_c = tree_cast(params, self.compute_dtype)
-        params_c = jax.device_put(params_c, self.param_shardings)
+        if params is None:
+            # zero.Init contract (`zero/partition_parameters.py:723`): the full
+            # model never materializes on one host/device. Shardings come from
+            # abstract shapes (jax.eval_shape = the meta device); XLA then runs
+            # init_fn with out_shardings so every leaf is CREATED in its shard.
+            if self.model_spec.init_fn is None:
+                raise ValueError("ModelSpec needs either params or init_fn")
+            from deepspeed_tpu.utils.init_on_device import materialize_sharded
+            init_fn = _normalize_init_fn(self.model_spec.init_fn)
+            init_rng = jax.random.PRNGKey(self.config.seed)
+            abstract = jax.eval_shape(init_fn, init_rng)
+            self.param_shardings = policy.param_shardings(abstract, param_specs)
+            params_c = materialize_sharded(
+                lambda r: tree_cast(init_fn(r), self.compute_dtype),
+                self.param_shardings, init_rng)
+        else:
+            self.param_shardings = policy.param_shardings(params, param_specs)
+            # place params (compute dtype)
+            params_c = tree_cast(params, self.compute_dtype)
+            params_c = jax.device_put(params_c, self.param_shardings)
 
         if self.nvme_offload:
+            if params is None:
+                # the host (C++) optimizer owns an fp32 master in host RAM by
+                # design — pull the sharded compute params back once
+                params = jax.tree_util.tree_map(
+                    lambda x: np.asarray(x, np.float32), jax.device_get(params_c))
             return self._init_state_host_offload(params, params_c)
 
         # fp32 master (ZeRO-partitioned — reference stage_1_and_2.py:630).
@@ -1010,8 +1052,14 @@ def initialize(args=None,
     if not isinstance(model, ModelSpec):
         assert callable(model), "model must be a ModelSpec or a loss callable"
         assert model_parameters is not None, \
-            "when model is a callable, pass model_parameters (the params pytree)"
-        model = ModelSpec(loss_fn=model, params=model_parameters)
+            "when model is a callable, pass model_parameters (a params pytree, " \
+            "or an init_fn for construction-time partitioning)"
+        if callable(model_parameters):
+            # zero.Init ergonomics: params materialize directly into their
+            # shards, never whole on the host
+            model = ModelSpec(loss_fn=model, init_fn=model_parameters)
+        else:
+            model = ModelSpec(loss_fn=model, params=model_parameters)
 
     engine = Engine(model=model,
                     config=cfg,
