@@ -12,12 +12,19 @@ import jax.numpy as jnp
 
 def fake_quantize(w, bits=8, symmetric=True, per_channel=True, axis=-1):
     """QAT fake-quant with straight-through estimator (reference
-    `Quantizer`/`fake_quantizer.cu` semantics)."""
+    `Quantizer`/`fake_quantizer.cu` semantics).
+
+    `bits` may be a scalar or a length-`w.shape[0]` sequence (per-layer bit
+    widths for stacked-block leaves — the MoQ schedule's mixed precision)."""
     if per_channel and w.ndim >= 2:
         reduce_axes = tuple(i for i in range(w.ndim) if i != (axis % w.ndim))
     else:
         reduce_axes = tuple(range(w.ndim))
-    qmax = 2.0**(bits - 1) - 1
+    if not jnp.isscalar(bits) and getattr(jnp.asarray(bits), "ndim", 0) > 0:
+        barr = jnp.asarray(bits, jnp.float32)
+        qmax = (2.0**(barr - 1) - 1).reshape((w.shape[0],) + (1,) * (w.ndim - 1))
+    else:
+        qmax = 2.0**(bits - 1) - 1
     amax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
     scale = jnp.maximum(amax, 1e-8) / qmax
     q = jnp.round(w / scale)

@@ -46,10 +46,14 @@ def _match(path, patterns):
     return any(p == "*" or re.search(p.replace("*", ".*"), path) for p in patterns)
 
 
-def _transform_leaf(kind, params, leaf):
+def _transform_leaf(kind, params, leaf, scheduler=None):
     if leaf.ndim < 2:
         return leaf
     if kind == "weight_quantization":
+        if scheduler is not None:
+            # MoQ: live per-layer bits, read at trace time — the engine
+            # retraces the step when the schedule advances (runtime/quantize.py)
+            return fake_quantize(leaf, bits=scheduler.bits_vector(leaf.shape[0]))
         bits = params.get("start_bits", params.get("target_bits", 8))
         return fake_quantize(leaf, bits=int(bits))
     if kind == "sparse_pruning":
@@ -61,19 +65,38 @@ def _transform_leaf(kind, params, leaf):
     return leaf
 
 
-def _build_param_transform(groups):
+def _build_param_transform(groups, scheduler=None):
     def transform(params):
         def leaf_fn(path, leaf):
             pstr = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
             out = leaf
             for kind, gparams, patterns in groups:
                 if _match(pstr, patterns):
-                    out = _transform_leaf(kind, gparams, out)
+                    sched = scheduler if kind == "weight_quantization" else None
+                    out = _transform_leaf(kind, gparams, out, scheduler=sched)
             return out
 
         return jax.tree_util.tree_map_with_path(leaf_fn, params)
 
     return transform
+
+
+def _build_moq_scheduler(groups, n_layers):
+    """A MoQScheduler when any weight_quantization group schedules a bit
+    reduction (start_bits > target_bits); None for static-bits QAT."""
+    for kind, gparams, _ in groups:
+        if kind != "weight_quantization":
+            continue
+        start = int(gparams.get("start_bits", gparams.get("target_bits", 8)))
+        target = int(gparams.get("target_bits", start))
+        if start > target:
+            from deepspeed_tpu.runtime.quantize import MoQScheduler
+            return MoQScheduler(
+                start_bits=start, target_bits=target,
+                period=int(gparams.get("quantization_period",
+                                       gparams.get("quantize_period", 100))),
+                layer_num=n_layers)
+    return None
 
 
 def apply_layer_reduction(params, lr_cfg):
@@ -119,8 +142,13 @@ def init_compression(model, deepspeed_config, teacher_model=None, mpu=None):
         params = apply_layer_reduction(src, lr_cfg)
 
     inner_loss = model.loss_fn
+    scheduler = None
     if groups:
-        transform = _build_param_transform(groups)
+        n_layers = 1
+        if isinstance(params, dict) and "blocks" in params:
+            n_layers = jax.tree_util.tree_leaves(params["blocks"])[0].shape[0]
+        scheduler = _build_moq_scheduler(groups, n_layers)
+        transform = _build_param_transform(groups, scheduler=scheduler)
 
         def compressed_loss(params, batch, rng=None):
             return inner_loss(transform(params), batch, rng)
@@ -128,10 +156,12 @@ def init_compression(model, deepspeed_config, teacher_model=None, mpu=None):
         compressed_loss = inner_loss
 
     logger.info(f"compression enabled: {[g[0] for g in groups]}"
-                + (" + layer_reduction" if lr_cfg.get("enabled") else ""))
+                + (" + layer_reduction" if lr_cfg.get("enabled") else "")
+                + (" + MoQ schedule" if scheduler is not None else ""))
     return ModelSpec(loss_fn=compressed_loss, params=params,
                      param_specs=model.param_specs, apply_fn=model.apply_fn,
-                     has_aux=model.has_aux, name=model.name + "+compress")
+                     has_aux=model.has_aux, name=model.name + "+compress",
+                     quantize_scheduler=scheduler)
 
 
 def redundancy_clean(model_or_params, deepspeed_config, mpu=None):

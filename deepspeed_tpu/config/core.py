@@ -239,6 +239,20 @@ class FlopsProfilerConfig(ConfigModel):
 
 
 @dataclass
+class EigenvalueConfig(ConfigModel):
+    """Reference: eigenvalue block (`runtime/config.py:545`) — curvature
+    estimation driving the MoQ quantization schedule."""
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "blocks"
+    layer_num: int = 0
+
+
+@dataclass
 class TensorBoardConfig(ConfigModel):
     enabled: bool = False
     output_path: str = ""
@@ -383,6 +397,7 @@ class TpuTrainConfig(ConfigModel):
     mesh: MeshConfig = field(default_factory=MeshConfig)
     activation_checkpointing: ActivationCheckpointingConfig = field(default_factory=ActivationCheckpointingConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
+    eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
     tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = field(default_factory=WandbConfig)
     csv_monitor: CsvConfig = field(default_factory=CsvConfig)

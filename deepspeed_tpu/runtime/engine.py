@@ -63,6 +63,9 @@ class ModelSpec:
                                           # leaf DIRECTLY into its ZeRO/TP shard
                                           # (zero.Init's construction-time
                                           # partitioning, partition_parameters.py:723)
+    quantize_scheduler: Any = None        # MoQScheduler from init_compression —
+                                          # the engine advances it per step and
+                                          # retraces when bit widths change
     has_aux: bool = False
     name: str = "model"
 
@@ -272,6 +275,11 @@ class Engine:
 
         # flops profiler (lazy)
         self._flops_profiler = None
+
+        # MoQ: progressive quantization schedule + curvature cache
+        # (reference engine.py:214-215 eigenvalue/block_eigenvalue)
+        self.quantize_scheduler = model.quantize_scheduler
+        self.block_eigenvalue = None
 
         # curriculum learning: legacy seqlen scheduling applied in train_batch
         # (reference `engine.forward` truncation, engine.py:1792-1795; v2 config
@@ -820,7 +828,44 @@ class Engine:
                 from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
                 self._flops_profiler = FlopsProfiler(ds_engine=self)
         self._after_step(metrics, count_micro=True)
+        self._maybe_step_moq(batch)
         return metrics["loss"]
+
+    def _maybe_step_moq(self, batch):
+        """Advance the MoQ bit-reduction schedule once per optimizer step; at
+        gas-boundary resolution, refresh per-layer curvature estimates that
+        stretch high-curvature layers' periods (reference engine.py:2116-2127
+        + quantize.py:51). When bits change, retrace the compiled programs
+        that bake the fake-quant constants in."""
+        sched = self.quantize_scheduler
+        if sched is None or not sched.any_precision_switch():
+            return
+        ecfg = self.config.eigenvalue
+        ev = self.block_eigenvalue
+        if ecfg.enabled and self.global_steps % max(ecfg.gas_boundary_resolution, 1) == 0:
+            from deepspeed_tpu.runtime.quantize import (block_eigenvalues,
+                                                        post_process_eigenvalues)
+            try:
+                mb = jax.tree_util.tree_map(
+                    lambda a: a[:self.micro_batch_size], batch)
+                rng = jax.random.PRNGKey(self.config.seed)
+                raw = block_eigenvalues(
+                    lambda p, b: self._loss_fn(p, b, rng)[0],
+                    self.state.params, mb,
+                    max_iter=ecfg.max_iter, tol=ecfg.tol,
+                    stability=ecfg.stability)
+                ev = self.block_eigenvalue = post_process_eigenvalues(raw)
+                if ecfg.verbose:
+                    log_dist(f"block eigenvalues: raw={raw} scaled={ev}", ranks=[0])
+            except (KeyError, TypeError) as e:
+                logger.warning(f"eigenvalue estimation unavailable for this "
+                               f"model layout ({e}); MoQ advances uncurved")
+        if sched.step(ev):
+            if self._train_step is not None:
+                self._train_step = self._build_train_step()
+            self._eval_step = self._build_eval_step()
+            self._grad_step = None
+            self._apply_step = None
 
     def eval_batch(self, batch, rng=None):
         placed = self._shard_batch(batch, for_scan=False)
