@@ -56,15 +56,40 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         return restored
 
 
+def _key_path_str(path):
+    """Key path → "params/blocks/attn_qkv_w"-style name (same convention as
+    checkpoint/universal.py's _flatten: dict keys and sequence indices as
+    path segments, NamedTuple fields by name)."""
+    parts = []
+    for e in path:
+        if hasattr(e, "name"):        # GetAttrKey (NamedTuple / dataclass)
+            parts.append(str(e.name))
+        elif hasattr(e, "key"):       # DictKey / FlattenedIndexKey
+            parts.append(str(e.key))
+        elif hasattr(e, "idx"):       # SequenceKey
+            parts.append(str(e.idx))
+        else:
+            parts.append(str(e))
+    return "/".join(parts)
+
+
 class NumpyCheckpointEngine(CheckpointEngine):
-    """Simple single-host .npz fallback (role of TorchCheckpointEngine)."""
+    """Simple single-host .npz fallback (role of TorchCheckpointEngine).
+
+    Leaves are stored positionally (`arr_i`) for exact template round-trips,
+    plus a `keys.json` recording each leaf's key path — that's what lets the
+    offline universal converter recover the params/master split from an npz
+    checkpoint with no engine or treedef at hand."""
 
     def save(self, state, path):
         import numpy as np
-        flat, treedef = jax.tree_util.tree_flatten(state)
-        arrays = {f"arr_{i}": np.asarray(jax.device_get(x)) for i, x in enumerate(flat)}
+        flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+        arrays = {f"arr_{i}": np.asarray(jax.device_get(x))
+                  for i, (_, x) in enumerate(flat)}
         pathlib.Path(path).mkdir(parents=True, exist_ok=True)
         np.savez(os.path.join(path, "state.npz"), **arrays)
+        with open(os.path.join(path, "keys.json"), "w") as f:
+            json.dump([_key_path_str(p) for p, _ in flat], f, indent=1)
 
     def load(self, path, template):
         import numpy as np

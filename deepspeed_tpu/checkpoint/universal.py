@@ -140,10 +140,10 @@ def convert_checkpoint_to_universal(ckpt_dir, out_dir, tag=None, out_tag="univer
     CLI role (`checkpoint/ds_to_universal.py:254`): reconstruct the fp32 param
     tree from a saved checkpoint and write the flat universal artifact.
 
-    Restores the checkpoint's structured TrainState directly (orbax format
-    only — the npz fallback engine stores positional leaves whose param/master
-    split is unrecoverable offline) so keys match `save_universal_checkpoint`
-    / `load_universal_checkpoint` exactly."""
+    Restores the checkpoint's structured TrainState directly — orbax format,
+    or the numpy engine's npz (whose `keys.json` records every leaf's key
+    path) — so keys match `save_universal_checkpoint` /
+    `load_universal_checkpoint` exactly."""
     import os
     from deepspeed_tpu.checkpoint.zero_to_fp32 import (_read_latest,
                                                        _restore_state_tree)
@@ -152,12 +152,12 @@ def convert_checkpoint_to_universal(ckpt_dir, out_dir, tag=None, out_tag="univer
         raise FileNotFoundError(f"no 'latest' file in {ckpt_dir}; pass --tag")
     state_path = os.path.join(ckpt_dir, str(tag), "state")
     restored, fmt = _restore_state_tree(state_path)
-    if fmt != "orbax":
+    if fmt not in ("orbax", "npz-named"):
         raise ValueError(
-            "offline universal conversion needs an orbax-format checkpoint "
-            "(checkpoint.engine='orbax'); the npz engine stores positional "
-            "leaves that cannot be mapped back to parameter names offline — "
-            "use convert_to_universal(ckpt_dir, out_dir, engine) instead")
+            "offline universal conversion needs an orbax-format checkpoint or "
+            "a named npz (keys.json, written by this version's numpy engine); "
+            "legacy positional npz cannot be mapped back to parameter names "
+            "offline — use convert_to_universal(ckpt_dir, out_dir, engine)")
     master = restored.get("master") if isinstance(restored, dict) \
         else getattr(restored, "master", None)
     params = restored.get("params") if isinstance(restored, dict) \

@@ -60,6 +60,22 @@ def _restore_state_tree(state_path):
     reference's offline zero_to_fp32 script)."""
     npz = os.path.join(state_path, "state.npz")
     if os.path.exists(npz):
+        keys_file = os.path.join(state_path, "keys.json")
+        if os.path.exists(keys_file):
+            # named npz (NumpyCheckpointEngine's keys.json): rebuild the
+            # nested TrainState-shaped dict so conversion sees params/master
+            import json as _json
+            with open(keys_file) as f:
+                names = _json.load(f)
+            nested = {}
+            with np.load(npz) as data:
+                for i, name in enumerate(names):
+                    parts = name.split("/")
+                    d = nested
+                    for p in parts[:-1]:
+                        d = d.setdefault(p, {})
+                    d[parts[-1]] = data[f"arr_{i}"]
+            return nested, "npz-named"
         with np.load(npz) as data:
             return {k: data[k] for k in data.files}, "npz"
     import jax
@@ -86,11 +102,11 @@ def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
     restored, fmt = _restore_state_tree(state_path)
 
     if fmt == "npz":
-        # npz engine stores a flat positional list; param/master split is not
-        # recoverable without the engine's treedef — return raw leaves.
+        # legacy npz (no keys.json): flat positional list; param/master split
+        # is not recoverable without the engine's treedef — return raw leaves.
         return {k: np.asarray(v, np.float32) for k, v in restored.items()}
 
-    # orbax: TrainState structure round-trips as a dict-like pytree
+    # orbax / named npz: TrainState structure round-trips as a dict-like pytree
     tree = restored
     master = tree.get("master") if isinstance(tree, dict) else getattr(tree, "master", None)
     params = tree.get("params") if isinstance(tree, dict) else getattr(tree, "params", None)

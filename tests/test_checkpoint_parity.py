@@ -218,7 +218,39 @@ class TestUniversalCli:
         np.testing.assert_allclose(w3, np.asarray(ref["layers"]["0"]["w"]),
                                    rtol=1e-5, atol=1e-6)
 
-    def test_offline_convert_rejects_npz_engine(self, tmp_path):
+    def test_offline_convert_from_npz_engine(self, tmp_path):
+        """npz-save -> universal -> reshard-load round-trip: the numpy
+        engine's keys.json gives the offline converter named leaves, so
+        conversion works from either engine's output."""
+        from deepspeed_tpu.comm import mesh as mesh_mod
+        mesh_mod._CURRENT_MESH = None
+        mesh_mod._CURRENT_SPEC = None
+        eng = _make_engine(tmp_path, stage=2, engine_kind="numpy")
+        rng = np.random.default_rng(0)
+        for _ in range(2):
+            eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path / "ckpt"))
+        ref = eng.get_fp32_state_dict()
+
+        from deepspeed_tpu.checkpoint.universal import (
+            main as universal_main, get_fp32_state_dict_from_universal,
+            load_universal_checkpoint)
+        rc = universal_main(["--input_folder", str(tmp_path / "ckpt"),
+                             "--output_folder", str(tmp_path / "uni")])
+        assert rc == 0
+        flat = get_fp32_state_dict_from_universal(str(tmp_path / "uni"))
+        np.testing.assert_allclose(flat["w"], np.asarray(ref["w"]), rtol=1e-6)
+
+        # reshard on load: a stage-3 engine consumes the artifact
+        mesh_mod._CURRENT_MESH = None
+        mesh_mod._CURRENT_SPEC = None
+        eng3 = _make_engine(tmp_path, stage=3, engine_kind="numpy")
+        load_universal_checkpoint(eng3, str(tmp_path / "uni"))
+        np.testing.assert_allclose(np.asarray(eng3.get_fp32_state_dict()["w"]),
+                                   np.asarray(ref["w"]), rtol=1e-5, atol=1e-6)
+
+    def test_offline_convert_rejects_legacy_positional_npz(self, tmp_path):
+        """A positional npz with no keys.json (pre-keys format) still errors."""
         from deepspeed_tpu.comm import mesh as mesh_mod
         mesh_mod._CURRENT_MESH = None
         mesh_mod._CURRENT_SPEC = None
@@ -226,7 +258,10 @@ class TestUniversalCli:
         rng = np.random.default_rng(0)
         eng.train_batch(_batch(rng))
         eng.save_checkpoint(str(tmp_path / "ckpt"))
+        import os
+        tag = (tmp_path / "ckpt" / "latest").read_text().strip()
+        os.remove(tmp_path / "ckpt" / tag / "state" / "keys.json")
         from deepspeed_tpu.checkpoint.universal import convert_checkpoint_to_universal
-        with pytest.raises(ValueError, match="orbax"):
+        with pytest.raises(ValueError, match="keys.json"):
             convert_checkpoint_to_universal(str(tmp_path / "ckpt"),
                                             str(tmp_path / "uni"))
