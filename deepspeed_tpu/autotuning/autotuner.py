@@ -25,6 +25,37 @@ from deepspeed_tpu.utils.logging import logger
 DEFAULT_STAGES = (0, 1, 2, 3)
 
 
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def admissible_mesh_shapes(n_devices, max_tensor=None, max_pipe=None,
+                           max_sequence=None):
+    """All (data, tensor, sequence, pipe) factorings of `n_devices`.
+
+    On TPU the mesh factoring IS the parallelism config — the knob the
+    reference's autotuner never sweeps (its space is ZeRO configs only,
+    `autotuning/autotuner.py:404`). Axis caps bound the space: tensor beyond
+    one ICI domain or pipe deeper than the layer count are never useful.
+    """
+    max_tensor = max_tensor or n_devices
+    max_pipe = max_pipe or n_devices
+    max_sequence = max_sequence or n_devices
+    shapes = []
+    for t in _divisors(n_devices):
+        if t > max_tensor:
+            continue
+        for s in _divisors(n_devices // t):
+            if s > max_sequence:
+                continue
+            for p in _divisors(n_devices // (t * s)):
+                if p > max_pipe:
+                    continue
+                d = n_devices // (t * s * p)
+                shapes.append({"data": d, "tensor": t, "sequence": s, "pipe": p})
+    return shapes
+
+
 class Autotuner:
     """Reference class name; `tune()` returns (best_config_dict, results)."""
 
@@ -168,6 +199,34 @@ class Autotuner:
         logger.info(f"autotune({tuner_type}) best: {best_exp} -> {best_val:.2f}")
         return tuned, {"exp": best_exp, "metric_val": best_val,
                        "trials": len(tuner.observed)}
+
+    def tune_mesh(self, n_devices=None, shapes=None, tuner_type="gridsearch",
+                  max_tensor=None, max_pipe=None, max_sequence=None,
+                  extra_overrides=None, **tuner_kw):
+        """Sweep mesh factorings (dp × tp × sp × pp) of the device count and
+        return (tuned_config_with_best_mesh, best_record).
+
+        `shapes` overrides the enumerated space with an explicit list of
+        {"data","tensor","sequence","pipe"} dicts. Other config overrides
+        (e.g. a fixed zero stage) ride along via `extra_overrides`.
+        """
+        if shapes is None:
+            if n_devices is None:
+                import jax
+                n_devices = len(jax.devices())
+            shapes = admissible_mesh_shapes(n_devices, max_tensor=max_tensor,
+                                            max_pipe=max_pipe,
+                                            max_sequence=max_sequence)
+        exps = []
+        for sh in shapes:
+            exp = {f"mesh.{k}": v for k, v in sh.items()}
+            exp.update(extra_overrides or {})
+            exps.append(exp)
+        tuned, best = self.tune_space(exps, tuner_type=tuner_type, **tuner_kw)
+        best["mesh"] = {k.split(".", 1)[1]: v for k, v in best["exp"].items()
+                       if k.startswith("mesh.")}
+        logger.info(f"autotune mesh recommendation: {best['mesh']}")
+        return tuned, best
 
     def tune(self):
         """Reference `Autotuner.tune` (`autotuner.py:404`)."""

@@ -232,6 +232,46 @@ class TestAutotuner:
         assert any(r["status"] == "ok" for r in tuner.results)
 
 
+    def test_admissible_mesh_shapes(self):
+        from deepspeed_tpu.autotuning.autotuner import admissible_mesh_shapes
+        shapes = admissible_mesh_shapes(8)
+        assert all(s["data"] * s["tensor"] * s["sequence"] * s["pipe"] == 8
+                   for s in shapes)
+        assert {"data": 8, "tensor": 1, "sequence": 1, "pipe": 1} in shapes
+        assert {"data": 2, "tensor": 2, "sequence": 2, "pipe": 1} in shapes
+        capped = admissible_mesh_shapes(8, max_tensor=2, max_pipe=1)
+        assert all(s["tensor"] <= 2 and s["pipe"] == 1 for s in capped)
+
+    def test_tune_mesh_returns_recommendation(self):
+        """Mesh sweep on the 8-device harness: tune_mesh must return a mesh
+        recommendation whose axes factor the device count (the TP/SP/PP knob
+        the reference autotuner never sweeps)."""
+        _reset()
+        from deepspeed_tpu.autotuning import Autotuner
+        from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_model
+        gcfg = GPTConfig(n_layer=2, n_head=4, d_model=32, max_seq_len=16,
+                         vocab_size=64, dtype=jnp.float32, remat=False)
+
+        def batch_factory(n):
+            toks = np.random.default_rng(0).integers(0, 64, (n, 16))
+            return {"tokens": toks.astype(np.int32)}
+
+        tuner = Autotuner(model_factory=lambda: make_gpt_model(cfg=gcfg),
+                          base_config={"optimizer": {"type": "Adam",
+                                                     "params": {"lr": 1e-3}},
+                                       "train_micro_batch_size_per_gpu": 2,
+                                       "steps_per_print": 10**9},
+                          batch_factory=batch_factory, steps=1, warmup=1)
+        shapes = [{"data": 8, "tensor": 1, "sequence": 1, "pipe": 1},
+                  {"data": 4, "tensor": 2, "sequence": 1, "pipe": 1},
+                  {"data": 4, "tensor": 1, "sequence": 2, "pipe": 1}]
+        tuned, best = tuner.tune_mesh(shapes=shapes)
+        m = best["mesh"]
+        assert m["data"] * m["tensor"] * m["sequence"] * m["pipe"] == 8
+        assert tuned["mesh"] == m
+        assert sum(r["status"] == "ok" for r in tuner.results) >= 1
+
+
 class TestHybridEngine:
     def test_train_and_generate(self):
         _reset()
