@@ -9,18 +9,43 @@ is exercised exactly as on a pod slice, minus the wire.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = xla_flags + " --xla_force_host_platform_device_count=8"
+# Real-TPU kernel lane: DSTPU_RUN_TPU_TESTS=1 keeps the hardware backend so
+# @pytest.mark.tpu tests compile (not interpret) the Pallas kernels on the
+# chip; everything else is skipped in that mode. Usage:
+#     DSTPU_RUN_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+RUN_TPU_LANE = os.environ.get("DSTPU_RUN_TPU_TESTS") == "1"
+
+if not RUN_TPU_LANE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = xla_flags + " --xla_force_host_platform_device_count=8"
 
 import jax  # noqa: E402
 
-# A sitecustomize may have pinned jax_platforms to a hardware backend before this
-# conftest ran; re-pin to CPU for the virtual 8-device harness.
-jax.config.update("jax_platforms", "cpu")
+if not RUN_TPU_LANE:
+    # A sitecustomize may have pinned jax_platforms to a hardware backend before
+    # this conftest ran; re-pin to CPU for the virtual 8-device harness.
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: compiles Pallas kernels on the real chip "
+                   "(needs DSTPU_RUN_TPU_TESTS=1, skipped on the CPU harness)")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        is_tpu = "tpu" in item.keywords
+        if is_tpu and not RUN_TPU_LANE:
+            item.add_marker(pytest.mark.skip(
+                reason="real-TPU kernel lane: run with DSTPU_RUN_TPU_TESTS=1 -m tpu"))
+        elif RUN_TPU_LANE and not is_tpu:
+            item.add_marker(pytest.mark.skip(
+                reason="CPU-mesh test skipped in the TPU kernel lane"))
 
 
 @pytest.fixture(autouse=True)
