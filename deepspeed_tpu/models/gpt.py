@@ -54,10 +54,23 @@ class GPTConfig:
     use_emb_ln: bool = False         # BLOOM LayerNorm after word embedding
     parallel_residual: bool = False  # NeoX/GPT-J: x + attn(ln1 x) + mlp(ln2 x)
     sliding_window: Optional[int] = None  # Mistral local attention window
+    attn_layer_types: Optional[tuple] = None  # GPT-Neo per-layer ("global",
+                                     # "local", ...): "local" layers apply the
+                                     # sliding_window mask, "global" full causal
+    scale_attn: bool = True          # GPT-Neo scores are NOT scaled by 1/sqrt(hd)
     tie_embeddings: bool = True
     remat: bool = True               # jax.checkpoint each block
-    remat_policy: str = "nothing_saveable"  # or "dots_with_no_batch_dims_saveable"
+    remat_policy: str = "nothing_saveable"  # jax.checkpoint_policies name, or
+                                     # "save_matmuls": save every big matmul
+                                     # output (named checkpoints) so backward
+                                     # recomputes only norms/softmax/elementwise
+                                     # — ~1/4 the refwd cost of full remat at
+                                     # ~150MB/layer (350M, mbs16, seq512)
     use_flash_attention: bool = False  # pallas kernel (ops/pallas/flash_attention.py)
+    softmax_dtype: Any = jnp.float32  # attention softmax accumulation dtype;
+                                     # bf16 halves the dominant HBM traffic of
+                                     # materialized attention (max-subtracted,
+                                     # exp still in fp32) — the bench uses it
     dtype: Any = jnp.bfloat16        # activation dtype
 
     def __post_init__(self):
@@ -275,6 +288,8 @@ def _norm(x, scale, bias, use_rms, eps=1e-5):
 def _act(x, cfg):
     if cfg.activation == "relu":
         return jax.nn.relu(x)
+    if cfg.activation == "quick_gelu":  # CLIP text encoder (x * sigmoid(1.702x))
+        return x * jax.nn.sigmoid(1.702 * x)
     return jax.nn.gelu(x)
 
 
@@ -325,6 +340,24 @@ def _rope(x, positions, rotary_dims, theta=10000.0):
         else rotated.astype(x.dtype)
 
 
+SAVE_MATMULS_NAMES = ("qkv_proj", "attn_out", "mlp_up", "mlp_down")
+
+
+def _ckpt_name(x, name):
+    """Tag a tensor for the "save_matmuls" selective-remat policy."""
+    from jax.ad_checkpoint import checkpoint_name
+    return checkpoint_name(x, name)
+
+
+def resolve_remat_policy(name):
+    """remat_policy string → jax.checkpoint policy. "save_matmuls" keeps every
+    tagged matmul output (the MXU-heavy tensors) so the backward recomputes
+    only norms/softmax/elementwise — the cheap fraction of a block."""
+    if name == "save_matmuls":
+        return jax.checkpoint_policies.save_only_these_names(*SAVE_MATMULS_NAMES)
+    return getattr(jax.checkpoint_policies, name, None)
+
+
 def _attention(q, k, v, causal_mask, cfg, attn_fn=None, bias=None):
     """q: [B, T, H, hd]; k,v: [B, S, Hkv, hd] → [B, T, H, hd]. fp32 softmax.
 
@@ -332,7 +365,8 @@ def _attention(q, k, v, causal_mask, cfg, attn_fn=None, bias=None):
     materializing repeated k/v (reference serves GQA models like llama2-70b via
     `module_inject/containers/llama2.py`). `bias`: additive [H, T, S] (alibi)."""
     if attn_fn is None and cfg.use_flash_attention and bias is None \
-            and not cfg.sliding_window and q.shape[1] % 128 == 0:
+            and not cfg.sliding_window and cfg.scale_attn \
+            and q.shape[1] % 128 == 0:
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
         attn_fn = partial(flash_attention, causal=True)
     if attn_fn is not None:
@@ -341,17 +375,28 @@ def _attention(q, k, v, causal_mask, cfg, attn_fn=None, bias=None):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
         return attn_fn(q, k, v)
-    scale = 1.0 / math.sqrt(q.shape[-1])
+    scale = 1.0 / math.sqrt(q.shape[-1]) if cfg.scale_attn else 1.0
     B, T, H, hd = q.shape
     Hkv = k.shape[2]
     G = H // Hkv  # grouped einsum; G == 1 is plain MHA
+    sm_dtype = jnp.dtype(getattr(cfg, "softmax_dtype", jnp.float32))
     qg = q.reshape(B, T, Hkv, G, hd)
-    logits = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale
+    logits = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(sm_dtype) * scale
     if bias is not None:
         S = k.shape[1]
-        logits = logits + bias.reshape(Hkv, G, T, S)[None]
-    logits = jnp.where(causal_mask[:, None], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        logits = logits + bias.reshape(Hkv, G, T, S)[None].astype(sm_dtype)
+    neg = jnp.asarray(-1e30 if sm_dtype == jnp.float32 else -3e38, sm_dtype)
+    logits = jnp.where(causal_mask[:, None], logits, neg)
+    if sm_dtype == jnp.float32:
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    else:
+        # reduced-precision softmax: the [T,S] score tensor stays bf16 (the
+        # HBM-traffic hot spot); max-subtraction keeps exp well-conditioned
+        # and the exp itself runs in fp32 before narrowing back
+        m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+        e = jnp.exp((logits - m).astype(jnp.float32)).astype(q.dtype)
+        denom = jnp.sum(e, axis=-1, keepdims=True, dtype=jnp.float32)
+        probs = (e.astype(jnp.float32) / denom).astype(q.dtype)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
     return out.reshape(B, T, H, hd)
 
@@ -363,12 +408,22 @@ def _mlp(h, p, cfg, constrain=True):
         up = jax.nn.silu(h @ p["mlp_gate_w"]) * (h @ p["mlp_up_w"])
     else:
         up = _act(h @ p["mlp_up_w"] + p["mlp_up_b"], cfg)
+    up = _ckpt_name(up, "mlp_up")
     if constrain:
         up = shard_constraint(up, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS)
-    return up @ p["mlp_down_w"] + p["mlp_out_b"]
+    return _ckpt_name(up @ p["mlp_down_w"] + p["mlp_out_b"], "mlp_down")
 
 
-def _attn_half(x, p, cfg: GPTConfig, positions, attn_fn=None, constrain=True):
+def _layer_local_flags(cfg: GPTConfig):
+    """attn_layer_types → bool[L] scan data (None when uniform attention)."""
+    if cfg.attn_layer_types is None:
+        return None
+    assert cfg.sliding_window, "attn_layer_types needs sliding_window set"
+    return jnp.asarray([t == "local" for t in cfg.attn_layer_types], bool)
+
+
+def _attn_half(x, p, cfg: GPTConfig, positions, attn_fn=None, constrain=True,
+               local_flag=None):
     """Attention half-block: ln1 → qkv → rope → masked attention → out-proj.
 
     Returns (attn_out, k, v) with k/v [B, T, Hkv, hd] so decode-model prefill
@@ -379,7 +434,7 @@ def _attn_half(x, p, cfg: GPTConfig, positions, attn_fn=None, constrain=True):
     H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
 
     h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm, cfg.norm_eps)
-    qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
+    qkv = _ckpt_name(h @ p["attn_qkv_w"] + p["attn_qkv_b"], "qkv_proj")
     q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
     q = q.reshape(B, T, H, hd)
     k = k.reshape(B, T, Hkv, hd)
@@ -396,12 +451,17 @@ def _attn_half(x, p, cfg: GPTConfig, positions, attn_fn=None, constrain=True):
     t_pos = jnp.arange(T, dtype=jnp.int32)
     causal = jnp.tril(jnp.ones((T, T), bool))
     if cfg.sliding_window:
-        causal = causal & _window_mask(t_pos, t_pos, cfg.sliding_window)
+        win = causal & _window_mask(t_pos, t_pos, cfg.sliding_window)
+        if local_flag is None:
+            causal = win
+        else:  # GPT-Neo alternating global/local: flag is per-layer scan data
+            causal = jnp.where(local_flag, win, causal)
     causal = causal[None, None, :, :]
     # alibi uses in-sequence distances (standard unpadded formulation)
     bias = _alibi_bias(cfg, t_pos, t_pos) if cfg.use_alibi else None
     attn = _attention(q, k, v, causal, cfg, attn_fn=attn_fn, bias=bias)
-    attn_out = attn.reshape(B, T, D) @ p["attn_out_w"] + p["attn_out_b"]
+    attn_out = _ckpt_name(
+        attn.reshape(B, T, D) @ p["attn_out_w"] + p["attn_out_b"], "attn_out")
     return attn_out, k, v
 
 
@@ -430,9 +490,11 @@ def _embed(params, tokens, positions, cfg: GPTConfig):
     return x
 
 
-def _block(x, p, cfg: GPTConfig, positions, dropout_rng=None, attn_fn=None):
+def _block(x, p, cfg: GPTConfig, positions, dropout_rng=None, attn_fn=None,
+           local_flag=None):
     """One transformer block. x: [B, T, D]."""
-    attn_out, _, _ = _attn_half(x, p, cfg, positions, attn_fn=attn_fn)
+    attn_out, _, _ = _attn_half(x, p, cfg, positions, attn_fn=attn_fn,
+                                local_flag=local_flag)
     x = _residual_mlp(x, attn_out, p, cfg)
     return shard_constraint(x, BATCH_AXES, SEQ_AXIS, None)
 
@@ -445,15 +507,25 @@ def gpt_forward(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None):
     x = _embed(params, tokens, positions, cfg)
     x = shard_constraint(x, BATCH_AXES, SEQ_AXIS, None)
 
-    block_fn = partial(_block, cfg=cfg, positions=positions, attn_fn=attn_fn)
+    flags = _layer_local_flags(cfg)
+    if flags is None:
+        block_fn = partial(_block, cfg=cfg, positions=positions, attn_fn=attn_fn)
+    else:
+        def block_fn(x, layer_params, flag):
+            return _block(x, layer_params, cfg=cfg, positions=positions,
+                          attn_fn=attn_fn, local_flag=flag)
     if cfg.remat:
-        policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
-        block_fn = jax.checkpoint(block_fn, policy=policy)
+        block_fn = jax.checkpoint(block_fn, policy=resolve_remat_policy(cfg.remat_policy))
 
-    def scan_body(x, layer_params):
-        return block_fn(x, layer_params), None
-
-    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    if flags is None:
+        def scan_body(x, layer_params):
+            return block_fn(x, layer_params), None
+        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    else:
+        def scan_body(x, inputs):
+            layer_params, flag = inputs
+            return block_fn(x, layer_params, flag), None
+        x, _ = jax.lax.scan(scan_body, x, (params["blocks"], flags))
 
     x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm, cfg.norm_eps)
     head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
@@ -472,10 +544,16 @@ def gpt_loss(params, batch, rng, cfg: GPTConfig, attn_fn=None):
     else:
         inputs = tokens
     logits = gpt_forward(params, inputs, cfg, attn_fn=attn_fn)
-    logits = logits.astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
+    # cross entropy WITHOUT materializing an fp32 [B,T,V] buffer (1.65G at
+    # mbs16/seq512/50k vocab): logits stay in compute dtype, the exp/sum runs
+    # with an fp32 accumulator fused into the reduction, and only [B,T]
+    # tensors ever exist in fp32.
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    sumexp = jnp.sum(jnp.exp((logits - m).astype(jnp.float32)), axis=-1)
+    logz = m[..., 0].astype(jnp.float32) + jnp.log(sumexp)
     safe_labels = jnp.maximum(labels, 0)  # ignore-index (<0) must not wrap
-    gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    gold = jnp.take_along_axis(logits, safe_labels[..., None],
+                               axis=-1)[..., 0].astype(jnp.float32)
     mask = (labels >= 0).astype(jnp.float32)
     nll = (logz - gold) * mask
     return nll.sum() / jnp.maximum(mask.sum(), 1.0)
@@ -513,7 +591,8 @@ def init_kv_cache(cfg: GPTConfig, batch_size, max_len, dtype=jnp.bfloat16):
             "length": jnp.zeros((batch_size,), jnp.int32)}
 
 
-def _decode_attn_half(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
+def _decode_attn_half(x, p, cache_k, cache_v, pos, cfg: GPTConfig,
+                      local_flag=None):
     """Single-token attention half: writes k/v at `pos` into the head-major
     cache and attends over it. x: [B, 1, D]; cache_[kv]: [B, Hkv, M, hd];
     pos: [B]. Returns (attn_out, cache_k, cache_v)."""
@@ -549,11 +628,12 @@ def _decode_attn_half(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
         from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
         attn = decode_attention(q[:, 0], cache_k, cache_v, pos).reshape(B, 1, D)
     else:
-        scale = 1.0 / math.sqrt(hd)
+        scale = 1.0 / math.sqrt(hd) if cfg.scale_attn else 1.0
         m_pos = jnp.arange(M)
         valid = (m_pos[None, :] <= pos[:, None])              # [B, M]
         if cfg.sliding_window:
-            valid = valid & (pos[:, None] - m_pos[None, :] < cfg.sliding_window)
+            win = valid & (pos[:, None] - m_pos[None, :] < cfg.sliding_window)
+            valid = win if local_flag is None else jnp.where(local_flag, win, valid)
         G = H // Hkv  # grouped einsum; G == 1 is plain MHA
         qg = q.reshape(B, Hkv, G, hd)
         logits = jnp.einsum("bkgd,bkmd->bkgm", qg, cache_k).astype(jnp.float32) * scale
@@ -569,9 +649,10 @@ def _decode_attn_half(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
     return attn_out, cache_k, cache_v
 
 
-def _block_decode(x, p, cache_k, cache_v, pos, cfg: GPTConfig):
+def _block_decode(x, p, cache_k, cache_v, pos, cfg: GPTConfig, local_flag=None):
     """Single-token decode for one block."""
-    attn_out, cache_k, cache_v = _decode_attn_half(x, p, cache_k, cache_v, pos, cfg)
+    attn_out, cache_k, cache_v = _decode_attn_half(x, p, cache_k, cache_v, pos,
+                                                   cfg, local_flag=local_flag)
     x = _residual_mlp(x, attn_out, p, cfg, constrain=False)
     return x, cache_k, cache_v
 
@@ -589,17 +670,22 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
         x = _embed(params, tokens, positions, cfg)
 
-        def body(x, inputs):
+        flags = _layer_local_flags(cfg)
+
+        def body(x, inputs, flag=None):
             p, ck, cv = inputs
-            attn_out, k, v = _attn_half(x, p, cfg, positions)
+            attn_out, k, v = _attn_half(x, p, cfg, positions, local_flag=flag)
             ck = ck.at[:, :, :T].set(jnp.moveaxis(k, 1, 2).astype(ck.dtype))
             cv = cv.at[:, :, :T].set(jnp.moveaxis(v, 1, 2).astype(cv.dtype))
             x = _residual_mlp(x, attn_out, p, cfg)
             return x, (ck, cv)
 
-        x, (ks, vs) = jax.lax.scan(
-            lambda c, inp: body(c, inp), x,
-            (params["blocks"], cache["k"], cache["v"]))
+        layers = (params["blocks"], cache["k"], cache["v"])
+        if flags is None:
+            x, (ks, vs) = jax.lax.scan(body, x, layers)
+        else:
+            x, (ks, vs) = jax.lax.scan(
+                lambda c, inp: body(c, inp[0], flag=inp[1]), x, (layers, flags))
         x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm, cfg.norm_eps)
         head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
         logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
@@ -612,12 +698,19 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
         B = token.shape[0]
         x = _embed(params, token[:, None], pos[:, None], cfg)
 
-        def body(x, inputs):
+        flags = _layer_local_flags(cfg)
+
+        def body(x, inputs, flag=None):
             p, ck, cv = inputs
-            x, ck, cv = _block_decode(x, p, ck, cv, pos, cfg)
+            x, ck, cv = _block_decode(x, p, ck, cv, pos, cfg, local_flag=flag)
             return x, (ck, cv)
 
-        x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+        layers = (params["blocks"], cache["k"], cache["v"])
+        if flags is None:
+            x, (ks, vs) = jax.lax.scan(body, x, layers)
+        else:
+            x, (ks, vs) = jax.lax.scan(
+                lambda c, inp: body(c, inp[0], flag=inp[1]), x, (layers, flags))
         x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm, cfg.norm_eps)
         head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
         logits = jnp.einsum("bod,vd->bov", x, head.astype(x.dtype))[:, 0]

@@ -39,7 +39,7 @@ from deepspeed_tpu.utils.logging import logger, log_dist
 from deepspeed_tpu.utils.timer import (SynchronizedWallClockTimer, ThroughputTimer,
                                        FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                                        STEP_GLOBAL_TIMER, TRAIN_BATCH_TIMER)
-from deepspeed_tpu.utils.tree import tree_cast, tree_num_params
+from deepspeed_tpu.utils.tree import tree_cast, tree_global_norm, tree_num_params
 
 
 @dataclasses.dataclass
@@ -475,8 +475,13 @@ class Engine:
         return compute
 
     def _apply_grads_fn(self):
-        """(state, fp32 grads, mean loss) -> (new_state, metrics). Shared by the
-        fused train step and the forward/backward/step parity path."""
+        """(state, grads, mean loss) -> (new_state, metrics). Shared by the
+        fused train step and the forward/backward/step parity path.
+
+        Grads arrive in COMPUTE dtype at gas==1 (bf16→f32 promotion inside the
+        fused update is exact; an eager upcast would only burn HBM) and in
+        fp32 at gas>1 (cross-micro-batch accumulation) or after fp16
+        unscaling (`LossScaler.unscale_grads` upcasts)."""
         scaler = self.scaler
         optimizer = self.optimizer
         clip = self.config.gradient_clipping
@@ -496,7 +501,9 @@ class Engine:
             grads = scaler.unscale_grads(grads, state.scaler)
 
             finite = scaler.check_overflow(grads)
-            grad_norm = optax.global_norm(grads)
+            # fp32-accumulated global norm (grads may be bf16; a bf16 reduce
+            # would overflow/round — the cast fuses into the reduction)
+            grad_norm = tree_global_norm(grads)
             if clip and clip > 0:
                 factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
                 grads = jax.tree_util.tree_map(lambda g: g * factor.astype(g.dtype), grads)
@@ -657,8 +664,13 @@ class Engine:
                 grads = jax.tree_util.tree_map(lambda g: g * (predivide / gas), grads)
                 loss = loss_sum / gas
             else:
+                # grads stay in compute dtype: they were already rounded to it
+                # by the backward pass, and bf16→f32 promotion inside the fused
+                # optimizer update is exact — an eager upcast would only
+                # materialize an extra fp32 grad tree (1.4G at 350M, 3G at
+                # 760m; fp32 accumulation matters only ACROSS micro-batches,
+                # the gas>1 branch above)
                 grads, loss = micro_grad(params, batch, rng, state.scaler)
-                grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
 
             return apply_grads(state, grads, loss)
 

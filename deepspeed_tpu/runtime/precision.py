@@ -60,8 +60,12 @@ class LossScaler:
     def unscale_grads(self, grads, state: LossScaleState):
         if not self.enabled:
             return grads
+        # unscale in fp32 (reference FP16_Optimizer semantics): dividing in
+        # fp16 underflows small grads to zero once the scale grows (fp16 min
+        # normal is 6e-5), silently freezing training
         inv = (1.0 / state.scale).astype(jnp.float32)
-        return jax.tree_util.tree_map(lambda g: g * inv.astype(g.dtype), grads)
+        return jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) * inv, grads)
 
     def check_overflow(self, grads):
         """True == all finite (no overflow)."""
