@@ -456,6 +456,63 @@ def from_hf_gptj(model_or_sd, hf_config=None, dtype=jnp.float32):
     return cfg, params
 
 
+def from_hf_gpt_neo(model_or_sd, hf_config=None, dtype=jnp.float32):
+    """GPTNeoForCausalLM → (GPTConfig, params). Alternating global/local
+    attention (window 256), UNSCALED attention scores, biasless q/k/v Linear
+    layers, learned positions (reference container: `containers/gptneo.py`)."""
+    sd = _state_dict(model_or_sd)
+    if hf_config is None:
+        hf_config = getattr(model_or_sd, "config", None)
+    assert hf_config is not None
+    D = hf_config.hidden_size
+    H = hf_config.num_heads
+    layer_types = tuple(hf_config.attention_layers)  # expanded per-layer list
+
+    cfg = GPTConfig(
+        vocab_size=hf_config.vocab_size,
+        n_layer=hf_config.num_layers,
+        n_head=H, d_model=D,
+        d_ff=getattr(hf_config, "intermediate_size", None) or 4 * D,
+        max_seq_len=hf_config.max_position_embeddings,
+        norm_eps=float(getattr(hf_config, "layer_norm_epsilon", 1e-5)),
+        sliding_window=int(getattr(hf_config, "window_size", 256)),
+        attn_layer_types=layer_types,
+        scale_attn=False,                  # GPT-Neo does not scale by 1/sqrt(hd)
+        use_rotary=False, use_swiglu=False, use_rmsnorm=False,
+        tie_embeddings=True, dtype=dtype, remat=False)
+
+    layers = []
+    for i in range(cfg.n_layer):
+        b = f"transformer.h.{i}."
+        q = sd[b + "attn.attention.q_proj.weight"]
+        k = sd[b + "attn.attention.k_proj.weight"]
+        v = sd[b + "attn.attention.v_proj.weight"]
+        layers.append({
+            "ln1_scale": sd[b + "ln_1.weight"],
+            "ln1_bias": sd[b + "ln_1.bias"],
+            "attn_qkv_w": np.concatenate([q, k, v], axis=0).T,
+            "attn_qkv_b": np.zeros(3 * D, np.float32),  # q/k/v are biasless
+            "attn_out_w": sd[b + "attn.attention.out_proj.weight"].T,
+            "attn_out_b": sd[b + "attn.attention.out_proj.bias"],
+            "ln2_scale": sd[b + "ln_2.weight"],
+            "ln2_bias": sd[b + "ln_2.bias"],
+            "mlp_up_w": sd[b + "mlp.c_fc.weight"].T,
+            "mlp_up_b": sd[b + "mlp.c_fc.bias"],
+            "mlp_down_w": sd[b + "mlp.c_proj.weight"].T,
+            "mlp_out_b": sd[b + "mlp.c_proj.bias"],
+        })
+    params = {
+        "wte": jnp.asarray(sd["transformer.wte.weight"], dtype),
+        "wpe": jnp.asarray(sd["transformer.wpe.weight"], dtype),
+        "blocks": {k2: v2.astype(dtype) for k2, v2 in _stack(layers).items()},
+        "lnf_scale": jnp.asarray(sd["transformer.ln_f.weight"], dtype),
+        "lnf_bias": jnp.asarray(sd["transformer.ln_f.bias"], dtype),
+    }
+    logger.info(f"adapted HF GPT-Neo: {cfg.n_layer}L d={D} "
+                f"types={layer_types[:4]}... window={cfg.sliding_window}")
+    return cfg, params
+
+
 # ----------------------------------------------------------------------
 # Mistral
 # ----------------------------------------------------------------------
@@ -727,6 +784,66 @@ def from_megatron_gpt(model_or_sd, hf_config=None, dtype=jnp.float32, *,
 
 
 # ----------------------------------------------------------------------
+# CLIP text encoder (diffusers/stable-diffusion conditioning)
+# ----------------------------------------------------------------------
+
+
+def from_hf_clip_text(model_or_sd, hf_config=None, dtype=jnp.float32):
+    """CLIPTextModel → (GPTConfig, params) for models/diffusion.py's
+    clip_text_encode (reference container: `containers/clip.py` maps
+    CLIPEncoderLayer onto the fused GPT block — same mapping here, as a
+    GPTConfig with quick-gelu + causal mask)."""
+    sd = _state_dict(model_or_sd)
+    if hf_config is None:
+        hf_config = getattr(model_or_sd, "config", None)
+    assert hf_config is not None
+    tc = getattr(hf_config, "text_config", hf_config)  # CLIPConfig or CLIPTextConfig
+    D = tc.hidden_size
+    pre = "text_model."
+
+    from deepspeed_tpu.models.gpt import GPTConfig
+    cfg = GPTConfig(
+        vocab_size=tc.vocab_size,
+        n_layer=tc.num_hidden_layers,
+        n_head=tc.num_attention_heads,
+        d_model=D, d_ff=tc.intermediate_size,
+        max_seq_len=tc.max_position_embeddings,
+        norm_eps=float(getattr(tc, "layer_norm_eps", 1e-5)),
+        activation="quick_gelu" if tc.hidden_act == "quick_gelu" else tc.hidden_act,
+        use_rotary=False, use_swiglu=False, use_rmsnorm=False,
+        tie_embeddings=True, dtype=dtype, remat=False)
+
+    layers = []
+    for i in range(cfg.n_layer):
+        b = f"{pre}encoder.layers.{i}."
+        q, k, v = (sd[b + f"self_attn.{n}_proj.weight"] for n in ("q", "k", "v"))
+        qb, kb, vb = (sd[b + f"self_attn.{n}_proj.bias"] for n in ("q", "k", "v"))
+        layers.append({
+            "ln1_scale": sd[b + "layer_norm1.weight"],
+            "ln1_bias": sd[b + "layer_norm1.bias"],
+            "attn_qkv_w": np.concatenate([q, k, v], axis=0).T,
+            "attn_qkv_b": np.concatenate([qb, kb, vb]),
+            "attn_out_w": sd[b + "self_attn.out_proj.weight"].T,
+            "attn_out_b": sd[b + "self_attn.out_proj.bias"],
+            "ln2_scale": sd[b + "layer_norm2.weight"],
+            "ln2_bias": sd[b + "layer_norm2.bias"],
+            "mlp_up_w": sd[b + "mlp.fc1.weight"].T,
+            "mlp_up_b": sd[b + "mlp.fc1.bias"],
+            "mlp_down_w": sd[b + "mlp.fc2.weight"].T,
+            "mlp_out_b": sd[b + "mlp.fc2.bias"],
+        })
+    params = {
+        "wte": jnp.asarray(sd[f"{pre}embeddings.token_embedding.weight"], dtype),
+        "wpe": jnp.asarray(sd[f"{pre}embeddings.position_embedding.weight"], dtype),
+        "blocks": {k2: v2.astype(dtype) for k2, v2 in _stack(layers).items()},
+        "lnf_scale": jnp.asarray(sd[f"{pre}final_layer_norm.weight"], dtype),
+        "lnf_bias": jnp.asarray(sd[f"{pre}final_layer_norm.bias"], dtype),
+    }
+    logger.info(f"adapted HF CLIP text encoder: {cfg.n_layer}L d={D}")
+    return cfg, params
+
+
+# ----------------------------------------------------------------------
 # dispatch
 # ----------------------------------------------------------------------
 
@@ -738,6 +855,7 @@ _ADAPTERS = {
     "opt": from_hf_opt,
     "bloom": from_hf_bloom,
     "gpt_neox": from_hf_gpt_neox,
+    "gpt_neo": from_hf_gpt_neo,
     "gptj": from_hf_gptj,
     "bert": from_hf_bert,
     "distilbert": from_hf_distilbert,

@@ -229,3 +229,43 @@ def test_megatron_reshard_roundtrip_logits_parity(ver):
     l1 = np.asarray(gpt_forward(params, jnp.asarray(toks), cfg))
     l2 = np.asarray(gpt_forward(params2, jnp.asarray(toks), cfg))
     np.testing.assert_allclose(l1, l2)
+
+
+def test_gpt_neo_adapter_logits_and_decode_parity():
+    """GPT-Neo: alternating global/local attention, unscaled scores
+    (reference container `containers/gptneo.py`). Logits must match the HF
+    torch forward, and the cached decode path must match the full forward."""
+    hf_cfg = transformers.GPTNeoConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        max_position_embeddings=64, window_size=8,
+        attention_types=[[["global", "local"], 1]])
+    torch.manual_seed(0)
+    hf = transformers.GPTNeoForCausalLM(hf_cfg)
+    cfg, params = adapt_hf_model(hf)
+    assert cfg.attn_layer_types == ("global", "local")
+    assert not cfg.scale_attn and cfg.sliding_window == 8
+    toks = np.random.default_rng(2).integers(0, 128, (2, 24)).astype(np.int64)
+    _logits_parity(hf, cfg, params, toks)
+
+    # decode path: generated tokens match argmax over the full forward
+    spec = hf_decode_model(hf)
+    from deepspeed_tpu.inference.engine import init_inference
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.config.core import MeshConfig
+    mesh_mod.clear_mesh()
+    mesh_mod.init_mesh(MeshConfig(data=1, tensor=1, sequence=1, expert=1, pipe=1))
+    eng = init_inference(model=spec, config={"dtype": "float32",
+                                             "kv_cache_dtype": "float32",
+                                             "greedy": True})
+    out = eng.generate(toks[:, :12].astype(np.int32), max_new_tokens=4)
+    cur = jnp.asarray(toks[:, :12], jnp.int32)
+    for j in range(4):
+        logits = gpt_forward(spec.params, cur, dataclasses_replace_f32(cfg))
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        np.testing.assert_array_equal(np.asarray(out[:, j]), np.asarray(nxt))
+        cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+
+
+def dataclasses_replace_f32(cfg):
+    import dataclasses
+    return dataclasses.replace(cfg, dtype=jnp.float32)
