@@ -1,0 +1,458 @@
+"""Diffusion (stable-diffusion-style) model family — UNet / VAE / CLIP text.
+
+Reference: `module_inject/containers/{clip,unet,vae}.py` + `csrc/spatial/`
+(channels-last bias-add and fused groupnorm CUDA kernels) — DeepSpeed's
+diffusers acceleration swaps HF modules for fused attention and channels-last
+spatial kernels. The TPU-native counterpart:
+
+  * NHWC layout throughout — channels-last IS the TPU-native conv layout, so
+    the whole `csrc/spatial` kernel family collapses into XLA's fused
+    conv+bias+activation emission;
+  * attention (spatial self- and text cross-attention) is the same einsum
+    formulation as the LLM zoo — one fused softmax program, bf16-friendly;
+  * the denoise loop is a single `lax.scan` over timesteps: scheduler math,
+    UNet, and classifier-free guidance compile into ONE XLA program (the
+    reference replays per-step Python with cuda-graph capture to approximate
+    this).
+
+Blocks mirror the diffusers UNet2DConditionModel essentials: timestep
+sinusoidal embedding + MLP, ResnetBlock2D, Transformer2D (self + cross +
+geglu ff), down/upsample ladder with skips, mid block; VAE decoder ladder;
+CLIP text encoder reusing the GPT block machinery (quick-gelu, causal).
+"""
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ----------------------------------------------------------------------
+# primitives (NHWC)
+# ----------------------------------------------------------------------
+
+
+def conv2d(x, w, b=None, stride=1, padding=1):
+    """x: [B,H,W,C_in], w: [kh,kw,C_in,C_out] (HWIO — TPU-native)."""
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding=((padding, padding), (padding, padding)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if b is not None:
+        out = out + b
+    return out
+
+
+def group_norm(x, scale, bias, groups=32, eps=1e-5):
+    """NHWC group norm with fp32 statistics (the `csrc/spatial` fused-GN
+    role — XLA fuses the normalize+affine+activation chain)."""
+    B, H, W, C = x.shape
+    g = min(groups, C)
+    xg = x.reshape(B, H, W, g, C // g).astype(jnp.float32)
+    mu = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mu) * jax.lax.rsqrt(var + eps)
+    out = xg.reshape(B, H, W, C).astype(x.dtype)
+    return out * scale + bias
+
+
+def timestep_embedding(t, dim, max_period=10000.0):
+    """Sinusoidal timestep embedding (diffusers get_timestep_embedding)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period) *
+                    jnp.arange(half, dtype=jnp.float32) / half)
+    ang = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.cos(ang), jnp.sin(ang)], axis=-1)
+
+
+def _attn(q, k, v, heads):
+    """[B, Nq, C] x [B, Nk, C] multi-head attention, fp32 softmax."""
+    B, Nq, C = q.shape
+    Nk = k.shape[1]
+    hd = C // heads
+    q = q.reshape(B, Nq, heads, hd)
+    k = k.reshape(B, Nk, heads, hd)
+    v = v.reshape(B, Nk, heads, hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / math.sqrt(hd)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return out.reshape(B, Nq, C)
+
+
+# ----------------------------------------------------------------------
+# UNet blocks
+# ----------------------------------------------------------------------
+
+
+def resnet_block(x, temb, p, groups=32):
+    """ResnetBlock2D: GN-silu-conv, +time proj, GN-silu-conv, skip."""
+    h = group_norm(x, p["gn1_s"], p["gn1_b"], groups)
+    h = conv2d(jax.nn.silu(h), p["conv1_w"], p["conv1_b"])
+    if temb is not None:
+        h = h + (jax.nn.silu(temb) @ p["temb_w"] + p["temb_b"])[:, None, None, :]
+    h = group_norm(h, p["gn2_s"], p["gn2_b"], groups)
+    h = conv2d(jax.nn.silu(h), p["conv2_w"], p["conv2_b"])
+    if "skip_w" in p:
+        x = conv2d(x, p["skip_w"], p["skip_b"], padding=0)
+    return x + h
+
+
+def transformer2d(x, context, p, heads, groups=32):
+    """Spatial transformer: GN + proj_in, self-attn, cross-attn(context),
+    geglu ff, proj_out + residual (diffusers BasicTransformerBlock)."""
+    B, H, W, C = x.shape
+    res = x
+    h = group_norm(x, p["gn_s"], p["gn_b"], groups)
+    h = (h.reshape(B, H * W, C) @ p["proj_in_w"]) + p["proj_in_b"]
+
+    # self attention
+    hn = _layer_norm(h, p["ln1_s"], p["ln1_b"])
+    q = hn @ p["sa_q"]
+    k = hn @ p["sa_k"]
+    v = hn @ p["sa_v"]
+    h = h + _attn(q, k, v, heads) @ p["sa_o_w"] + p["sa_o_b"]
+
+    # cross attention over the text context [B, T, C_ctx]
+    hn = _layer_norm(h, p["ln2_s"], p["ln2_b"])
+    q = hn @ p["ca_q"]
+    k = context @ p["ca_k"]
+    v = context @ p["ca_v"]
+    h = h + _attn(q, k, v, heads) @ p["ca_o_w"] + p["ca_o_b"]
+
+    # geglu feed-forward
+    hn = _layer_norm(h, p["ln3_s"], p["ln3_b"])
+    up = hn @ p["ff_in_w"] + p["ff_in_b"]
+    a, g = jnp.split(up, 2, axis=-1)
+    h = h + (a * jax.nn.gelu(g)) @ p["ff_out_w"] + p["ff_out_b"]
+
+    h = h @ p["proj_out_w"] + p["proj_out_b"]
+    return res + h.reshape(B, H, W, C)
+
+
+def _layer_norm(x, s, b, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * s + b
+
+
+def downsample(x, p):
+    return conv2d(x, p["w"], p["b"], stride=2)
+
+
+def upsample(x, p):
+    B, H, W, C = x.shape
+    x = jax.image.resize(x, (B, 2 * H, 2 * W, C), method="nearest")
+    return conv2d(x, p["w"], p["b"])
+
+
+# ----------------------------------------------------------------------
+# UNet2DCondition
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    block_channels: Tuple[int, ...] = (64, 128)   # per resolution level
+    layers_per_block: int = 1
+    attn_levels: Tuple[int, ...] = (1,)           # levels with cross-attn
+    heads: int = 4
+    context_dim: int = 256                        # text-encoder width
+    groups: int = 16
+    dtype: Any = jnp.float32
+
+
+def init_unet_params(cfg: UNetConfig, seed=0):
+    rng = np.random.default_rng(seed)
+    dt = cfg.dtype
+
+    def nrm(*s, scale=0.05):
+        return jnp.asarray(rng.normal(0, scale, s), dt)
+
+    def zeros(*s):
+        return jnp.zeros(s, dt)
+
+    def ones(*s):
+        return jnp.ones(s, dt)
+
+    def resnet(cin, cout, tdim):
+        p = {"gn1_s": ones(cin), "gn1_b": zeros(cin),
+             "conv1_w": nrm(3, 3, cin, cout), "conv1_b": zeros(cout),
+             "temb_w": nrm(tdim, cout), "temb_b": zeros(cout),
+             "gn2_s": ones(cout), "gn2_b": zeros(cout),
+             "conv2_w": nrm(3, 3, cout, cout), "conv2_b": zeros(cout)}
+        if cin != cout:
+            p["skip_w"] = nrm(1, 1, cin, cout)
+            p["skip_b"] = zeros(cout)
+        return p
+
+    def xformer(c):
+        ff = 4 * c
+        return {"gn_s": ones(c), "gn_b": zeros(c),
+                "proj_in_w": nrm(c, c), "proj_in_b": zeros(c),
+                "ln1_s": ones(c), "ln1_b": zeros(c),
+                "sa_q": nrm(c, c), "sa_k": nrm(c, c), "sa_v": nrm(c, c),
+                "sa_o_w": nrm(c, c), "sa_o_b": zeros(c),
+                "ln2_s": ones(c), "ln2_b": zeros(c),
+                "ca_q": nrm(c, c), "ca_k": nrm(cfg.context_dim, c),
+                "ca_v": nrm(cfg.context_dim, c),
+                "ca_o_w": nrm(c, c), "ca_o_b": zeros(c),
+                "ln3_s": ones(c), "ln3_b": zeros(c),
+                "ff_in_w": nrm(c, 2 * ff), "ff_in_b": zeros(2 * ff),
+                "ff_out_w": nrm(ff, c), "ff_out_b": zeros(c),
+                "proj_out_w": nrm(c, c), "proj_out_b": zeros(c)}
+
+    ch = cfg.block_channels
+    tdim = 4 * ch[0]
+    params = {
+        "temb_w1": nrm(ch[0], tdim), "temb_b1": zeros(tdim),
+        "temb_w2": nrm(tdim, tdim), "temb_b2": zeros(tdim),
+        "conv_in_w": nrm(3, 3, cfg.in_channels, ch[0]),
+        "conv_in_b": zeros(ch[0]),
+        "down": [], "up": [],
+        "gn_out_s": ones(ch[0]), "gn_out_b": zeros(ch[0]),
+        "conv_out_w": nrm(3, 3, ch[0], cfg.out_channels),
+        "conv_out_b": zeros(cfg.out_channels),
+    }
+    # down ladder
+    cin = ch[0]
+    for lvl, c in enumerate(ch):
+        blocks = []
+        for _ in range(cfg.layers_per_block):
+            blk = {"res": resnet(cin, c, tdim)}
+            if lvl in cfg.attn_levels:
+                blk["attn"] = xformer(c)
+            blocks.append(blk)
+            cin = c
+        level = {"blocks": blocks}
+        if lvl < len(ch) - 1:
+            level["down"] = {"w": nrm(3, 3, c, c), "b": zeros(c)}
+        params["down"].append(level)
+    # mid
+    cm = ch[-1]
+    params["mid"] = {"res1": resnet(cm, cm, tdim), "attn": xformer(cm),
+                     "res2": resnet(cm, cm, tdim)}
+    # up ladder (reverse, with skip concat channels)
+    for lvl in reversed(range(len(ch))):
+        c = ch[lvl]
+        blocks = []
+        for i in range(cfg.layers_per_block + 1):
+            skip_c = ch[lvl] if i < cfg.layers_per_block else \
+                ch[max(lvl - 1, 0)]
+            blk = {"res": resnet(cin + skip_c, c, tdim)}
+            if lvl in cfg.attn_levels:
+                blk["attn"] = xformer(c)
+            blocks.append(blk)
+            cin = c
+        level = {"blocks": blocks}
+        if lvl > 0:
+            level["up"] = {"w": nrm(3, 3, c, c), "b": zeros(c)}
+        params["up"].append(level)
+    return params
+
+
+def unet_forward(params, x, t, context, cfg: UNetConfig):
+    """x: [B,H,W,C_in] noisy latents, t: [B] timesteps, context: [B,T,ctx].
+    Returns predicted noise [B,H,W,C_out]."""
+    temb = timestep_embedding(t, cfg.block_channels[0]).astype(x.dtype)
+    temb = jax.nn.silu(temb @ params["temb_w1"] + params["temb_b1"])
+    temb = temb @ params["temb_w2"] + params["temb_b2"]
+
+    h = conv2d(x, params["conv_in_w"], params["conv_in_b"])
+    skips = [h]
+    for lvl, level in enumerate(params["down"]):
+        for blk in level["blocks"]:
+            h = resnet_block(h, temb, blk["res"], cfg.groups)
+            if "attn" in blk:
+                h = transformer2d(h, context, blk["attn"], cfg.heads, cfg.groups)
+            skips.append(h)
+        if "down" in level:
+            h = downsample(h, level["down"])
+            skips.append(h)
+
+    m = params["mid"]
+    h = resnet_block(h, temb, m["res1"], cfg.groups)
+    h = transformer2d(h, context, m["attn"], cfg.heads, cfg.groups)
+    h = resnet_block(h, temb, m["res2"], cfg.groups)
+
+    for i, level in enumerate(params["up"]):
+        for blk in level["blocks"]:
+            h = jnp.concatenate([h, skips.pop()], axis=-1)
+            h = resnet_block(h, temb, blk["res"], cfg.groups)
+            if "attn" in blk:
+                h = transformer2d(h, context, blk["attn"], cfg.heads, cfg.groups)
+        if "up" in level:
+            h = upsample(h, level["up"])
+
+    h = group_norm(h, params["gn_out_s"], params["gn_out_b"], cfg.groups)
+    return conv2d(jax.nn.silu(h), params["conv_out_w"], params["conv_out_b"])
+
+
+# ----------------------------------------------------------------------
+# VAE decoder
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class VAEDecoderConfig:
+    latent_channels: int = 4
+    out_channels: int = 3
+    block_channels: Tuple[int, ...] = (128, 64)   # high→low res order
+    layers_per_block: int = 1
+    groups: int = 16
+    scaling_factor: float = 0.18215               # SD latent scale
+    dtype: Any = jnp.float32
+
+
+def init_vae_decoder_params(cfg: VAEDecoderConfig, seed=0):
+    rng = np.random.default_rng(seed)
+    dt = cfg.dtype
+    nrm = lambda *s: jnp.asarray(rng.normal(0, 0.05, s), dt)
+    zeros = lambda *s: jnp.zeros(s, dt)
+    ones = lambda *s: jnp.ones(s, dt)
+
+    def resnet(cin, cout):
+        p = {"gn1_s": ones(cin), "gn1_b": zeros(cin),
+             "conv1_w": nrm(3, 3, cin, cout), "conv1_b": zeros(cout),
+             "gn2_s": ones(cout), "gn2_b": zeros(cout),
+             "conv2_w": nrm(3, 3, cout, cout), "conv2_b": zeros(cout)}
+        if cin != cout:
+            p["skip_w"] = nrm(1, 1, cin, cout)
+            p["skip_b"] = zeros(cout)
+        return p
+
+    ch = cfg.block_channels
+    params = {"conv_in_w": nrm(3, 3, cfg.latent_channels, ch[0]),
+              "conv_in_b": zeros(ch[0]),
+              "mid": {"res1": resnet(ch[0], ch[0]), "res2": resnet(ch[0], ch[0])},
+              "up": [],
+              "gn_out_s": ones(ch[-1]), "gn_out_b": zeros(ch[-1]),
+              "conv_out_w": nrm(3, 3, ch[-1], cfg.out_channels),
+              "conv_out_b": zeros(cfg.out_channels)}
+    cin = ch[0]
+    for lvl, c in enumerate(ch):
+        level = {"blocks": [resnet(cin if i == 0 else c, c)
+                            for i in range(cfg.layers_per_block)]}
+        cin = c
+        if lvl < len(ch) - 1:
+            level["upsample"] = {"w": nrm(3, 3, c, c), "b": zeros(c)}
+        params["up"].append(level)
+    return params
+
+
+def vae_decode(params, z, cfg: VAEDecoderConfig):
+    """z: [B,h,w,latent] → image [B,H,W,3] in [-1, 1]."""
+    h = conv2d(z / cfg.scaling_factor, params["conv_in_w"], params["conv_in_b"])
+    h = resnet_block(h, None, params["mid"]["res1"], cfg.groups)
+    h = resnet_block(h, None, params["mid"]["res2"], cfg.groups)
+    for level in params["up"]:
+        for p in level["blocks"]:
+            h = resnet_block(h, None, p, cfg.groups)
+        if "upsample" in level:
+            h = upsample(h, level["upsample"])
+    h = group_norm(h, params["gn_out_s"], params["gn_out_b"], cfg.groups)
+    return jnp.tanh(conv2d(jax.nn.silu(h), params["conv_out_w"],
+                           params["conv_out_b"]))
+
+
+# ----------------------------------------------------------------------
+# CLIP text encoder — the GPT block machinery with quick-gelu
+# (reference `containers/clip.py` maps CLIPEncoderLayer onto the fused GPT
+# inference block; here the mapping is a GPTConfig)
+# ----------------------------------------------------------------------
+
+
+def clip_text_config(vocab_size=1000, width=256, layers=2, heads=4,
+                     max_len=77, dtype=jnp.float32):
+    from deepspeed_tpu.models.gpt import GPTConfig
+    return GPTConfig(vocab_size=vocab_size, n_layer=layers, n_head=heads,
+                     d_model=width, d_ff=4 * width, max_seq_len=max_len,
+                     activation="quick_gelu", tie_embeddings=True,
+                     dtype=dtype, remat=False)
+
+
+def clip_text_encode(params, tokens, cfg):
+    """CLIP text transformer: causal blocks + final LN; returns
+    (hidden [B,T,D], pooled [B,D]) with pooling at the last token
+    (CLIP pools at the EOS position; callers pass eos-terminated prompts)."""
+    from deepspeed_tpu.models.gpt import _embed, _block, _norm
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    x = _embed(params, tokens, positions, cfg)
+
+    def body(x, lp):
+        return _block(x, lp, cfg=cfg, positions=positions), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"),
+              cfg.use_rmsnorm, cfg.norm_eps)
+    return x, x[:, -1, :]
+
+
+# ----------------------------------------------------------------------
+# DDIM scheduler + txt2img pipeline (one compiled scan)
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DDIMSchedule:
+    num_train_timesteps: int = 1000
+    beta_start: float = 0.00085
+    beta_end: float = 0.012
+
+    def alphas_cumprod(self):
+        betas = jnp.linspace(self.beta_start**0.5, self.beta_end**0.5,
+                             self.num_train_timesteps) ** 2
+        return jnp.cumprod(1.0 - betas)
+
+
+def ddim_step(eps, x, alpha_t, alpha_prev):
+    """Deterministic DDIM update (eta=0)."""
+    x0 = (x - jnp.sqrt(1 - alpha_t) * eps) / jnp.sqrt(alpha_t)
+    return jnp.sqrt(alpha_prev) * x0 + jnp.sqrt(1 - alpha_prev) * eps
+
+
+def make_txt2img(unet_params, unet_cfg: UNetConfig,
+                 vae_params, vae_cfg: VAEDecoderConfig,
+                 text_params, text_cfg,
+                 schedule: DDIMSchedule = None, steps: int = 20,
+                 guidance_scale: float = 7.5, latent_hw: int = 16):
+    """Build a jitted (prompt_tokens, uncond_tokens, rng) -> images function.
+
+    Classifier-free guidance batches cond+uncond through one UNet call; the
+    whole denoise loop is a single lax.scan — scheduler constants are baked
+    into the compiled program."""
+    schedule = schedule or DDIMSchedule()
+    acp = schedule.alphas_cumprod()
+    ts = jnp.linspace(schedule.num_train_timesteps - 1, 0, steps).astype(jnp.int32)
+    alphas = acp[ts]
+    alphas_prev = jnp.concatenate([acp[ts[1:]], jnp.ones((1,))])
+
+    def txt2img(prompt_tokens, uncond_tokens, rng):
+        B = prompt_tokens.shape[0]
+        ctx_c, _ = clip_text_encode(text_params, prompt_tokens, text_cfg)
+        ctx_u, _ = clip_text_encode(text_params, uncond_tokens, text_cfg)
+        context = jnp.concatenate([ctx_u, ctx_c], axis=0)   # [2B, T, D]
+        x = jax.random.normal(
+            rng, (B, latent_hw, latent_hw, unet_cfg.in_channels),
+            unet_cfg.dtype)
+
+        def body(x, sched):
+            t, a_t, a_prev = sched
+            xx = jnp.concatenate([x, x], axis=0)
+            tt = jnp.full((2 * B,), t, jnp.int32)
+            eps = unet_forward(unet_params, xx, tt, context, unet_cfg)
+            eps_u, eps_c = jnp.split(eps, 2, axis=0)
+            eps = eps_u + guidance_scale * (eps_c - eps_u)
+            return ddim_step(eps, x, a_t, a_prev), None
+
+        x, _ = jax.lax.scan(body, x, (ts, alphas, alphas_prev))
+        return vae_decode(vae_params, x, vae_cfg)
+
+    return jax.jit(txt2img)
