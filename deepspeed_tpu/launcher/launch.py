@@ -84,25 +84,34 @@ def build_rank_env(world_info, node_rank, local_rank, procs_per_node,
     return env
 
 
+def _signal_child(p, sig):
+    """Signal a child's process group — but NEVER our own group. If the child
+    shares our group (spawned without start_new_session, or its pid was
+    recycled), killpg would TERM the caller and every sibling — in an
+    in-process harness that detonates unrelated work."""
+    try:
+        pgid = os.getpgid(p.pid)
+        if pgid == os.getpgid(0):
+            p.send_signal(sig)
+        else:
+            os.killpg(pgid, sig)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
 def terminate_process_tree(procs, timeout=30):
     """SIGTERM then SIGKILL the spawned processes (children ride the process
     group — each child is started in its own session)."""
     for p in procs:
         if p.poll() is None:
-            try:
-                os.killpg(os.getpgid(p.pid), signal.SIGTERM)
-            except (ProcessLookupError, PermissionError):
-                pass
+            _signal_child(p, signal.SIGTERM)
     deadline = time.time() + timeout
     for p in procs:
         remaining = max(0.1, deadline - time.time())
         try:
             p.wait(timeout=remaining)
         except subprocess.TimeoutExpired:
-            try:
-                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
+            _signal_child(p, signal.SIGKILL)
 
 
 def main(args=None):
@@ -139,8 +148,8 @@ def main(args=None):
         terminate_process_tree(procs)
         sys.exit(128 + signum)
 
-    signal.signal(signal.SIGINT, handler)
-    signal.signal(signal.SIGTERM, handler)
+    saved = {sig: signal.signal(sig, handler)
+             for sig in (signal.SIGINT, signal.SIGTERM)}
 
     rc = 0
     try:
@@ -154,6 +163,10 @@ def main(args=None):
                 terminate_process_tree(procs)
     finally:
         terminate_process_tree(procs, timeout=5)
+        # restore: leaving our handler installed poisons in-process callers
+        # (a stray signal later would run it with dead procs and sys.exit)
+        for sig, old in saved.items():
+            signal.signal(sig, old)
     return rc
 
 

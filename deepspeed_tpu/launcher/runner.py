@@ -19,6 +19,7 @@ Hostfile format is the reference's: `hostname slots=N` per line.
 
 import argparse
 import base64
+import contextlib
 import json
 import os
 import shlex
@@ -123,8 +124,8 @@ def main(args=None):
         env.setdefault("RANK", "0")
         logger.info(f"launching single-host: {' '.join(cmd_tail)}")
         proc = subprocess.Popen([sys.executable] + cmd_tail, env=env)
-        _forward_signals(proc)
-        return proc.wait()
+        with _forward_signals(proc):
+            return proc.wait()
 
     if args.launcher not in ("ssh",):
         # backend-managed fanout (pdsh / mpirun / srun ... — reference
@@ -141,8 +142,8 @@ def main(args=None):
         cmd, env = runner.get_cmd(dict(os.environ), resources)
         logger.info(f"launching via {runner.name}: {' '.join(map(str, cmd))}")
         proc = subprocess.Popen(cmd, env=env)
-        _forward_signals(proc)
-        return proc.wait()
+        with _forward_signals(proc):
+            return proc.wait()
 
     # multi-host ssh fanout: rank i on host i
     hosts = list(resources.keys())
@@ -169,12 +170,26 @@ def main(args=None):
     return rc
 
 
+@contextlib.contextmanager
 def _forward_signals(proc):
+    """Forward INT/TERM to `proc` for the duration of the wait, then RESTORE
+    the previous handlers. Leaving them installed poisons in-process callers
+    (e.g. a test harness): a later signal would hit a handler holding a dead
+    proc long after the launch returned."""
     def handler(signum, frame):
-        proc.send_signal(signum)
+        try:
+            proc.send_signal(signum)
+        except ProcessLookupError:
+            pass
 
+    saved = {}
     for sig in (signal.SIGINT, signal.SIGTERM):
-        signal.signal(sig, handler)
+        saved[sig] = signal.signal(sig, handler)
+    try:
+        yield
+    finally:
+        for sig, old in saved.items():
+            signal.signal(sig, old)
 
 
 if __name__ == "__main__":

@@ -56,6 +56,16 @@ def _reset_global_mesh():
     mesh_mod.clear_mesh()
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled-program caches between test modules: a full-suite run
+    otherwise accumulates hundreds of live executables on the virtual
+    8-device CPU backend, which has been observed to abort() inside XLA
+    (shard_map collectives) late in the run."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture
 def devices8():
     ds = jax.devices()
