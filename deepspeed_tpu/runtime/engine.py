@@ -937,12 +937,18 @@ class Engine:
                      f"(loss scale -> {float(self.state.scaler.scale):.1f})", ranks=[0])
         if self.monitor is not None and self.monitor.enabled:
             if self.global_steps % self.config.steps_per_print == 0:
-                self.monitor.write_events([
+                events = [
                     ("Train/loss", float(metrics["loss"]), self.global_steps),
                     ("Train/lr", float(metrics["lr"]), self.global_steps),
                     ("Train/loss_scale", float(metrics["loss_scale"]), self.global_steps),
                     ("Train/grad_norm", float(metrics["grad_norm"]), self.global_steps),
-                ])
+                ]
+                if self.block_eigenvalue is not None:
+                    # reference engine.py:2150-2158 Train/Eigenvalues events
+                    events += [(f"Train/Eigenvalues/ModelBlockParam_{i}",
+                                float(v), self.global_steps)
+                               for i, v in enumerate(self.block_eigenvalue)]
+                self.monitor.write_events(events)
         if self.config.wall_clock_breakdown and \
                 self.global_steps % self.config.steps_per_print == 0:
             self.timers.log([TRAIN_BATCH_TIMER])
