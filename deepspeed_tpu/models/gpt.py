@@ -63,9 +63,11 @@ class GPTConfig:
     remat_policy: str = "nothing_saveable"  # jax.checkpoint_policies name, or
                                      # "save_matmuls": save every big matmul
                                      # output (named checkpoints) so backward
-                                     # recomputes only norms/softmax/elementwise
-                                     # — ~1/4 the refwd cost of full remat at
-                                     # ~150MB/layer (350M, mbs16, seq512)
+                                     # recomputes only norms/softmax/elementwise.
+                                     # Measured on v5e: full remat WINS anyway —
+                                     # recompute is cheaper than reloading the
+                                     # saved ~150MB/layer from HBM; kept as an
+                                     # option for bandwidth-rich parts
     use_flash_attention: bool = False  # pallas kernel (ops/pallas/flash_attention.py)
     softmax_dtype: Any = jnp.float32  # attention softmax accumulation dtype;
                                      # bf16 halves the dominant HBM traffic of
