@@ -14,10 +14,31 @@ from deepspeed_tpu.config.core import TpuTrainConfig
 from deepspeed_tpu.runtime.engine import Engine, initialize
 from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
 from deepspeed_tpu import comm
+from deepspeed_tpu import zero
 from deepspeed_tpu.utils.logging import logger, log_dist
 from deepspeed_tpu.platform import get_accelerator
 
 from deepspeed_tpu.runtime.arguments import add_config_arguments
+
+# reference-name aliases + parity surface (deepspeed/__init__.py:21-45)
+from deepspeed_tpu.runtime.hybrid_engine import HybridEngine
+from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments
+from deepspeed_tpu.runtime import activation_checkpointing as checkpointing
+from deepspeed_tpu.inference.config import TpuInferenceConfig
+from deepspeed_tpu.comm.comm import init_distributed
+from deepspeed_tpu.utils.init_on_device import OnDevice
+
+DeepSpeedEngine = Engine
+DeepSpeedHybridEngine = HybridEngine
+DeepSpeedConfig = TpuTrainConfig
+DeepSpeedInferenceConfig = TpuInferenceConfig
+
+
+def default_inference_config():
+    """Reference `default_inference_config` (`deepspeed/__init__.py:262`):
+    the inference config schema with default values, as a dict."""
+    import dataclasses
+    return dataclasses.asdict(TpuInferenceConfig())
 
 
 def _get_monitor():  # lazy to keep import light
@@ -28,11 +49,23 @@ def _get_monitor():  # lazy to keep import light
 __all__ = [
     "initialize",
     "init_inference",
+    "default_inference_config",
     "add_config_arguments",
+    "add_tuning_arguments",
+    "init_distributed",
     "Engine",
+    "DeepSpeedEngine",
+    "HybridEngine",
+    "DeepSpeedHybridEngine",
     "InferenceEngine",
     "TpuTrainConfig",
+    "DeepSpeedConfig",
+    "TpuInferenceConfig",
+    "DeepSpeedInferenceConfig",
+    "checkpointing",
+    "OnDevice",
     "comm",
+    "zero",
     "logger",
     "log_dist",
     "get_accelerator",

@@ -156,3 +156,36 @@ class LRScheduler:
 
     def load_state_dict(self, sd):
         self.last_step = sd["last_step"]
+
+
+def add_tuning_arguments(parser):
+    """Reference `add_tuning_arguments` (`runtime/lr_schedules.py:56`): the
+    convergence-tuning CLI surface. Same flag names so reference training
+    scripts parse unchanged; values feed the `scheduler` config block."""
+    g = parser.add_argument_group("Convergence Tuning",
+                                  "Convergence tuning configurations")
+    g.add_argument("--lr_schedule", type=str, default=None)
+    # LR range test
+    g.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
+    g.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
+    g.add_argument("--lr_range_test_step_size", type=int, default=1000)
+    g.add_argument("--lr_range_test_staircase", type=bool, default=False)
+    # OneCycle
+    g.add_argument("--cycle_first_step_size", type=int, default=1000)
+    g.add_argument("--cycle_first_stair_count", type=int, default=-1)
+    g.add_argument("--cycle_second_step_size", type=int, default=-1)
+    g.add_argument("--cycle_second_stair_count", type=int, default=-1)
+    g.add_argument("--decay_step_size", type=int, default=1000)
+    g.add_argument("--cycle_min_lr", type=float, default=0.01)
+    g.add_argument("--cycle_max_lr", type=float, default=0.1)
+    g.add_argument("--decay_lr_rate", type=float, default=0.0)
+    g.add_argument("--cycle_momentum", default=False, action="store_true")
+    g.add_argument("--cycle_min_mom", type=float, default=0.8)
+    g.add_argument("--cycle_max_mom", type=float, default=0.9)
+    g.add_argument("--decay_mom_rate", type=float, default=0.0)
+    # Warmup
+    g.add_argument("--warmup_min_lr", type=float, default=0)
+    g.add_argument("--warmup_max_lr", type=float, default=0.001)
+    g.add_argument("--warmup_num_steps", type=int, default=1000)
+    g.add_argument("--warmup_type", type=str, default="log")
+    return parser

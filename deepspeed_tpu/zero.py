@@ -1,0 +1,109 @@
+"""`deepspeed_tpu.zero` — the reference's `deepspeed.zero` namespace.
+
+Reference surface (`deepspeed/runtime/zero/__init__.py` via
+`deepspeed/__init__.py`): `zero.Init` (construction-time partitioning,
+`zero/partition_parameters.py:723`), `zero.GatheredParameters`
+(`partition_parameters.py:2204`), `zero.TiledLinear` (`zero/tiling.py`),
+`zero.register_external_parameter` (`zero/partition_parameters.py:85`).
+
+TPU mapping: stage-3 partitioning is a sharding policy, not module surgery, so
+most of this namespace collapses into three facts —
+
+  * construction-time partitioning = `ModelSpec(init_fn=...)`: the engine
+    materializes each leaf directly into its shard (see
+    `utils/init_on_device.py`); `Init` here is the reference-shaped wrapper;
+  * a sharded `jax.Array` is LOGICALLY WHOLE: reading it (device_get,
+    indexing) is already the "gather", so `GatheredParameters` is a thin
+    context that yields host copies and writes modifications back with the
+    original shardings;
+  * hook-registration (`register_external_parameter`) has no SPMD equivalent
+    to register — XLA sees every use of every parameter; kept as a no-op for
+    call-site compatibility.
+"""
+
+import contextlib
+
+import jax
+
+from deepspeed_tpu.runtime.tiling import TiledLinear  # re-export (zero/tiling.py)
+from deepspeed_tpu.utils.init_on_device import OnDevice, abstract_init, \
+    materialize_sharded
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["Init", "GatheredParameters", "TiledLinear",
+           "register_external_parameter", "unregister_external_parameter"]
+
+
+class Init(OnDevice):
+    """Reference-shaped `zero.Init` (`zero/partition_parameters.py:723`).
+
+    Idiomatic use on TPU is simply::
+
+        spec = ModelSpec(loss_fn=..., init_fn=my_init_fn)   # or
+        engine, *_ = initialize(model=loss_fn, model_parameters=my_init_fn, ...)
+
+    — the engine shards the abstract shapes first and runs the initializer
+    with ``out_shardings``, so the full model never materializes. This class
+    keeps the reference's context-manager call shape for ported code; the
+    reference's CUDA/NVMe placement knobs are accepted and ignored (sharded
+    placement is the config's job here).
+
+        with zero.Init(config_dict_or_path=cfg):
+            spec = make_gpt_model(cfg=model_cfg, abstract=True)
+    """
+
+    def __init__(self, module=None, data_parallel_group=None,
+                 mem_efficient_linear=True, remote_device=None,
+                 pin_memory=False, config_dict_or_path=None, config=None,
+                 enabled=True, dtype=None, mpu=None, sequence_data_parallel_group=None,
+                 param_swapper=None):
+        super().__init__(dtype=dtype, device="meta", enabled=enabled)
+        self.config = config_dict_or_path if config_dict_or_path is not None else config
+        if remote_device not in (None, "cpu", "nvme"):
+            logger.warning(f"zero.Init: ignoring remote_device={remote_device!r} "
+                           "(sharded placement is the ZeRO policy's job on TPU)")
+
+
+@contextlib.contextmanager
+def GatheredParameters(params, modifier_rank=None, fwd_module=None, enabled=True):
+    """Reference `zero.GatheredParameters` (`partition_parameters.py:2204`):
+    temporarily gather stage-3 partitioned params for host-side inspection or
+    modification.
+
+    On TPU a sharded `jax.Array` is logically whole, so "gathering" for READS
+    is free — this context yields host numpy copies; on exit, any leaves the
+    caller REPLACED in the yielded dict/list are placed back with each
+    original leaf's sharding (the re-partition step of the reference's exit).
+    `modifier_rank` is accepted for signature parity (single-program SPMD has
+    no per-rank modification)."""
+    if not enabled:
+        yield params
+        return
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    host = [jax.device_get(l) for l in leaves]
+    out = jax.tree_util.tree_unflatten(treedef, list(host))
+    yield out
+    new_leaves = jax.tree_util.tree_leaves(out)
+    for i, (old, new) in enumerate(zip(leaves, new_leaves)):
+        if new is not host[i]:  # caller replaced this leaf: re-partition
+            leaves[i] = jax.device_put(new, old.sharding)
+    # mutate the original containers in place where possible so the caller's
+    # reference sees the re-partitioned values (reference semantics)
+    updated = jax.tree_util.tree_unflatten(treedef, leaves)
+    if isinstance(params, dict):
+        params.update(updated)
+    elif isinstance(params, list):
+        params[:] = updated
+
+
+def register_external_parameter(module, parameter):
+    """Reference `zero.register_external_parameter`: tells the stage-3 hook
+    machinery that a module accesses a parameter it doesn't own. SPMD needs no
+    registration — XLA traces every use of every array — so this is a no-op
+    kept for call-site compatibility."""
+    return None
+
+
+def unregister_external_parameter(module, parameter):
+    """Counterpart no-op (see `register_external_parameter`)."""
+    return None

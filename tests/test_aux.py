@@ -252,3 +252,21 @@ def test_engine_auto_flops_profile():
     assert eng._flops_profiler is not None      # ran at step 2
     assert eng._flops_profiler.get_total_flops() > 0
     eng.train_batch(b)                          # runs once only
+
+
+def test_top_level_api_parity_surface():
+    """Reference deepspeed/__init__.py exports resolve here (aliases included)."""
+    import argparse
+    import deepspeed_tpu as ds
+    assert ds.DeepSpeedEngine is ds.Engine
+    assert ds.DeepSpeedHybridEngine is ds.HybridEngine
+    assert ds.DeepSpeedConfig is ds.TpuTrainConfig
+    assert ds.DeepSpeedInferenceConfig is ds.TpuInferenceConfig
+    assert callable(ds.init_distributed) and callable(ds.checkpointing.configure)
+    assert ds.OnDevice is not None and ds.zero.Init is not None
+    cfg = ds.default_inference_config()
+    assert isinstance(cfg, dict) and "dtype" in cfg
+    p = argparse.ArgumentParser()
+    ds.add_tuning_arguments(p)
+    ns = p.parse_args(["--warmup_num_steps", "7", "--cycle_min_lr", "0.02"])
+    assert ns.warmup_num_steps == 7 and ns.cycle_min_lr == 0.02
