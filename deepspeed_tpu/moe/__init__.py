@@ -1,0 +1,9 @@
+"""`deepspeed_tpu.moe` — the reference's `deepspeed.moe` import namespace
+(`deepspeed/moe/`). The implementation lives in `parallel/moe.py` (expert
+sharding over the `expert` mesh axis); this package keeps reference import
+paths (`from deepspeed.moe.layer import MoE`) working."""
+
+from deepspeed_tpu.moe import layer
+from deepspeed_tpu.parallel.moe import MoE, MoELayer
+
+__all__ = ["MoE", "MoELayer", "layer"]

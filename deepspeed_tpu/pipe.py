@@ -1,0 +1,74 @@
+"""`deepspeed_tpu.pipe` — the reference's `deepspeed.pipe` namespace
+(`deepspeed/pipe/__init__.py` re-exports `PipelineModule`, `LayerSpec`,
+`TiedLayerSpec` from `runtime/pipe/module.py`).
+
+TPU mapping: a pipeline "module" is three pure functions + stacked stage
+params (`parallel/pipeline.py`), not an nn.Sequential split. `PipelineModule`
+here is the reference-shaped constructor over those primitives; `LayerSpec`'s
+role (deferred layer construction so each rank builds only its stages) is
+subsumed by construction-time sharding — params materialize into their pipe
+shard directly (ModelSpec.init_fn / zero.Init).
+"""
+
+from deepspeed_tpu.parallel.pipeline import (partition_layers,
+                                             pipeline_loss_fn,
+                                             pipeline_grad_fn,
+                                             pipeline_forward_fn,
+                                             make_gpt_pipeline_model)
+from deepspeed_tpu.runtime.engine import ModelSpec
+
+
+class PipelineModule:
+    """Reference-shaped `PipelineModule` (`runtime/pipe/module.py:92`).
+
+    Args mirror the reference where they translate:
+      * embed_fn/block_fn/head_loss_fn — the stage functions (the reference's
+        `layers=[LayerSpec...]` list collapses into one scanned block fn over
+        stacked params);
+      * params — {"embed", "blocks" [PP*Lp, ...], "head"} pytree;
+      * num_stages — pipe depth (reference `num_stages`);
+      * num_microbatches — schedule width;
+      * partition_method — kept for signature parity; stage assignment of
+        stacked blocks is uniform by construction (use `partition_layers` to
+        compute assignments for uneven costs);
+      * schedule — "1f1b" (reference TrainSchedule) or "gpipe" (fill-drain).
+
+    `.to_model_spec()` yields the engine input; the instance itself is also
+    accepted by `deepspeed_tpu.initialize` via duck-typing of ModelSpec
+    fields.
+    """
+
+    def __init__(self, embed_fn, block_fn, head_loss_fn, params,
+                 num_stages=2, num_microbatches=4, partition_method="uniform",
+                 schedule="1f1b", remat_blocks=True, param_specs=None,
+                 name="pipeline"):
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.partition_method = partition_method
+        loss_fn = pipeline_loss_fn(embed_fn, block_fn, head_loss_fn,
+                                   num_stages=num_stages,
+                                   num_microbatches=num_microbatches,
+                                   remat_blocks=remat_blocks)
+        schedule = schedule.lower()
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"unknown schedule {schedule!r}")
+        grad_fn = None
+        if schedule == "1f1b":
+            grad_fn = pipeline_grad_fn(embed_fn, block_fn, head_loss_fn,
+                                       num_stages=num_stages,
+                                       num_microbatches=num_microbatches,
+                                       remat_blocks=remat_blocks)
+        self._spec = ModelSpec(loss_fn=loss_fn, params=params,
+                               param_specs=param_specs, grad_fn=grad_fn,
+                               name=name)
+
+    def to_model_spec(self) -> ModelSpec:
+        return self._spec
+
+    # duck-typed ModelSpec surface so initialize(model=PipelineModule(...)) works
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_spec"], item)
+
+
+__all__ = ["PipelineModule", "partition_layers", "pipeline_loss_fn",
+           "pipeline_grad_fn", "pipeline_forward_fn", "make_gpt_pipeline_model"]

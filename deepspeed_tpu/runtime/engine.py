@@ -1112,6 +1112,8 @@ def initialize(args=None,
         config = getattr(args, "deepspeed_config", None) or getattr(args, "deepscale_config", None)
     cfg = TpuTrainConfig.load(config)
 
+    if hasattr(model, "to_model_spec"):   # e.g. pipe.PipelineModule
+        model = model.to_model_spec()
     if not isinstance(model, ModelSpec):
         assert callable(model), "model must be a ModelSpec or a loss callable"
         assert model_parameters is not None, \
