@@ -1,0 +1,32 @@
+"""`deepspeed_tpu.runtime.utils` — reference import-path parity for the most
+commonly imported helpers of `deepspeed/runtime/utils.py` (see_memory_usage,
+get_global_norm, clip_grad_norm_). Implementations live in
+`deepspeed_tpu/utils/`; functional JAX versions return instead of mutating."""
+
+import jax
+
+from deepspeed_tpu.utils.memory import see_memory_usage
+from deepspeed_tpu.utils.tree import tree_global_norm
+
+
+def get_global_norm(norm_list=None, parameters=None):
+    """Reference `get_global_norm` (`runtime/utils.py`): combine per-group
+    norms, or compute the global norm of a parameter pytree."""
+    if norm_list is not None:
+        return float(sum(n**2 for n in norm_list) ** 0.5)
+    return float(tree_global_norm(parameters))
+
+
+def clip_grad_norm_(parameters=None, max_norm=1.0, mpu=None, grads=None):
+    """Reference `clip_grad_norm_` semantics, functional: returns
+    (clipped_grads, total_norm) instead of mutating in place. Accepts either
+    `grads` or the reference's `parameters` name for the pytree."""
+    tree = grads if grads is not None else parameters
+    total = tree_global_norm(tree)
+    factor = jax.numpy.minimum(1.0, max_norm / (total + 1e-6))
+    clipped = jax.tree_util.tree_map(
+        lambda g: g * factor.astype(g.dtype), tree)
+    return clipped, float(total)
+
+
+__all__ = ["see_memory_usage", "get_global_norm", "clip_grad_norm_"]

@@ -270,3 +270,18 @@ def test_top_level_api_parity_surface():
     ds.add_tuning_arguments(p)
     ns = p.parse_args(["--warmup_num_steps", "7", "--cycle_min_lr", "0.02"])
     assert ns.warmup_num_steps == 7 and ns.cycle_min_lr == 0.02
+
+
+def test_runtime_utils_parity_imports():
+    """Reference import path `from deepspeed.runtime.utils import ...`."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.runtime.utils import (see_memory_usage, get_global_norm,
+                                             clip_grad_norm_)
+    assert callable(see_memory_usage)
+    assert get_global_norm(norm_list=[3.0, 4.0]) == pytest.approx(5.0)
+    g = {"w": jnp.full((4,), 3.0)}
+    assert get_global_norm(parameters=g) == pytest.approx(6.0)
+    clipped, total = clip_grad_norm_(parameters=g, max_norm=1.0)
+    assert total == pytest.approx(6.0)
+    np.testing.assert_allclose(np.asarray(clipped["w"]),
+                               np.full((4,), 0.5), rtol=1e-5)
