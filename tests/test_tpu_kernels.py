@@ -150,15 +150,19 @@ def test_evoformer_attention_compiled():
                                atol=2e-2, rtol=2e-2)  # MXU default precision
 
 
-def _bench(fn, *args, iters=20):
+def _bench(fn, *args, iters=10, batches=5):
+    """Best-of-N batched timing: a single pass is too noisy on the shared
+    tunneled chip (observed >30% swings between identical runs)."""
     out = fn(*args)
-    jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
     float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))  # hard fence
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
-    return (time.perf_counter() - t0) / iters
+    best = float("inf")
+    for _ in range(batches):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
 
 
 def test_flash_beats_xla_at_long_seq():
