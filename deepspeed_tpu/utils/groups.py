@@ -1,0 +1,92 @@
+"""`deepspeed_tpu.utils.groups` — the reference's process-group bookkeeping
+(`deepspeed/utils/groups.py`), mapped onto the global mesh.
+
+The reference materializes torch process groups per parallelism flavor
+(`_create_expert_and_data_parallel` etc.) and hands them to collectives. On
+TPU a "group" is a tuple of mesh axis names: collectives inside the compiled
+program reduce over axes, so this module only answers the bookkeeping
+questions (sizes, ranks, axis handles) in the reference's vocabulary.
+
+Reference names keep their leading underscore (MoE client code imports them
+that way) with public aliases.
+"""
+
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.utils.logging import logger
+
+_EP_SIZE = None
+
+
+def initialize(ep_size=1, mpu=None):
+    """Reference `groups.initialize(ep_size=...)` (`utils/groups.py:51`):
+    record the expert-parallel degree. The actual mesh factoring comes from
+    the config's mesh block; this validates consistency when a mesh exists."""
+    global _EP_SIZE
+    _EP_SIZE = int(ep_size)
+    if mesh_mod.has_mesh():
+        actual = mesh_mod.axis_size(mesh_mod.EXPERT_AXIS)
+        if actual not in (1, _EP_SIZE):
+            logger.warning(f"groups.initialize(ep_size={ep_size}) but the mesh "
+                           f"expert axis is {actual}; the mesh wins")
+
+
+def _get_data_parallel_group():
+    """Axes forming the data-parallel domain (a 'group handle' here is the
+    axis-name tuple accepted by every comm collective)."""
+    return mesh_mod.ZERO_AXES
+
+
+def _get_data_parallel_world_size():
+    return mesh_mod.axis_size(mesh_mod.ZERO_AXES)
+
+
+def _get_data_parallel_rank():
+    import jax
+    return jax.process_index()
+
+
+def _get_model_parallel_group():
+    return (mesh_mod.TENSOR_AXIS,)
+
+
+def _get_model_parallel_world_size():
+    return mesh_mod.axis_size(mesh_mod.TENSOR_AXIS)
+
+
+def _get_expert_parallel_group(group_name=None):
+    return (mesh_mod.EXPERT_AXIS,)
+
+
+def _get_expert_parallel_world_size(group_name=None):
+    return mesh_mod.axis_size(mesh_mod.EXPERT_AXIS)
+
+
+def _get_expert_data_parallel_group(group_name=None):
+    """Data-parallel replication domain of the expert weights (the axes NOT
+    carrying experts within the ZeRO domain)."""
+    return tuple(a for a in mesh_mod.ZERO_AXES if a != mesh_mod.EXPERT_AXIS)
+
+
+def _get_expert_data_parallel_world_size(group_name=None):
+    return mesh_mod.axis_size(_get_expert_data_parallel_group())
+
+
+def _get_sequence_parallel_group():
+    return (mesh_mod.SEQ_AXIS,)
+
+
+def _get_sequence_parallel_world_size():
+    return mesh_mod.axis_size(mesh_mod.SEQ_AXIS)
+
+
+def _get_world_group():
+    return mesh_mod.ALL_AXES
+
+
+# public aliases
+get_data_parallel_group = _get_data_parallel_group
+get_data_parallel_world_size = _get_data_parallel_world_size
+get_model_parallel_world_size = _get_model_parallel_world_size
+get_expert_parallel_group = _get_expert_parallel_group
+get_expert_parallel_world_size = _get_expert_parallel_world_size
+get_sequence_parallel_world_size = _get_sequence_parallel_world_size
