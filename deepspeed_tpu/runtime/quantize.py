@@ -144,8 +144,14 @@ def block_eigenvalues(loss_fn, params, batch, max_iter: int = 100,
 
         def body(carry):
             v, prev, it, _ = carry
-            hv = jax.vmap(layer_hvp, in_axes=(0, None))(idx, v)
-            # vmap output row j of instance i is zero unless j == i: collapse
+            # vmap batches L tangent copies (L x model memory) — fine for
+            # typical depths; deep models switch to lax.map (sequential, O(1)
+            # extra memory, same one-program property)
+            if L <= 16:
+                hv = jax.vmap(layer_hvp, in_axes=(0, None))(idx, v)
+            else:
+                hv = jax.lax.map(lambda i: layer_hvp(i, v), idx)
+            # per-instance output row j is zero unless j == i: collapse
             hv = jax.tree_util.tree_map(
                 lambda l: jnp.sum(l, axis=1) if l.ndim > 1 else l, hv)
             ev = sum(jnp.sum(a.astype(jnp.float32) * b.astype(jnp.float32),
