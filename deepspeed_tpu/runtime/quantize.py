@@ -117,9 +117,13 @@ def block_eigenvalues(loss_fn, params, batch, max_iter: int = 100,
         return jax.tree_util.tree_map(leaf, tree)
 
     def layer_hvp(i, v):
-        # v: blocks-shaped, row i of every leaf holds layer i's vector
+        # v: blocks-shaped, row i of every leaf holds layer i's vector.
+        # Slice row i of the product: (Hv)_i = H_ii v_i exactly (the tangent
+        # is supported on layer i only), and returning just that row keeps the
+        # mapped output at [L, ...] — one model's worth — instead of an
+        # [L, L, ...] stack of masked copies.
         hv = jax.jvp(grad_fn, (blocks,), (layer_mask(i, v),))[1]
-        return layer_mask(i, hv)
+        return jax.tree_util.tree_map(lambda l: l[i], hv)
 
     def norms(v):
         """Per-layer L2 norms [L] over all leaves."""
@@ -144,16 +148,14 @@ def block_eigenvalues(loss_fn, params, batch, max_iter: int = 100,
 
         def body(carry):
             v, prev, it, _ = carry
-            # vmap batches L tangent copies (L x model memory) — fine for
-            # typical depths; deep models switch to lax.map (sequential, O(1)
-            # extra memory, same one-program property)
+            # vmap batches L tangent copies (L x model memory in
+            # intermediates) — fine for typical depths; deep models switch to
+            # lax.map (sequential: one tangent's activations live at a time,
+            # same one-program property). Both produce [L, ...] outputs.
             if L <= 16:
                 hv = jax.vmap(layer_hvp, in_axes=(0, None))(idx, v)
             else:
                 hv = jax.lax.map(lambda i: layer_hvp(i, v), idx)
-            # per-instance output row j is zero unless j == i: collapse
-            hv = jax.tree_util.tree_map(
-                lambda l: jnp.sum(l, axis=1) if l.ndim > 1 else l, hv)
             ev = sum(jnp.sum(a.astype(jnp.float32) * b.astype(jnp.float32),
                              axis=tuple(range(1, a.ndim)))
                      for a, b in zip(jax.tree_util.tree_leaves(v),
