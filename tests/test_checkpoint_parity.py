@@ -5,6 +5,7 @@ state_dict_factory TP-resharding loaders.
 """
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -265,3 +266,57 @@ class TestUniversalCli:
         with pytest.raises(ValueError, match="keys.json"):
             convert_checkpoint_to_universal(str(tmp_path / "ckpt"),
                                             str(tmp_path / "uni"))
+
+
+class TestLoadFlags:
+    def test_load_module_only_and_skip_optimizer_states(self, tmp_path):
+        """Reference load_checkpoint flags (`runtime/engine.py:2653`):
+        load_module_only restores just the weights; load_optimizer_states=False
+        restores weights+counters but keeps the current optimizer moments."""
+        from deepspeed_tpu.comm import mesh as mesh_mod
+        mesh_mod.clear_mesh()
+        eng = _make_engine(tmp_path, stage=2)
+        rng = np.random.default_rng(0)
+        for _ in range(2):
+            eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path / "ckpt"), tag="t0")
+        w_ckpt = np.asarray(jax.device_get(eng.state.params["w"]))
+        m_ckpt = np.asarray(jax.device_get(
+            jax.tree_util.tree_leaves(eng.state.opt_state)[1]))  # adam mu leaf
+
+        for _ in range(3):  # diverge past the checkpoint
+            eng.train_batch(_batch(rng))
+        m_later = np.asarray(jax.device_get(
+            jax.tree_util.tree_leaves(eng.state.opt_state)[1]))
+        step_later = int(eng.state.step)
+        assert not np.allclose(m_later, m_ckpt)
+
+        # module only: weights back to t0, optimizer moments and step kept
+        eng.load_checkpoint(str(tmp_path / "ckpt"), tag="t0",
+                            load_module_only=True)
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(eng.state.params["w"])), w_ckpt,
+            rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(jax.device_get(
+            jax.tree_util.tree_leaves(eng.state.opt_state)[1])), m_later)
+        assert int(eng.state.step) == step_later
+
+        # skip optimizer states: weights + step restored, moments kept
+        for _ in range(2):
+            eng.train_batch(_batch(rng))
+        m_now = np.asarray(jax.device_get(
+            jax.tree_util.tree_leaves(eng.state.opt_state)[1]))
+        eng.load_checkpoint(str(tmp_path / "ckpt"), tag="t0",
+                            load_optimizer_states=False)
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(eng.state.params["w"])), w_ckpt,
+            rtol=1e-6)
+        assert int(eng.state.step) == 2          # checkpoint's counter
+        np.testing.assert_allclose(np.asarray(jax.device_get(
+            jax.tree_util.tree_leaves(eng.state.opt_state)[1])), m_now)
+
+        # full load restores the moments too
+        eng.load_checkpoint(str(tmp_path / "ckpt"), tag="t0")
+        np.testing.assert_allclose(np.asarray(jax.device_get(
+            jax.tree_util.tree_leaves(eng.state.opt_state)[1])), m_ckpt,
+            rtol=1e-6)
