@@ -1,0 +1,183 @@
+// Native prefetching token-dataset loader.
+//
+// The role torch's DataLoader worker processes play in the reference
+// (`runtime/dataloader.py` wraps torch.utils.data.DataLoader): overlap host
+// batch assembly with device compute. Here: the token corpus is mmap'd, a
+// thread pool assembles [batch, seq_len] int32 batches into a ring of
+// buffers ahead of the consumer, and delivery is IN BATCH-INDEX ORDER with
+// deterministic per-index sampling — so runs are reproducible regardless of
+// worker count (the reference needs a seeded sampler + single worker for
+// that).
+//
+// Exposed via ctypes (deepspeed_tpu/runtime/native_dataloader.py).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <map>
+#include <mutex>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97f4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+struct Buffer {
+  std::vector<int32_t> data;
+  int64_t index = -1;
+};
+
+struct Loader {
+  int fd = -1;
+  const uint8_t* map = nullptr;
+  size_t file_bytes = 0;
+  int token_bytes = 4;  // 2 (uint16) or 4 (int32) on disk; output is int32
+  int64_t n_tokens = 0;
+  int64_t seq_len = 0;
+  int64_t batch = 0;
+  uint64_t seed = 0;
+
+  std::atomic<int64_t> claim{0};    // next batch index a worker will produce
+  std::mutex mu;
+  std::condition_variable cv_ready; // consumer waits for its index
+  std::condition_variable cv_free;  // workers wait for a free buffer
+  std::map<int64_t, Buffer*> ready;
+  std::vector<Buffer*> free_bufs;
+  std::vector<std::unique_ptr<Buffer>> storage;
+  std::vector<std::thread> workers;
+  int64_t consumed = 0;             // next index the consumer takes
+  bool stop = false;
+
+  int32_t token_at(int64_t i) const {
+    if (token_bytes == 2) {
+      uint16_t t;
+      std::memcpy(&t, map + 2 * i, 2);
+      return (int32_t)t;
+    }
+    int32_t t;
+    std::memcpy(&t, map + 4 * i, 4);
+    return t;
+  }
+
+  void fill(Buffer* b, int64_t index) {
+    const int64_t span = n_tokens - seq_len;
+    for (int64_t r = 0; r < batch; ++r) {
+      uint64_t h = splitmix64(seed ^ (uint64_t)(index * batch + r));
+      int64_t start = (int64_t)(h % (uint64_t)span);
+      int32_t* row = b->data.data() + r * seq_len;
+      for (int64_t t = 0; t < seq_len; ++t) row[t] = token_at(start + t);
+    }
+    b->index = index;
+  }
+
+  void worker() {
+    for (;;) {
+      Buffer* b = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_free.wait(lk, [&] { return stop || !free_bufs.empty(); });
+        if (stop) return;
+        b = free_bufs.back();
+        free_bufs.pop_back();
+      }
+      int64_t index = claim.fetch_add(1);
+      fill(b, index);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        ready[index] = b;
+      }
+      cv_ready.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dstpu_dl_create(const char* path, int64_t seq_len, int64_t batch,
+                      int n_prefetch, int n_threads, uint64_t seed,
+                      int token_bytes) {
+  if (seq_len <= 0 || batch <= 0 || (token_bytes != 2 && token_bytes != 4))
+    return nullptr;
+  auto* L = new Loader();
+  L->fd = ::open(path, O_RDONLY);
+  if (L->fd < 0) { delete L; return nullptr; }
+  struct stat st;
+  if (fstat(L->fd, &st) != 0) { ::close(L->fd); delete L; return nullptr; }
+  L->file_bytes = (size_t)st.st_size;
+  L->token_bytes = token_bytes;
+  L->n_tokens = (int64_t)(L->file_bytes / token_bytes);
+  if (L->n_tokens <= seq_len) { ::close(L->fd); delete L; return nullptr; }
+  L->map = (const uint8_t*)mmap(nullptr, L->file_bytes, PROT_READ, MAP_SHARED,
+                                L->fd, 0);
+  if (L->map == MAP_FAILED) { ::close(L->fd); delete L; return nullptr; }
+  madvise((void*)L->map, L->file_bytes, MADV_RANDOM);
+  L->seq_len = seq_len;
+  L->batch = batch;
+  L->seed = seed;
+  if (n_prefetch < 2) n_prefetch = 2;
+  if (n_threads < 1) n_threads = 1;
+  for (int i = 0; i < n_prefetch; ++i) {
+    L->storage.emplace_back(new Buffer());
+    L->storage.back()->data.resize((size_t)batch * seq_len);
+    L->free_bufs.push_back(L->storage.back().get());
+  }
+  for (int i = 0; i < n_threads; ++i)
+    L->workers.emplace_back(&Loader::worker, L);
+  return L;
+}
+
+int64_t dstpu_dl_num_tokens(void* handle) {
+  return handle ? ((Loader*)handle)->n_tokens : -1;
+}
+
+// Blocks until the next in-order batch is assembled, copies it into `out`
+// ([batch, seq_len] int32). Returns the batch index (>= 0).
+int64_t dstpu_dl_next(void* handle, int32_t* out) {
+  auto* L = (Loader*)handle;
+  if (!L) return -1;
+  Buffer* b = nullptr;
+  int64_t want;
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    want = L->consumed++;
+    L->cv_ready.wait(lk, [&] { return L->ready.count(want) != 0; });
+    b = L->ready[want];
+    L->ready.erase(want);
+  }
+  std::memcpy(out, b->data.data(), sizeof(int32_t) * L->batch * L->seq_len);
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->free_bufs.push_back(b);
+  }
+  L->cv_free.notify_one();
+  return want;
+}
+
+void dstpu_dl_destroy(void* handle) {
+  auto* L = (Loader*)handle;
+  if (!L) return;
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->stop = true;
+  }
+  L->cv_free.notify_all();
+  for (auto& t : L->workers) t.join();
+  if (L->map && L->map != MAP_FAILED) munmap((void*)L->map, L->file_bytes);
+  if (L->fd >= 0) ::close(L->fd);
+  delete L;
+}
+
+}  // extern "C"

@@ -110,3 +110,22 @@ ALL_OPS = {b.NAME: b for b in (AsyncIOBuilder(), CPUAdamBuilder())}
 
 def get_op_builder(name):
     return ALL_OPS[name]
+
+
+class DataLoaderBuilder(OpBuilder):
+    """Native prefetching token-dataset loader (the torch-DataLoader-worker
+    role of the reference's `runtime/dataloader.py`)."""
+
+    NAME = "dstpu_dataloader"
+    SOURCES = ("dataloader/dstpu_dataloader.cpp",)
+
+    def annotate(self, lib):
+        lib.dstpu_dl_create.restype = ctypes.c_void_p
+        lib.dstpu_dl_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int]
+        lib.dstpu_dl_num_tokens.restype = ctypes.c_int64
+        lib.dstpu_dl_num_tokens.argtypes = [ctypes.c_void_p]
+        lib.dstpu_dl_next.restype = ctypes.c_int64
+        lib.dstpu_dl_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.dstpu_dl_destroy.argtypes = [ctypes.c_void_p]

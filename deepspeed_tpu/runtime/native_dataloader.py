@@ -1,0 +1,75 @@
+"""Native prefetching token dataset — the reference DataLoader-worker role.
+
+Reference: `runtime/dataloader.py` (`DeepSpeedDataLoader`) delegates host-side
+batch assembly to torch DataLoader worker PROCESSES. Here the corpus is a flat
+token file (int32 or uint16), mmap'd by a C++ thread pool
+(`csrc/dataloader/dstpu_dataloader.cpp`) that assembles `[batch, seq_len]`
+int32 batches into a prefetch ring ahead of the consumer. Delivery is in
+batch-index order with per-index deterministic sampling, so runs reproduce
+regardless of worker count — no seeded-sampler/single-worker dance.
+
+Use standalone or hand the iterator to `engine.train_batch(data_iter=...)` /
+`deepspeed_io`:
+
+    ds = NativeTokenDataset("corpus.bin", seq_len=513, batch_size=96, seed=0)
+    for step in range(n):
+        loss = engine.train_batch(next(ds))
+"""
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import DataLoaderBuilder
+
+
+def write_token_file(path, tokens, dtype=np.int32):
+    """Write a flat token array as the loader's on-disk format."""
+    arr = np.asarray(tokens, dtype)
+    assert arr.dtype in (np.dtype(np.int32), np.dtype(np.uint16)), arr.dtype
+    arr.tofile(path)
+    return path
+
+
+class NativeTokenDataset:
+    """Infinite iterator of {"tokens": int32 [batch, seq_len]} batches.
+
+    `seq_len` should be model_seq + 1 when the loss derives labels by
+    shifting (`gpt_loss` with a bare "tokens" batch does exactly that).
+    """
+
+    def __init__(self, path, seq_len, batch_size, n_prefetch=4, n_threads=2,
+                 seed=0, token_bytes=4):
+        self.lib = DataLoaderBuilder().load()
+        self.seq_len = int(seq_len)
+        self.batch_size = int(batch_size)
+        self.handle = self.lib.dstpu_dl_create(
+            str(path).encode(), self.seq_len, self.batch_size,
+            int(n_prefetch), int(n_threads), int(seed) & (2**64 - 1),
+            int(token_bytes))
+        if not self.handle:
+            raise IOError(f"dstpu_dl_create failed for {path!r} "
+                          f"(missing file or corpus shorter than seq_len?)")
+
+    @property
+    def num_tokens(self):
+        return int(self.lib.dstpu_dl_num_tokens(self.handle))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        out = np.empty((self.batch_size, self.seq_len), np.int32)
+        idx = self.lib.dstpu_dl_next(self.handle, out.ctypes.data)
+        if idx < 0:
+            raise IOError("dstpu_dl_next failed")
+        return {"tokens": out}
+
+    def close(self):
+        if getattr(self, "handle", None):
+            self.lib.dstpu_dl_destroy(self.handle)
+            self.handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

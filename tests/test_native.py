@@ -159,3 +159,71 @@ def test_aio_async_submit_overlaps_host_compute(tmp_path, native_available):
     print(f"\naio overlap: submit {t_submit*1e3:.2f}ms, wait {t_wait*1e3:.2f}ms, "
           f"batched {t_total*1e3:.2f}ms vs serial {t_serial*1e3:.2f}ms "
           f"({t_serial/max(t_total,1e-9):.2f}x)")
+
+
+def test_native_dataloader_deterministic_and_correct(tmp_path, native_available):
+    """C++ prefetching loader: windows come from the corpus, delivery is
+    batch-index-ordered and deterministic across worker counts."""
+    import time
+    from deepspeed_tpu.runtime.native_dataloader import (NativeTokenDataset,
+                                                         write_token_file)
+    rng = np.random.default_rng(0)
+    corpus = rng.integers(0, 1000, (50_000,)).astype(np.int32)
+    path = write_token_file(tmp_path / "corpus.bin", corpus)
+
+    ds = NativeTokenDataset(path, seq_len=65, batch_size=4, n_threads=2, seed=7)
+    assert ds.num_tokens == 50_000
+    batches = [next(ds)["tokens"] for _ in range(5)]
+    ds.close()
+    for b in batches:
+        assert b.shape == (4, 65) and b.dtype == np.int32
+        # every row is a contiguous window of the corpus
+        for row in b:
+            starts = np.flatnonzero(corpus[:-65 + 1] == row[0])
+            assert any((corpus[s:s + 65] == row).all() for s in starts)
+
+    # determinism across a different worker count
+    ds2 = NativeTokenDataset(path, seq_len=65, batch_size=4, n_threads=4, seed=7)
+    for b in batches:
+        np.testing.assert_array_equal(next(ds2)["tokens"], b)
+    ds2.close()
+
+    # different seed -> different stream
+    ds3 = NativeTokenDataset(path, seq_len=65, batch_size=4, seed=8)
+    assert not np.array_equal(next(ds3)["tokens"], batches[0])
+    ds3.close()
+
+
+def test_native_dataloader_feeds_engine(tmp_path, native_available):
+    """End-to-end: loader batches drive Engine.train_batch."""
+    from deepspeed_tpu.runtime.native_dataloader import (NativeTokenDataset,
+                                                         write_token_file)
+    from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_model
+    rng = np.random.default_rng(1)
+    path = write_token_file(tmp_path / "c.bin",
+                            rng.integers(0, 128, (20_000,)).astype(np.int32))
+    cfg = GPTConfig(n_layer=2, n_head=2, d_model=32, max_seq_len=32,
+                    vocab_size=128, dtype=jnp.float32, remat=False)
+    engine, *_ = deepspeed_tpu.initialize(model=make_gpt_model(cfg=cfg), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "mesh": {"data": 8}, "steps_per_print": 10**9})
+    ds = NativeTokenDataset(path, seq_len=17, batch_size=engine.train_batch_size())
+    losses = [float(engine.train_batch(data_iter=ds)) for _ in range(3)]
+    ds.close()
+    assert np.isfinite(losses).all()
+
+
+def test_native_dataloader_uint16_tokens(tmp_path, native_available):
+    from deepspeed_tpu.runtime.native_dataloader import (NativeTokenDataset,
+                                                         write_token_file)
+    corpus = np.arange(5000, dtype=np.uint16) % 900
+    path = write_token_file(tmp_path / "u16.bin", corpus, dtype=np.uint16)
+    ds = NativeTokenDataset(path, seq_len=9, batch_size=2, token_bytes=2)
+    b = next(ds)["tokens"]
+    ds.close()
+    assert b.dtype == np.int32 and b.max() < 900
+    # rows are consecutive mod-900 runs from the arange corpus
+    for row in b:
+        diffs = np.diff(row) % 900
+        assert ((diffs == 1) | (diffs == 1 - 900)).all()
