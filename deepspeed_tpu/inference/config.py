@@ -40,3 +40,28 @@ class TpuInferenceConfig(ConfigModel):
     moe: Dict[str, Any] = field(default_factory=dict)
     # kv cache
     kv_cache_dtype: str = "bfloat16"
+
+    _LEGACY_DTYPES = {"fp16": "float16", "half": "float16", "bf16": "bfloat16",
+                      "fp32": "float32", "float": "float32",
+                      "torch.float16": "float16", "torch.bfloat16": "bfloat16",
+                      "torch.float32": "float32"}
+
+    @classmethod
+    def from_dict(cls, d, path=""):
+        """Accept the reference's legacy kwargs (`inference/config.py`
+        validators): `mp_size` is the deprecated tensor_parallel degree —
+        silently ignoring it would serve tp=1 — plus torch-style dtype
+        spellings and the retired `replace_method` knob."""
+        d = dict(d or {})
+        if "mp_size" in d:
+            tp = d.pop("mp_size")
+            tpc = d.setdefault("tensor_parallel", {})
+            if isinstance(tpc, dict):
+                tpc.setdefault("tp_size", int(tp))
+        d.pop("replace_method", None)  # deprecated no-op in the reference too
+        dt = d.get("dtype")
+        if dt is not None and not isinstance(dt, str):
+            dt = str(dt)
+        if isinstance(dt, str):
+            d["dtype"] = cls._LEGACY_DTYPES.get(dt, dt)
+        return super().from_dict(d, path=path)

@@ -214,3 +214,19 @@ def test_generate_eos_stop_mask():
             assert (out[r, cut + 1:] == pad).all()
         else:
             np.testing.assert_array_equal(out[r], free[r])
+
+
+def test_inference_config_legacy_kwargs():
+    """Reference init_inference kwargs: mp_size (deprecated TP degree), torch
+    dtype spellings, replace_method — must not be silently dropped."""
+    cfg = TpuInferenceConfig.from_dict({"mp_size": 4, "dtype": "fp16",
+                                        "replace_method": "auto"})
+    assert cfg.tensor_parallel.tp_size == 4
+    assert cfg.dtype == "float16"
+    cfg2 = TpuInferenceConfig.from_dict({"dtype": "torch.bfloat16",
+                                         "tensor_parallel": {"tp_size": 2}})
+    assert cfg2.dtype == "bfloat16" and cfg2.tensor_parallel.tp_size == 2
+    # explicit tensor_parallel wins over mp_size
+    cfg3 = TpuInferenceConfig.from_dict({"mp_size": 4,
+                                         "tensor_parallel": {"tp_size": 2}})
+    assert cfg3.tensor_parallel.tp_size == 2
