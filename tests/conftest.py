@@ -12,7 +12,8 @@ import os
 # Real-TPU kernel lane: DSTPU_RUN_TPU_TESTS=1 keeps the hardware backend so
 # @pytest.mark.tpu tests compile (not interpret) the Pallas kernels on the
 # chip; everything else is skipped in that mode. Usage:
-#     DSTPU_RUN_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+#     DSTPU_RUN_TPU_TESTS=1 python -m pytest tests/ -m tpu -q -n 0
+# (-n 0 disables the xdist default: one process must own the chip)
 RUN_TPU_LANE = os.environ.get("DSTPU_RUN_TPU_TESTS") == "1"
 
 if not RUN_TPU_LANE:

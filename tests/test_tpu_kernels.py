@@ -2,7 +2,7 @@
 chip and check numerics against the XLA reference, plus a long-sequence
 timing assertion that measures the kernels' reason to exist.
 
-Run: DSTPU_RUN_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+Run: DSTPU_RUN_TPU_TESTS=1 python -m pytest tests/ -m tpu -q -n 0
 
 The CPU suite routes all Pallas code through interpret mode
 (`_use_interpret()`), so a regression in the Mosaic lowering would pass CI
