@@ -197,3 +197,64 @@ class TestCliSuite:
             mod_name, func = target.split(":")
             mod = importlib.import_module(mod_name)
             assert callable(getattr(mod, func))
+
+
+class TestTwoProcessDistributed:
+    def test_launcher_spawns_two_process_psum(self, tmp_path):
+        """End-to-end multi-process path: the node-local launcher spawns two
+        workers, each calls init_distributed (coordinator env from the
+        launcher), builds a 2-device global mesh across processes, and a
+        jitted cross-process reduction returns the right value — the real
+        multi-host wire, minus the second host."""
+        import textwrap
+        worker = tmp_path / "worker.py"
+        import os as _os
+        repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+        worker.write_text(textwrap.dedent(f"""
+            import sys, os, re
+            sys.path.insert(0, {repo!r})
+            # one device per process: strip the CPU-harness 8-device flag the
+            # pytest parent exported
+            _flags = re.sub(r"--xla_force_host_platform_device_count=\\d+", "",
+                            os.environ.get("XLA_FLAGS", "")).strip()
+            if _flags:
+                os.environ["XLA_FLAGS"] = _flags
+            else:
+                os.environ.pop("XLA_FLAGS", None)
+        """) + textwrap.dedent("""
+            import jax
+            jax.config.update("jax_platforms", "cpu")  # sitecustomize may pin hw
+            import numpy as np
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            import deepspeed_tpu
+            from deepspeed_tpu.comm import mesh as mesh_mod
+            from deepspeed_tpu.config.core import MeshConfig
+
+            deepspeed_tpu.init_distributed()           # RANK/WORLD_SIZE/MASTER_* env
+            assert jax.process_count() == 2, jax.process_count()
+            assert jax.device_count() == 2, jax.device_count()
+            mesh_mod.init_mesh(MeshConfig(data=2))
+            mesh = mesh_mod.get_mesh()
+            sh = NamedSharding(mesh, P(("data", "zero")))
+            # each process contributes its rank+1 as its local shard
+            x = jax.make_array_from_callback(
+                (2,), sh, lambda idx: np.full((1,), jax.process_index() + 1.0))
+            total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(x)
+            assert float(total) == 3.0, float(total)   # 1 + 2 across processes
+            print("PSUM_OK", float(total))
+        """))
+        from deepspeed_tpu.launcher import launch as launch_mod
+        from deepspeed_tpu.launcher.runner import encode_world_info
+        import os
+        env_backup = dict(os.environ)
+        try:
+            rc = launch_mod.main([
+                "--world_info", encode_world_info({"localhost": [0, 1]}),
+                "--node_rank", "0", "--procs_per_node", "2",
+                "--master_addr", "127.0.0.1", "--master_port", "29517",
+                str(worker)])
+        finally:
+            os.environ.clear()
+            os.environ.update(env_backup)
+        assert rc == 0
