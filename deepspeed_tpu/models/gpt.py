@@ -482,10 +482,21 @@ def _residual_mlp(x, attn_out, p, cfg: GPTConfig, constrain=True, mlp_fn=None):
 
 
 def _embed(params, tokens, positions, cfg: GPTConfig):
-    """Token embedding + (absolute) position embedding + BLOOM emb LayerNorm."""
-    x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
+    """Token embedding + (absolute) position embedding + BLOOM emb LayerNorm.
+
+    The tables are constrained to their gathered (TP-only) layout before the
+    lookup: under ZeRO-3 the policy shards their feature dim over the zero
+    domain, and XLA cannot reshard a gather whose operand is feature-sharded
+    without a full replicate-then-partition of the output (SPMD partitioner
+    warning). Constraining the *table* instead makes the all-gather explicit —
+    exactly ZeRO-3's gather-before-use (reference
+    `zero/partitioned_param_coordinator.py:256`) — after which the output
+    transition to batch/seq sharding is a cheap slice."""
+    wte = shard_constraint(params["wte"], TENSOR_AXIS, None)
+    x = jnp.take(wte, tokens, axis=0).astype(cfg.dtype)
     if not cfg.use_rotary and not cfg.use_alibi:
-        x = x + jnp.take(params["wpe"], positions, axis=0).astype(cfg.dtype)
+        wpe = shard_constraint(params["wpe"], None, None)
+        x = x + jnp.take(wpe, positions, axis=0).astype(cfg.dtype)
     if cfg.use_emb_ln:  # BLOOM word-embedding LayerNorm
         x = _norm(x, params["emb_ln_scale"], params.get("emb_ln_bias"),
                   use_rms=False, eps=cfg.norm_eps)

@@ -105,13 +105,6 @@ class CPUAdamBuilder(OpBuilder):
                                            ctypes.c_int64]
 
 
-ALL_OPS = {b.NAME: b for b in (AsyncIOBuilder(), CPUAdamBuilder())}
-
-
-def get_op_builder(name):
-    return ALL_OPS[name]
-
-
 class DataLoaderBuilder(OpBuilder):
     """Native prefetching token-dataset loader (the torch-DataLoader-worker
     role of the reference's `runtime/dataloader.py`)."""
@@ -129,3 +122,11 @@ class DataLoaderBuilder(OpBuilder):
         lib.dstpu_dl_next.restype = ctypes.c_int64
         lib.dstpu_dl_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
         lib.dstpu_dl_destroy.argtypes = [ctypes.c_void_p]
+
+
+ALL_OPS = {b.NAME: b for b in (AsyncIOBuilder(), CPUAdamBuilder(),
+                               DataLoaderBuilder())}
+
+
+def get_op_builder(name):
+    return ALL_OPS[name]

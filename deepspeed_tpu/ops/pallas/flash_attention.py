@@ -282,8 +282,16 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, block_q=None,
     B, H, T, D = q.shape
     if block_q is None:
         block_q = 512 if T >= 2048 else DEFAULT_BLOCK_Q
+        # the scaled default may not divide T (e.g. T=2176 is a 128-multiple
+        # but not a 512-multiple): shrink to the largest power-of-two
+        # divisor >= the 128 lane width. Explicit block sizes are honored
+        # as-is and still assert below.
+        while block_q > DEFAULT_BLOCK_Q and T % block_q != 0:
+            block_q //= 2
     if block_k is None:
         block_k = 1024 if T >= 2048 else DEFAULT_BLOCK_K
+        while block_k > DEFAULT_BLOCK_K and T % block_k != 0:
+            block_k //= 2
     block_q = min(block_q, T)
     block_k = min(block_k, T)
     assert T % block_q == 0 and T % block_k == 0, \

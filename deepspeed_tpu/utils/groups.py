@@ -41,8 +41,27 @@ def _get_data_parallel_world_size():
 
 
 def _get_data_parallel_rank():
+    """Rank within the data-parallel domain: the mesh coordinates of this
+    process's first addressable device along ZERO_AXES, flattened in axis
+    order. Falls back to the process index when no mesh exists (then the
+    process IS the data-parallel unit)."""
     import jax
-    return jax.process_index()
+    if not mesh_mod.has_mesh():
+        return jax.process_index()
+    mesh = mesh_mod.get_mesh()
+    local = [d for d in mesh.devices.flat if d.process_index == jax.process_index()]
+    if not local:
+        return jax.process_index()
+    device = min(local, key=lambda d: d.id)
+    # coordinates of `device` in the mesh array
+    import numpy as np
+    idx = np.argwhere(mesh.devices == device)[0]
+    coord = dict(zip(mesh.axis_names, idx))
+    rank = 0
+    for ax in mesh_mod.ZERO_AXES:
+        if ax in coord:
+            rank = rank * mesh.shape[ax] + int(coord[ax])
+    return rank
 
 
 def _get_model_parallel_group():

@@ -71,28 +71,44 @@ def GatheredParameters(params, modifier_rank=None, fwd_module=None, enabled=True
     modification.
 
     On TPU a sharded `jax.Array` is logically whole, so "gathering" for READS
-    is free — this context yields host numpy copies; on exit, any leaves the
-    caller REPLACED in the yielded dict/list are placed back with each
-    original leaf's sharding (the re-partition step of the reference's exit).
-    `modifier_rank` is accepted for signature parity (single-program SPMD has
-    no per-rank modification)."""
+    is free — this context yields host numpy copies. Reference semantics for
+    writes (`partition_parameters.py:2258`): with ``modifier_rank=None`` the
+    gather is read-only and modifications are DISCARDED on exit; with a rank
+    set, modifications persist — here every yielded leaf (mutated in place or
+    replaced) is placed back with its original sharding on exit (the
+    re-partition step of the reference's exit). Which rank is irrelevant
+    under single-program SPMD. Writeback requires a dict or list container
+    (in-place update of the caller's reference); other pytrees raise."""
     if not enabled:
         yield params
         return
+    if modifier_rank is not None and not isinstance(params, (dict, list)):
+        raise TypeError(
+            "GatheredParameters(modifier_rank=...): writeback needs a dict "
+            "or list container (in-place update of the caller's reference); "
+            f"got {type(params).__name__}. Re-partition manually with "
+            "jax.device_put(leaf, old.sharding) instead.")
     leaves, treedef = jax.tree_util.tree_flatten(params)
     host = [jax.device_get(l) for l in leaves]
+    if modifier_rank is not None:
+        # device_get views are read-only; writers get mutable copies
+        import numpy as _np
+        host = [_np.array(h) for h in host]
     out = jax.tree_util.tree_unflatten(treedef, list(host))
     yield out
+    if modifier_rank is None:
+        return  # read-only gather: edits discarded (reference parity; the
+        #         read-only device_get views make accidental writes raise)
+    # device_put every leaf: catches both replaced leaves and in-place numpy
+    # mutation of the gathered copies (this path is host-side surgery, never
+    # hot — upload cost is irrelevant next to silently dropping an edit).
     new_leaves = jax.tree_util.tree_leaves(out)
     for i, (old, new) in enumerate(zip(leaves, new_leaves)):
-        if new is not host[i]:  # caller replaced this leaf: re-partition
-            leaves[i] = jax.device_put(new, old.sharding)
-    # mutate the original containers in place where possible so the caller's
-    # reference sees the re-partitioned values (reference semantics)
+        leaves[i] = jax.device_put(jax.numpy.asarray(new, old.dtype), old.sharding)
     updated = jax.tree_util.tree_unflatten(treedef, leaves)
     if isinstance(params, dict):
         params.update(updated)
-    elif isinstance(params, list):
+    else:
         params[:] = updated
 
 
