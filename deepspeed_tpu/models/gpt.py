@@ -354,9 +354,15 @@ def _ckpt_name(x, name):
 def resolve_remat_policy(name):
     """remat_policy string → jax.checkpoint policy. "save_matmuls" keeps every
     tagged matmul output (the MXU-heavy tensors) so the backward recomputes
-    only norms/softmax/elementwise — the cheap fraction of a block."""
+    only norms/softmax/elementwise — the cheap fraction of a block.
+    "save_matmuls_probs" additionally keeps the [B,H,T,S] softmax probs, so
+    the backward skips the attention-score recompute entirely — the fastest
+    policy when HBM has room for ~B*H*T*S*2 bytes per layer (bf16 softmax)."""
     if name == "save_matmuls":
         return jax.checkpoint_policies.save_only_these_names(*SAVE_MATMULS_NAMES)
+    if name == "save_matmuls_probs":
+        return jax.checkpoint_policies.save_only_these_names(
+            *SAVE_MATMULS_NAMES, "attn_probs")
     return getattr(jax.checkpoint_policies, name, None)
 
 
@@ -399,6 +405,7 @@ def _attention(q, k, v, causal_mask, cfg, attn_fn=None, bias=None):
         e = jnp.exp((logits - m).astype(jnp.float32)).astype(q.dtype)
         denom = jnp.sum(e, axis=-1, keepdims=True, dtype=jnp.float32)
         probs = (e.astype(jnp.float32) / denom).astype(q.dtype)
+    probs = _ckpt_name(probs, "attn_probs")
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
     return out.reshape(B, T, H, hd)
 

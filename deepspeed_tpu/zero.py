@@ -14,8 +14,9 @@ most of this namespace collapses into three facts —
     `utils/init_on_device.py`); `Init` here is the reference-shaped wrapper;
   * a sharded `jax.Array` is LOGICALLY WHOLE: reading it (device_get,
     indexing) is already the "gather", so `GatheredParameters` is a thin
-    context that yields host copies and writes modifications back with the
-    original shardings;
+    context that yields host copies; with `modifier_rank` set it writes
+    modifications back with the original shardings (without it, reads are
+    read-only and edits are discarded — reference semantics);
   * hook-registration (`register_external_parameter`) has no SPMD equivalent
     to register — XLA sees every use of every parameter; kept as a no-op for
     call-site compatibility.
@@ -99,12 +100,18 @@ def GatheredParameters(params, modifier_rank=None, fwd_module=None, enabled=True
     if modifier_rank is None:
         return  # read-only gather: edits discarded (reference parity; the
         #         read-only device_get views make accidental writes raise)
-    # device_put every leaf: catches both replaced leaves and in-place numpy
-    # mutation of the gathered copies (this path is host-side surgery, never
-    # hot — upload cost is irrelevant next to silently dropping an edit).
+    # device_put every jax.Array leaf: catches both replaced leaves and
+    # in-place numpy mutation of the gathered copies (this path is host-side
+    # surgery, never hot — upload cost is irrelevant next to silently
+    # dropping an edit). Non-device leaves (plain numpy/scalars mixed into
+    # the tree) pass through by value.
     new_leaves = jax.tree_util.tree_leaves(out)
     for i, (old, new) in enumerate(zip(leaves, new_leaves)):
-        leaves[i] = jax.device_put(jax.numpy.asarray(new, old.dtype), old.sharding)
+        if hasattr(old, "sharding"):
+            leaves[i] = jax.device_put(jax.numpy.asarray(new, old.dtype),
+                                       old.sharding)
+        else:
+            leaves[i] = new
     updated = jax.tree_util.tree_unflatten(treedef, leaves)
     if isinstance(params, dict):
         params.update(updated)

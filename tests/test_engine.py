@@ -356,10 +356,18 @@ def test_zero_namespace_parity():
     sharding = NamedSharding(mesh, P(("data", "zero")))
     params = {"w": jax.device_put(jnp.arange(16.0), sharding),
               "b": jax.device_put(jnp.zeros(4), NamedSharding(mesh, P()))}
+    # modifier_rank=None: read-only, edits discarded (reference
+    # partition_parameters.py:2258 semantics)
     with deepspeed_tpu.zero.GatheredParameters(params) as gathered:
         np.testing.assert_array_equal(np.asarray(gathered["w"]),
                                       np.arange(16.0))
-        gathered["w"] = np.arange(16.0) * 2  # host-side modification
+        gathered["w"] = np.arange(16.0) * 3
+    np.testing.assert_array_equal(np.asarray(params["w"]), np.arange(16.0))
+    # modifier_rank set: replacement AND in-place mutation both persist,
+    # re-partitioned to the original sharding
+    with deepspeed_tpu.zero.GatheredParameters(params, modifier_rank=0) as gathered:
+        gathered["w"] = np.arange(16.0) * 2      # replacement
+        gathered["b"][:] = 1.0                   # in-place mutation
     np.testing.assert_array_equal(np.asarray(params["w"]), np.arange(16.0) * 2)
     assert params["w"].sharding == sharding      # re-partitioned, not replicated
-    np.testing.assert_array_equal(np.asarray(params["b"]), np.zeros(4))
+    np.testing.assert_array_equal(np.asarray(params["b"]), np.ones(4))
