@@ -39,8 +39,10 @@ struct Request {
 
 class AioHandle {
   public:
-    AioHandle(int num_threads, int block_size)
-        : block_size_(block_size > 0 ? block_size : (1 << 20)), stop_(false),
+    AioHandle(int num_threads, int block_size, bool use_odirect = false,
+              bool fsync_writes = false)
+        : block_size_(block_size > 0 ? block_size : (1 << 20)),
+          use_odirect_(use_odirect), fsync_writes_(fsync_writes), stop_(false),
           next_id_(1), completed_(0), submitted_(0), errors_(0) {
         if (num_threads <= 0) num_threads = 4;
         for (int i = 0; i < num_threads; ++i) {
@@ -108,9 +110,25 @@ class AioHandle {
         }
     }
 
+    static bool aligned(const void* p, int64_t v, int64_t a) {
+        return (reinterpret_cast<uintptr_t>(p) % a) == 0 && (v % a) == 0;
+    }
+
     bool run(const Request& req) {
         int flags = req.is_write ? (O_WRONLY | O_CREAT) : O_RDONLY;
-        int fd = ::open(req.path.c_str(), flags, 0644);
+        // O_DIRECT (NVMe queue-depth path: no page cache, no write-back
+        // serialization) needs 4K-aligned buffer/offset/length — the Python
+        // swapper pads its staging buffers; unaligned requests and
+        // filesystems without O_DIRECT (tmpfs) fall back to buffered I/O.
+        const int64_t kAlign = 4096;
+        bool direct = use_odirect_ && aligned(req.buffer, req.num_bytes, kAlign)
+                      && (req.file_offset % kAlign) == 0;
+        int fd = -1;
+        if (direct) fd = ::open(req.path.c_str(), flags | O_DIRECT, 0644);
+        if (fd < 0) {
+            direct = false;
+            fd = ::open(req.path.c_str(), flags, 0644);
+        }
         if (fd < 0) return false;
         char* buf = static_cast<char*>(req.buffer);
         int64_t remaining = req.num_bytes;
@@ -118,9 +136,19 @@ class AioHandle {
         bool ok = true;
         while (remaining > 0) {
             int64_t chunk = remaining < block_size_ ? remaining : block_size_;
+            if (direct && (chunk % kAlign) != 0)  // keep every direct IO aligned
+                chunk = remaining;                 // (total is aligned; tail only
+                                                   //  happens if block_size_ isn't)
             ssize_t n = req.is_write ? ::pwrite(fd, buf, chunk, offset)
                                      : ::pread(fd, buf, chunk, offset);
             if (n <= 0) {
+                if (direct) {  // e.g. EINVAL mid-stream: retry buffered
+                    ::close(fd);
+                    direct = false;
+                    fd = ::open(req.path.c_str(), flags, 0644);
+                    if (fd < 0) return false;
+                    continue;
+                }
                 ok = false;
                 break;
             }
@@ -128,12 +156,27 @@ class AioHandle {
             offset += n;
             remaining -= n;
         }
-        if (req.is_write && ok) ::fsync(fd);
+        // No fsync by default: swap files are scratch state rewritten every
+        // step — durability costs NVMe queue depth for nothing. Opt in via
+        // create_ex for checkpoint-grade writers.
+        if (req.is_write && ok && fsync_writes_) ::fsync(fd);
+        if (req.is_write && ok) {
+            // grow-only pad to the alignment (cheap metadata op, both modes)
+            // so readers can always issue fully aligned (O_DIRECT-eligible)
+            // reads of ceil(nbytes/4K)*4K without hitting EOF
+            int64_t end = req.file_offset + req.num_bytes;
+            int64_t padded = (end + kAlign - 1) / kAlign * kAlign;
+            struct stat st;
+            if (::fstat(fd, &st) == 0 && st.st_size < padded)
+                ::ftruncate(fd, padded);
+        }
         ::close(fd);
         return ok;
     }
 
     int64_t block_size_;
+    bool use_odirect_;
+    bool fsync_writes_;
     bool stop_;
     int64_t next_id_;
     int64_t completed_;
@@ -151,6 +194,14 @@ extern "C" {
 
 void* dstpu_aio_create(int num_threads, int block_size) {
     return new AioHandle(num_threads, block_size);
+}
+
+// use_odirect: try O_DIRECT for 4K-aligned requests (falls back per-request);
+// fsync_writes: fsync after each completed write (off = scratch-swap mode).
+void* dstpu_aio_create_ex(int num_threads, int block_size, int use_odirect,
+                          int fsync_writes) {
+    return new AioHandle(num_threads, block_size, use_odirect != 0,
+                         fsync_writes != 0);
 }
 
 void dstpu_aio_destroy(void* handle) { delete static_cast<AioHandle*>(handle); }

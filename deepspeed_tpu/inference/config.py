@@ -40,6 +40,10 @@ class TpuInferenceConfig(ConfigModel):
     moe: Dict[str, Any] = field(default_factory=dict)
     # kv cache
     kv_cache_dtype: str = "bfloat16"
+    # ZeRO-Inference parameter spill (reference ds_config "zero_optimization"
+    # with stage-3 param offload): {"offload_param": {"device": "cpu"|"nvme",
+    # "nvme_path": ..., "lookahead": 1, "staging": 3}}
+    zero: Dict[str, Any] = field(default_factory=dict)
 
     _LEGACY_DTYPES = {"fp16": "float16", "half": "float16", "bf16": "bfloat16",
                       "fp32": "float32", "float": "float32",

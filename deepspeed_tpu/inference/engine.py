@@ -219,6 +219,20 @@ def init_inference(model=None, config=None, **kwargs):
         cfg = TpuInferenceConfig.from_dict(config)
     else:
         cfg = config
+    from deepspeed_tpu.inference.zero_inference import (LayeredModelSpec,
+                                                        ZeroInferenceEngine)
+    off = (cfg.zero or {}).get("offload_param")
+    if isinstance(model, LayeredModelSpec):
+        off = off or {}
+        return ZeroInferenceEngine(
+            model, cfg, offload_device=off.get("device", "cpu"),
+            nvme_path=off.get("nvme_path"),
+            lookahead=int(off.get("lookahead", 1)),
+            staging=int(off.get("staging", 3)))
+    if off:
+        raise ValueError(
+            "zero.offload_param (ZeRO-Inference) needs a LayeredModelSpec — "
+            "build one with models.gpt.make_gpt_layered_model")
     assert isinstance(model, DecodeModelSpec), \
         "init_inference expects a DecodeModelSpec (see deepspeed_tpu.models / inference.adapters)"
     return InferenceEngine(model, cfg)

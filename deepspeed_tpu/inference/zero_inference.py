@@ -1,0 +1,161 @@
+"""ZeRO-Inference: serve models whose weights exceed HBM.
+
+Reference: `docs/_posts/2022-09-10-zero-inference.md:35` ("15T-param model
+inference on 1 GPU") — ZeRO-3's `AsyncPartitionedParameterSwapper`
+(`runtime/swap_tensor/partitioned_param_swapper.py:36`) keeps the weights on
+host RAM or NVMe and fetches each layer's partition right before use.
+
+TPU-native design: the transformer stack is homogeneous, so ONE jitted
+per-layer function serves every layer with the layer's weights as arguments.
+`runtime/param_swap.LayerStreamer` double-buffers host->HBM uploads (and
+NVMe->host reads below them) while the current layer computes. HBM holds:
+resident leaves (embeddings/norms/head) + `lookahead+1` layer blocks + the
+KV cache — independent of model depth, which is the whole point.
+
+Cost model (same as the reference's): every forward streams all weights
+through HBM once, so throughput is bounded by the host link — batch as large
+as the KV cache allows to amortize. The reference makes the identical
+recommendation (zero-inference.md "efficiency" section).
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.inference.config import TpuInferenceConfig
+from deepspeed_tpu.runtime.param_swap import LayerParamStore, LayerStreamer
+from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.tree import tree_cast
+
+
+@dataclasses.dataclass
+class LayeredModelSpec:
+    """A decode model factored into per-layer pieces (see
+    `models/gpt.py::make_gpt_layered_model`)."""
+    embed_fn: Callable        # (resident, tokens[B,T], positions[B,T]) -> x[B,T,D]
+    layer_prefill_fn: Callable  # (layer_p, x, ck, cv, positions) -> (x, ck, cv)
+    layer_decode_fn: Callable   # (layer_p, x[B,1,D], ck, cv, pos[B]) -> (x, ck, cv)
+    final_fn: Callable        # (resident, x[B,T,D]) -> logits[B,T,V]
+    resident: Any             # always-in-HBM params (embed/norms/head)
+    blocks: Any               # stacked per-layer params (leading dim L)
+    num_layers: int
+    init_layer_cache: Callable  # (B, max_len, dtype) -> (ck, cv) one layer
+    eos_token_id: Optional[int] = None
+    name: str = "model"
+
+
+class ZeroInferenceEngine:
+    """Inference engine with the parameter spill tier.
+
+    `offload_device`: "cpu" (host RAM) or "nvme" (disk via the AIO library,
+    O_DIRECT). `lookahead`: how many layers of weights to keep in flight
+    ahead of compute (1 = classic double buffering)."""
+
+    def __init__(self, model: LayeredModelSpec, config: TpuInferenceConfig,
+                 offload_device="cpu", nvme_path=None, lookahead=1,
+                 staging=3):
+        self.model_spec = model
+        self.config = config
+        dtype = jnp.dtype(config.dtype) if config.dtype != "float" else jnp.float32
+        self.dtype = dtype
+
+        if not mesh_mod.has_mesh():
+            from deepspeed_tpu import comm
+            from deepspeed_tpu.config.core import MeshConfig
+            tp = config.tensor_parallel.tp_size
+            comm.init_distributed(mesh_config=MeshConfig(data=-1, tensor=tp))
+        self.mesh = mesh_mod.get_mesh()
+
+        self.resident = jax.device_put(tree_cast(model.resident, dtype))
+        self.store = LayerParamStore(
+            tree_cast(model.blocks, dtype), device=offload_device,
+            swap_folder=nvme_path, staging=staging)
+        self.streamer = LayerStreamer(self.store, lookahead=lookahead)
+        self.total_param_bytes = (
+            self.store.layer_bytes * self.store.num_layers)
+
+        # one compiled function per role, reused for every layer
+        self._embed = jax.jit(model.embed_fn)
+        self._layer_prefill = jax.jit(model.layer_prefill_fn,
+                                      donate_argnums=(1, 2, 3))
+        self._layer_decode = jax.jit(model.layer_decode_fn,
+                                     donate_argnums=(1, 2, 3))
+        self._final = jax.jit(model.final_fn)
+        log_dist(
+            f"zero-inference engine: {model.name} dtype={dtype} "
+            f"offload={offload_device} layers={self.store.num_layers} "
+            f"layer_mb={self.store.layer_bytes / 1e6:.1f} "
+            f"resident+{lookahead + 1} layers in HBM", ranks=[0])
+
+    # ---- forward ----
+
+    def _init_caches(self, B, max_len):
+        dt = jnp.dtype(self.config.kv_cache_dtype)
+        return [self.model_spec.init_layer_cache(B, max_len, dt)
+                for _ in range(self.store.num_layers)]
+
+    def forward(self, tokens, caches=None, max_len=None):
+        """Prefill: logits [B,T,V] + per-layer caches, streaming the weights."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        B, T = tokens.shape
+        if caches is None:
+            caches = self._init_caches(B, max_len or self.config.max_out_tokens)
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+        x = self._embed(self.resident, tokens, positions)
+        for i in range(self.store.num_layers):
+            p = self.streamer.layer(i)
+            x, ck, cv = self._layer_prefill(p, x, caches[i][0], caches[i][1],
+                                            positions)
+            caches[i] = (ck, cv)
+        logits = self._final(self.resident, x)
+        return logits, caches
+
+    __call__ = forward
+
+    def _decode_step(self, token, pos, caches):
+        x = self._embed(self.resident, token[:, None], pos[:, None])
+        for i in range(self.store.num_layers):
+            p = self.streamer.layer(i)
+            x, ck, cv = self._layer_decode(p, x, caches[i][0], caches[i][1], pos)
+            caches[i] = (ck, cv)
+        logits = self._final(self.resident, x)[:, 0]
+        return logits, caches
+
+    def generate(self, tokens, max_new_tokens=16, eos_token_id=None,
+                 pad_token_id=0):
+        """Greedy generation. Each emitted token streams the full weight set
+        through HBM — the ZeRO-Inference cost model; batch wide to amortize."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        B, T = tokens.shape
+        caches = self._init_caches(B, T + max_new_tokens)
+        logits, caches = self.forward(tokens, caches)
+        tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        pos = jnp.full((B,), T, jnp.int32)
+        eos = self.model_spec.eos_token_id if eos_token_id is None else eos_token_id
+        out = []
+        done = np.zeros((B,), bool)
+        for _ in range(max_new_tokens):
+            emitted = np.where(done, pad_token_id, np.asarray(tok))
+            out.append(emitted)
+            if eos is not None:
+                done |= emitted == eos
+                if done.all():
+                    break
+            logits, caches = self._decode_step(tok, pos, caches)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            pos = pos + 1
+        return np.stack(out, axis=1)
+
+    # ---- accounting (for tests and `see_memory_usage`-style reporting) ----
+
+    @property
+    def peak_param_hbm_bytes(self):
+        """High-water mark of device-resident spilled-parameter bytes."""
+        return self.streamer.peak_live_layers * self.store.layer_bytes
+
+    def release(self):
+        self.store.release()

@@ -488,6 +488,17 @@ def _residual_mlp(x, attn_out, p, cfg: GPTConfig, constrain=True, mlp_fn=None):
     return x + mlp_fn(h2)
 
 
+def _lm_head(params, x, cfg: GPTConfig):
+    """Final norm + (tied) LM head. x: [B, T, D] -> logits [B, T, V]."""
+    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm,
+              cfg.norm_eps)
+    head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
+    logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+    if "lm_head_bias" in params:  # GPT-J ties a bias to the LM head
+        logits = logits + params["lm_head_bias"].astype(logits.dtype)
+    return logits
+
+
 def _embed(params, tokens, positions, cfg: GPTConfig):
     """Token embedding + (absolute) position embedding + BLOOM emb LayerNorm.
 
@@ -547,12 +558,7 @@ def gpt_forward(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None):
             return block_fn(x, layer_params, flag), None
         x, _ = jax.lax.scan(scan_body, x, (params["blocks"], flags))
 
-    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm, cfg.norm_eps)
-    head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
-    logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
-    if "lm_head_bias" in params:  # GPT-J ties a bias to the LM head
-        logits = logits + params["lm_head_bias"].astype(logits.dtype)
-    return logits
+    return _lm_head(params, x, cfg)
 
 
 def gpt_loss(params, batch, rng, cfg: GPTConfig, attn_fn=None):
@@ -706,11 +712,7 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
         else:
             x, (ks, vs) = jax.lax.scan(
                 lambda c, inp: body(c, inp[0], flag=inp[1]), x, (layers, flags))
-        x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm, cfg.norm_eps)
-        head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
-        logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
-        if "lm_head_bias" in params:  # GPT-J ties a bias to the LM head
-            logits = logits + params["lm_head_bias"].astype(logits.dtype)
+        logits = _lm_head(params, x, cfg)
         cache = {"k": ks, "v": vs, "length": jnp.full((B,), T, jnp.int32)}
         return logits, cache
 
@@ -731,11 +733,7 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
         else:
             x, (ks, vs) = jax.lax.scan(
                 lambda c, inp: body(c, inp[0], flag=inp[1]), x, (layers, flags))
-        x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm, cfg.norm_eps)
-        head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
-        logits = jnp.einsum("bod,vd->bov", x, head.astype(x.dtype))[:, 0]
-        if "lm_head_bias" in params:
-            logits = logits + params["lm_head_bias"].astype(logits.dtype)
+        logits = _lm_head(params, x, cfg)[:, 0]
         cache = {"k": ks, "v": vs, "length": cache["length"] + 1}
         return logits, cache
 
@@ -744,3 +742,56 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
 
     return DecodeModelSpec(prefill_fn=prefill_fn, decode_fn=decode_fn,
                            init_cache=init_cache, params=params, name=name)
+
+
+# ----------------------------------------------------------------------
+# layered decode path — for the ZeRO-Inference parameter spill tier
+# ----------------------------------------------------------------------
+
+
+def make_gpt_layered_model(cfg: GPTConfig = None, name="gpt2-125m", params=None,
+                           seed=0):
+    """LayeredModelSpec: the decode model factored into per-layer functions so
+    the spill engine (`inference/zero_inference.py`) can stream one layer's
+    weights host->HBM at a time. Same math as `make_gpt_decode_model` — the
+    stacked `lax.scan` over resident blocks becomes a Python loop over
+    streamed blocks (reference capability:
+    `runtime/swap_tensor/partitioned_param_swapper.py:36`,
+    `docs/_posts/2022-09-10-zero-inference.md:35`)."""
+    from deepspeed_tpu.inference.zero_inference import LayeredModelSpec
+    cfg = cfg or GPT2_CONFIGS[name]
+    if params is None:
+        params = init_gpt_params(cfg, seed=seed)
+    assert _layer_local_flags(cfg) is None, \
+        "per-layer local/global flags not supported on the spill path yet"
+
+    resident = {k: v for k, v in params.items() if k != "blocks"}
+    blocks = params["blocks"]
+
+    def embed_fn(res, tokens, positions):
+        return _embed(res, tokens, positions, cfg)
+
+    def layer_prefill_fn(p, x, ck, cv, positions):
+        """x: [B,T,D]; ck/cv: [B,Hkv,M,hd] (this layer's cache slice)."""
+        T = x.shape[1]
+        attn_out, k, v = _attn_half(x, p, cfg, positions)
+        ck = ck.at[:, :, :T].set(jnp.moveaxis(k, 1, 2).astype(ck.dtype))
+        cv = cv.at[:, :, :T].set(jnp.moveaxis(v, 1, 2).astype(cv.dtype))
+        x = _residual_mlp(x, attn_out, p, cfg)
+        return x, ck, cv
+
+    def layer_decode_fn(p, x, ck, cv, pos):
+        return _block_decode(x, p, ck, cv, pos, cfg)
+
+    def final_fn(res, x):
+        return _lm_head(res, x, cfg)
+
+    def init_layer_cache(batch_size, max_len, dtype=jnp.bfloat16):
+        shape = (batch_size, cfg.n_kv_head, max_len, cfg.head_dim)
+        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+    return LayeredModelSpec(
+        embed_fn=embed_fn, layer_prefill_fn=layer_prefill_fn,
+        layer_decode_fn=layer_decode_fn, final_fn=final_fn,
+        resident=resident, blocks=blocks, num_layers=cfg.n_layer,
+        init_layer_cache=init_layer_cache, name=name)

@@ -72,6 +72,9 @@ class AsyncIOBuilder(OpBuilder):
     def annotate(self, lib):
         lib.dstpu_aio_create.restype = ctypes.c_void_p
         lib.dstpu_aio_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.dstpu_aio_create_ex.restype = ctypes.c_void_p
+        lib.dstpu_aio_create_ex.argtypes = [ctypes.c_int, ctypes.c_int,
+                                            ctypes.c_int, ctypes.c_int]
         lib.dstpu_aio_destroy.argtypes = [ctypes.c_void_p]
         for fn in (lib.dstpu_aio_pread, lib.dstpu_aio_pwrite):
             fn.restype = ctypes.c_int64

@@ -1,0 +1,103 @@
+"""ZeRO-Inference parameter spill tier (reference
+`runtime/swap_tensor/partitioned_param_swapper.py:36`,
+`docs/_posts/2022-09-10-zero-inference.md:35`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.config.core import MeshConfig
+from deepspeed_tpu.inference.engine import init_inference
+from deepspeed_tpu.models.gpt import (GPTConfig, make_gpt_decode_model,
+                                      make_gpt_layered_model, init_gpt_params)
+
+# deep + narrow on purpose: the spilled blocks dominate total params, so the
+# HBM-working-set assertion below is meaningful
+DEEP = GPTConfig(n_layer=8, n_head=4, d_model=64, max_seq_len=128,
+                 vocab_size=256, dtype=jnp.float32, remat=False)
+
+
+def _mk_mesh(**axes):
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    return mesh_mod.init_mesh(MeshConfig(**{**dict(data=1, tensor=1, sequence=1,
+                                                   expert=1, pipe=1), **axes}))
+
+
+def _engines(offload_device, tmp_path):
+    _mk_mesh(data=1)
+    params = init_gpt_params(DEEP, seed=0)
+    ref_spec = make_gpt_decode_model(cfg=DEEP, name="ref", params=params)
+    ref = init_inference(model=ref_spec, config={
+        "dtype": "float32", "kv_cache_dtype": "float32", "greedy": True})
+    spec = make_gpt_layered_model(cfg=DEEP, name="spill", params=params)
+    off = {"device": offload_device}
+    if offload_device == "nvme":
+        off["nvme_path"] = str(tmp_path / "param_swap")
+    eng = init_inference(model=spec, config={
+        "dtype": "float32", "kv_cache_dtype": "float32", "greedy": True,
+        "zero": {"offload_param": off}})
+    return ref, eng
+
+
+@pytest.mark.parametrize("offload_device", ["cpu", "nvme"])
+def test_spill_generate_matches_resident_engine(offload_device, tmp_path):
+    """Streaming the weights layer-by-layer must be bit-identical to the
+    resident engine (same math, different residency)."""
+    ref, eng = _engines(offload_device, tmp_path)
+    toks = np.random.default_rng(0).integers(0, DEEP.vocab_size, (2, 8)).astype(np.int32)
+    out_ref = ref.generate(toks, max_new_tokens=6)
+    out = eng.generate(toks, max_new_tokens=6)
+    np.testing.assert_array_equal(out, out_ref)
+    eng.release()
+
+
+def test_spill_hbm_working_set_is_depth_independent(tmp_path):
+    """The capability claim: HBM never holds more than lookahead+1 layers of
+    spilled weights, so servable model size is bounded by host/disk, not HBM.
+    (On the CPU harness "device memory" is host memory; the accounting is the
+    streamer's live-upload high-water mark, which IS the HBM working set on
+    hardware.)"""
+    _, eng = _engines("cpu", tmp_path)
+    toks = np.random.default_rng(1).integers(0, DEEP.vocab_size, (2, 6)).astype(np.int32)
+    eng.generate(toks, max_new_tokens=4)
+    assert eng.streamer.peak_live_layers <= 2  # lookahead=1 -> double buffer
+    assert eng.peak_param_hbm_bytes <= 2 * eng.store.layer_bytes
+    # the spilled model is ~4x bigger than what was ever resident at once
+    assert eng.total_param_bytes >= 4 * eng.peak_param_hbm_bytes
+    # streaming actually happened: every layer re-uploaded per forward pass
+    assert eng.streamer.uploads >= DEEP.n_layer
+    eng.release()
+
+
+def test_nvme_store_roundtrip_and_readahead(tmp_path):
+    """LayerParamStore nvme tier: all layers round-trip exactly through the
+    O_DIRECT AIO path, in-order and out-of-order, with read-ahead queued."""
+    from deepspeed_tpu.runtime.param_swap import LayerParamStore
+    rng = np.random.default_rng(0)
+    stacked = {"w": rng.normal(size=(5, 33, 17)).astype(np.float32),
+               "b": rng.normal(size=(5, 129)).astype(np.float32)}
+    store = LayerParamStore(stacked, device="nvme",
+                            swap_folder=str(tmp_path / "swp"), staging=3)
+    store.prefetch(0)
+    store.prefetch(1)
+    for i in [0, 1, 2, 4, 3, 0]:  # includes a ring-wrap revisit
+        tree = store.get_tree(i)
+        np.testing.assert_array_equal(tree["w"], stacked["w"][i])
+        np.testing.assert_array_equal(tree["b"], stacked["b"][i])
+    store.release()
+
+
+def test_spill_prefill_logits_match(tmp_path):
+    """Prefill logits parity (separately from generate, which only compares
+    argmax winners)."""
+    ref, eng = _engines("cpu", tmp_path)
+    toks = np.random.default_rng(2).integers(0, DEEP.vocab_size, (2, 12)).astype(np.int32)
+    cache = ref.model_spec.init_cache(2, 32, jnp.float32)
+    logits_ref, _ = ref.forward(toks, cache)
+    logits, _ = eng.forward(toks, max_len=32)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(logits_ref),
+                               rtol=1e-5, atol=1e-5)
+    eng.release()
