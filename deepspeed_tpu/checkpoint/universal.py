@@ -31,6 +31,12 @@ def _flatten(tree, prefix=()):
         if isinstance(node, dict):
             for k, v in node.items():
                 rec(v, path + (str(k),))
+        elif isinstance(node, tuple) and hasattr(node, "_fields"):
+            # NamedTuple (optax states): field names, not indices — orbax
+            # round-trips these as field-keyed dicts, so the offline converter
+            # and the live engine produce identical paths
+            for k, v in zip(node._fields, node):
+                rec(v, path + (str(k),))
         elif isinstance(node, (list, tuple)):
             for i, v in enumerate(node):
                 rec(v, path + (str(i),))
@@ -44,12 +50,19 @@ def _flatten(tree, prefix=()):
 
 
 def _unflatten_into(template, flat):
-    """Place flat name→array entries into a params-like template pytree."""
+    """Place flat name→array entries into a template pytree (params or
+    optimizer state — handles dicts, lists, tuples, NamedTuples like optax
+    states, and None leaves, mirroring `_flatten`)."""
     def rec(node, path):
         if isinstance(node, dict):
             return {k: rec(v, path + (str(k),)) for k, v in node.items()}
+        if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
+            return type(node)(*(rec(v, path + (str(k),))
+                                for k, v in zip(node._fields, node)))
         if isinstance(node, (list, tuple)):
             return type(node)(rec(v, path + (str(i),)) for i, v in enumerate(node))
+        if node is None:
+            return None
         key = "/".join(path)
         if key not in flat:
             raise KeyError(f"universal checkpoint missing param '{key}'")
@@ -74,48 +87,118 @@ def _write_universal(flat, out_dir, extra_meta=None):
     return str(out_dir)
 
 
-def save_universal_checkpoint(engine, save_dir, tag="universal"):
-    """Gather full fp32 weights from the engine (whatever its ZeRO/TP/PP layout)
-    and write the flat npz artifact."""
+OPT_PREFIX = "__opt__"
+
+
+def save_universal_checkpoint(engine, save_dir, tag="universal",
+                              save_optimizer_states=True):
+    """Gather full fp32 weights AND optimizer state from the engine (whatever
+    its ZeRO/TP/PP layout) and write the flat npz artifact.
+
+    v2 format (reference `ds_to_universal.py:254`, which merges fp32 weights
+    *and* exp_avg/exp_avg_sq into reshardable slices): optimizer-state leaves
+    (the optax tree — Adam mu/nu, step counts, ...) are stored fp32 under
+    `__opt__/<structural path>` next to the fp32 params, plus the global step,
+    so a topology-changing resume continues the SAME optimization trajectory
+    instead of resetting moments."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
     fp32 = engine.get_fp32_state_dict()
     flat = {k: np.asarray(v, np.float32) for k, v in _flatten(fp32).items()}
+    has_opt = False
+    if save_optimizer_states and engine.state.opt_state is not None:
+        opt = engine.state.opt_state
+        try:
+            # replicate-then-fetch (same mechanism as get_fp32_state_dict):
+            # ZeRO-sharded optimizer state in a multi-process run is not fully
+            # addressable, so a bare device_get would fail exactly on the
+            # large runs universal checkpoints exist for
+            rep = jax.tree_util.tree_map(
+                lambda _: NamedSharding(engine.mesh, P()), opt)
+            opt_host = jax.device_get(
+                jax.jit(lambda t: t, out_shardings=rep)(opt))
+        except Exception:
+            # host-tier/pinned state (or numpy leaves) — already addressable
+            opt_host = jax.device_get(opt)
+        flat.update({k: np.asarray(v, np.float32)
+                     for k, v in _flatten(opt_host, (OPT_PREFIX,)).items()})
+        has_opt = True
     return _write_universal(flat, pathlib.Path(save_dir) / tag, {
+        "format_version": 2,
+        "has_optimizer_state": has_opt,
         "global_steps": engine.global_steps,
+        "step": int(engine.state.step),
         "zero_stage": engine.zero_stage,
         "mesh": str(engine.spec),
     })
 
 
-def load_universal_checkpoint(engine, load_dir, tag="universal", strict=True):
+def load_universal_checkpoint(engine, load_dir, tag="universal", strict=True,
+                              load_optimizer_states=True):
     """Load a universal artifact into an engine of ANY topology: arrays are cast
-    to the compute dtype and placed with the engine's own shardings; fp32 master
-    rebuilt; optimizer state reset (reference loads fresh states too unless the
-    optimizer slices were converted)."""
+    to each template leaf's dtype and placed with the engine's own shardings
+    (params, fp32 master, AND — v2 — the optimizer-state tree, so Adam moments
+    survive a mesh/TP/PP refactoring; reference `universal_checkpoint.py:12`).
+    v1 artifacts without optimizer slices fall back to fresh optimizer state."""
     import jax
     import jax.numpy as jnp
-    from deepspeed_tpu.utils.tree import tree_cast
 
     in_dir = pathlib.Path(load_dir) / tag
     with np.load(in_dir / UNIVERSAL_FILE) as data:
         flat = {k: data[k] for k in data.files}
-    params_np = _unflatten_into(engine.state.params, flat)
-    # place with engine shardings in compute dtype
-    params = jax.tree_util.tree_map(
-        lambda leaf, arr: jax.device_put(jnp.asarray(arr, leaf.dtype), leaf.sharding),
-        engine.state.params, params_np)
+    opt_flat = {k[len(OPT_PREFIX) + 1:]: v for k, v in flat.items()
+                if k.startswith(OPT_PREFIX + "/")}
+    param_flat = {k: v for k, v in flat.items()
+                  if not k.startswith(OPT_PREFIX + "/")}
+    params_np = _unflatten_into(engine.state.params, param_flat)
+
+    def place_like(leaf, arr):
+        return jax.device_put(jnp.asarray(arr, leaf.dtype), leaf.sharding)
+
+    params = jax.tree_util.tree_map(place_like, engine.state.params, params_np)
     state = engine.state._replace(params=params)
     if engine.keep_master:
-        master = jax.tree_util.tree_map(
-            lambda leaf, arr: jax.device_put(jnp.asarray(arr, jnp.float32), leaf.sharding),
-            engine.state.master, params_np)
+        master = jax.tree_util.tree_map(place_like, engine.state.master, params_np)
         state = state._replace(master=master)
-    engine.state = state
+    if load_optimizer_states and opt_flat and state.opt_state is not None:
+        # the fresh opt_state is the structural+sharding template: every leaf
+        # takes the saved full array, cast to the leaf dtype, placed with the
+        # leaf's sharding (that mapping IS the reshard — on a different mesh
+        # factoring the same full array just splits differently)
+        template = state.opt_state
+        named = _flatten(template)
+        if set(named) != set(opt_flat):
+            missing = sorted(set(named) - set(opt_flat))[:5]
+            extra = sorted(set(opt_flat) - set(named))[:5]
+            msg = ("universal optimizer state does not match this engine's "
+                   f"optimizer structure (missing {missing}, unexpected "
+                   f"{extra})")
+            if strict:
+                raise KeyError(msg + "; pass strict=False to reset moments "
+                               "instead, or load_optimizer_states=False")
+            logger.warning(msg + " — optimizer state reset (strict=False)")
+            opt_flat = {}
+        if opt_flat:
+            opt_np = _unflatten_into(template, opt_flat)
+            opt_state = jax.tree_util.tree_map(place_like, template, opt_np)
+            state = state._replace(opt_state=opt_state)
+    elif load_optimizer_states and not opt_flat:
+        log_dist("universal checkpoint has no optimizer slices (v1 artifact): "
+                 "optimizer state reset", ranks=[0])
     meta = {}
     meta_file = in_dir / META_FILE
     if meta_file.exists():
         with open(meta_file) as f:
             meta = json.load(f)
-    log_dist(f"loaded universal checkpoint from {in_dir}", ranks=[0])
+    if meta.get("step") is not None:
+        state = state._replace(step=jax.device_put(
+            jnp.asarray(meta["step"], state.step.dtype), state.step.sharding))
+    engine.state = state
+    if meta.get("global_steps") is not None and hasattr(engine, "global_steps"):
+        engine.global_steps = int(meta["global_steps"])  # keep counters in sync
+    log_dist(f"loaded universal checkpoint from {in_dir} "
+             f"(optimizer state {'restored' if opt_flat else 'reset'})",
+             ranks=[0])
     return meta
 
 
@@ -158,16 +241,26 @@ def convert_checkpoint_to_universal(ckpt_dir, out_dir, tag=None, out_tag="univer
             "a named npz (keys.json, written by this version's numpy engine); "
             "legacy positional npz cannot be mapped back to parameter names "
             "offline — use convert_to_universal(ckpt_dir, out_dir, engine)")
-    master = restored.get("master") if isinstance(restored, dict) \
-        else getattr(restored, "master", None)
-    params = restored.get("params") if isinstance(restored, dict) \
-        else getattr(restored, "params", None)
+    def field(name):
+        return restored.get(name) if isinstance(restored, dict) \
+            else getattr(restored, name, None)
+
+    master, params = field("master"), field("params")
     source = master if master is not None else params
     if source is None:
         raise ValueError("checkpoint has neither 'master' nor 'params' trees")
     flat = {k: np.asarray(v, np.float32) for k, v in _flatten(source).items()}
-    return _write_universal(flat, pathlib.Path(out_dir) / out_tag,
-                            {"source_checkpoint": str(ckpt_dir), "tag": str(tag)})
+    opt_state = field("opt_state")
+    has_opt = opt_state is not None and _flatten(opt_state)
+    if has_opt:  # v2: exp_avg/exp_avg_sq slices too (ds_to_universal.py:254)
+        flat.update({k: np.asarray(v, np.float32)
+                     for k, v in _flatten(opt_state, (OPT_PREFIX,)).items()})
+    step = field("step")
+    extra = {"format_version": 2, "has_optimizer_state": bool(has_opt),
+             "source_checkpoint": str(ckpt_dir), "tag": str(tag)}
+    if step is not None and np.ndim(step) == 0:
+        extra["step"] = int(step)
+    return _write_universal(flat, pathlib.Path(out_dir) / out_tag, extra)
 
 
 def main(argv=None):

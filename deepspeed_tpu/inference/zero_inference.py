@@ -44,6 +44,8 @@ class LayeredModelSpec:
     blocks: Any               # stacked per-layer params (leading dim L)
     num_layers: int
     init_layer_cache: Callable  # (B, max_len, dtype) -> (ck, cv) one layer
+    resident_specs: Any = None  # PartitionSpecs for TP sharding of resident
+    block_specs: Any = None     # per-LAYER PartitionSpecs (no leading L dim)
     eos_token_id: Optional[int] = None
     name: str = "model"
 
@@ -70,11 +72,30 @@ class ZeroInferenceEngine:
             comm.init_distributed(mesh_config=MeshConfig(data=-1, tensor=tp))
         self.mesh = mesh_mod.get_mesh()
 
-        self.resident = jax.device_put(tree_cast(model.resident, dtype))
+        from jax.sharding import NamedSharding
+        tp = config.tensor_parallel.tp_size
+        if tp > 1 and model.block_specs is None:
+            raise ValueError(
+                f"tensor_parallel.tp_size={tp} with parameter spill needs a "
+                "LayeredModelSpec carrying block_specs/resident_specs (the "
+                "GPT zoo's make_gpt_layered_model provides them); refusing "
+                "to silently serve unsharded layers")
+        if model.resident_specs is not None:
+            res_sh = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s), model.resident_specs)
+            self.resident = jax.device_put(tree_cast(model.resident, dtype),
+                                           res_sh)
+        else:
+            self.resident = jax.device_put(tree_cast(model.resident, dtype))
         self.store = LayerParamStore(
             tree_cast(model.blocks, dtype), device=offload_device,
             swap_folder=nvme_path, staging=staging)
-        self.streamer = LayerStreamer(self.store, lookahead=lookahead)
+        layer_sh = None
+        if model.block_specs is not None:
+            layer_sh = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s), model.block_specs)
+        self.streamer = LayerStreamer(self.store, shardings=layer_sh,
+                                      lookahead=lookahead)
         self.total_param_bytes = (
             self.store.layer_bytes * self.store.num_layers)
 
@@ -138,13 +159,15 @@ class ZeroInferenceEngine:
         eos = self.model_spec.eos_token_id if eos_token_id is None else eos_token_id
         out = []
         done = np.zeros((B,), bool)
-        for _ in range(max_new_tokens):
+        for step in range(max_new_tokens):
             emitted = np.where(done, pad_token_id, np.asarray(tok))
             out.append(emitted)
             if eos is not None:
                 done |= emitted == eos
-                if done.all():
-                    break
+            # only pay a decode pass (a full weight stream through HBM) when
+            # another token will actually be emitted
+            if step == max_new_tokens - 1 or done.all():
+                break
             logits, caches = self._decode_step(tok, pos, caches)
             tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             pos = pos + 1

@@ -790,8 +790,16 @@ def make_gpt_layered_model(cfg: GPTConfig = None, name="gpt2-125m", params=None,
         shape = (batch_size, cfg.n_kv_head, max_len, cfg.head_dim)
         return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
+    # TP shardings: the stacked specs' leading (layer) entry drops for the
+    # per-layer streamed trees
+    specs = gpt_param_specs(cfg)
+    resident_specs = {k: v for k, v in specs.items() if k != "blocks"}
+    block_specs = jax.tree_util.tree_map(lambda s: P(*tuple(s)[1:]),
+                                         specs["blocks"])
+
     return LayeredModelSpec(
         embed_fn=embed_fn, layer_prefill_fn=layer_prefill_fn,
         layer_decode_fn=layer_decode_fn, final_fn=final_fn,
         resident=resident, blocks=blocks, num_layers=cfg.n_layer,
-        init_layer_cache=init_layer_cache, name=name)
+        init_layer_cache=init_layer_cache, resident_specs=resident_specs,
+        block_specs=block_specs, name=name)

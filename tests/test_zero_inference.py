@@ -101,3 +101,39 @@ def test_spill_prefill_logits_match(tmp_path):
     np.testing.assert_allclose(np.asarray(logits), np.asarray(logits_ref),
                                rtol=1e-5, atol=1e-5)
     eng.release()
+
+
+def test_spill_with_tensor_parallel(tmp_path):
+    """tp=2 + spill: streamed layers carry their TP shardings (qkv column,
+    out-proj row), so the per-device working set is layer_bytes/tp — without
+    specs the engine must refuse rather than silently serve unsharded."""
+    _mk_mesh(data=1, tensor=2)
+    params = init_gpt_params(DEEP, seed=0)
+    ref_spec = make_gpt_decode_model(cfg=DEEP, name="ref", params=params)
+    ref = init_inference(model=ref_spec, config={
+        "dtype": "float32", "kv_cache_dtype": "float32", "greedy": True})
+    toks = np.random.default_rng(4).integers(0, DEEP.vocab_size, (2, 10)).astype(np.int32)
+    cache = ref.model_spec.init_cache(2, 24, jnp.float32)
+    logits_ref, _ = ref.forward(toks, cache)
+
+    spec = make_gpt_layered_model(cfg=DEEP, name="spill-tp", params=params)
+    eng = init_inference(model=spec, config={
+        "dtype": "float32", "kv_cache_dtype": "float32", "greedy": True,
+        "tensor_parallel": {"tp_size": 2},
+        "zero": {"offload_param": {"device": "cpu"}}})
+    logits, _ = eng.forward(toks, max_len=24)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(logits_ref),
+                               rtol=2e-5, atol=2e-5)
+    # the streamed qkv weight really is tensor-sharded on device
+    p0 = eng.streamer.layer(0)
+    sh = p0["attn_qkv_w"].sharding
+    assert not sh.is_fully_replicated, sh
+    eng.release()
+
+    # refusal path: a spec without block_specs + tp>1 must raise
+    import dataclasses as dc
+    bare = dc.replace(spec, block_specs=None, resident_specs=None)
+    with pytest.raises(ValueError, match="block_specs"):
+        init_inference(model=bare, config={
+            "dtype": "float32", "tensor_parallel": {"tp_size": 2},
+            "zero": {"offload_param": {"device": "cpu"}}})
