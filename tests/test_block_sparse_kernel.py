@@ -1,0 +1,168 @@
+"""Block-sparse flash kernel (reference `ops/sparse_attention/matmul.py:17`
+Triton SDD/DSD analog): numerics vs the dense masked path for every layout
+family, gradients, the SparseSelfAttention fast-path routing, and a real-TPU
+timing lane."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                BSLongformerSparsityConfig,
+                                                FixedSparsityConfig,
+                                                SparseSelfAttention)
+from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+    block_sparse_attention, _build)
+
+B, H, T, D = 2, 4, 512, 64
+
+
+def _qkv(seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(rng.normal(0, 1, (B, H, T, D)), dtype)
+                 for _ in range(3))
+
+
+def _dense_reference(cfg, q, k, v):
+    """The dense masked fp32 path, bypassing the kernel fast path."""
+    attn = SparseSelfAttention(cfg)
+    mask = attn._mask(T)
+    s = jnp.einsum("bhtd,bhsd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(D)
+    s = jnp.where(mask[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bhsd->bhtd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+LAYOUT_FAMILIES = [
+    ("fixed", FixedSparsityConfig(num_heads=H, block=16, num_local_blocks=4,
+                                  num_global_blocks=1,
+                                  attention="unidirectional")),
+    ("bigbird", BigBirdSparsityConfig(num_heads=H, block=16,
+                                      num_random_blocks=2,
+                                      num_sliding_window_blocks=3,
+                                      num_global_blocks=1)),
+    ("bslongformer", BSLongformerSparsityConfig(num_heads=H, block=16,
+                                                num_sliding_window_blocks=5,
+                                                global_block_indices=(0, 7))),
+]
+
+
+@pytest.mark.parametrize("name,cfg", LAYOUT_FAMILIES, ids=[n for n, _ in LAYOUT_FAMILIES])
+def test_kernel_matches_dense_masked(name, cfg):
+    q, k, v = _qkv()
+    layout = cfg.make_layout(T)
+    ref = _dense_reference(cfg, q, k, v)
+    out = block_sparse_attention(q, k, v, layout, block=cfg.block)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("block_q", [128, 256])
+def test_kernel_gradients_match_dense(block_q):
+    cfg = LAYOUT_FAMILIES[0][1]
+    q, k, v = _qkv(1)
+    layout = cfg.make_layout(T)
+
+    def f_sparse(q, k, v):
+        return jnp.sum(block_sparse_attention(q, k, v, layout, block=16,
+                                              block_q=block_q) ** 2)
+
+    def f_dense(q, k, v):
+        return jnp.sum(_dense_reference(cfg, q, k, v) ** 2)
+
+    gs = jax.grad(f_sparse, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
+
+
+def test_sparse_self_attention_routes_to_kernel():
+    """T % 128 == 0 + no extra masks -> the kernel path; outputs match the
+    dense fallback (which extra-mask calls still take)."""
+    cfg = FixedSparsityConfig(num_heads=H, block=16, num_local_blocks=4,
+                              num_global_blocks=1, attention="unidirectional")
+    q, k, v = _qkv(2)
+    attn = SparseSelfAttention(cfg)
+    out = attn(q, k, v)
+    ref = _dense_reference(cfg, q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # non-128-multiple T falls back to the dense path and still works
+    q2, k2, v2 = (x[:, :, :320] for x in (q, k, v))
+    out2 = attn(q2, k2, v2)
+    assert out2.shape == (B, H, 320, D)
+
+
+def test_visit_lists_skip_dead_blocks():
+    """The kernel's whole point: visited k-blocks per row track the layout,
+    not T — at ~19% density the mean visit count is a fraction of nb."""
+    cfg = FixedSparsityConfig(num_heads=H, block=16, num_local_blocks=4,
+                              num_global_blocks=1, attention="unidirectional")
+    layout = cfg.make_layout(T)
+    counts, idx, *_ = _build(layout, T, 16, 128)
+    nb = T // 128
+    assert counts.mean() < 0.75 * nb, (counts.mean(), nb)
+    assert counts.min() >= 1
+
+
+def test_dead_query_row_rejected():
+    """A q row dead at KERNEL granularity (a full 128-token stripe with no
+    live k-block) has an empty visit set -> undefined softmax; the build
+    refuses. (A dead 16-granular row inside a live kernel row degrades to the
+    dense path's uniform-softmax behavior instead — consistent, not fatal.)"""
+    layout = np.zeros((1, T // 16, T // 16), bool)
+    layout[:, :, 0] = True
+    layout[0, 8:16, :] = False  # fine rows 8..15 = kernel q-block 1, all dead
+    q, k, v = (x[:, :1] for x in _qkv(3))
+    with pytest.raises(AssertionError, match="fully-masked"):
+        block_sparse_attention(q, k, v, layout, block=16, block_q=128)
+
+
+@pytest.mark.tpu
+def test_tpu_sparse_speedup_at_8k():
+    """Real-chip lane: at T=8k / ~26% density the kernel must beat the dense
+    masked path by >=1.5x (measured 2.3x; the bound is relaxed for tunnel
+    timing variance). Reference capability: compute savings are WHY
+    `ops/sparse_attention` exists."""
+    import time
+    Tl, Hl = 8192, 4
+    cfg = FixedSparsityConfig(num_heads=Hl, block=16, num_local_blocks=256,
+                              num_global_blocks=8, attention="unidirectional")
+    layout = cfg.make_layout(Tl)
+    assert 0.2 < layout.mean() < 0.3
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(0, 1, (1, Hl, Tl, D)), jnp.bfloat16)
+               for _ in range(3))
+    attn = SparseSelfAttention(cfg)
+    mask = attn._mask(Tl)
+
+    def dense_fn(a):
+        s = jnp.einsum("bhtd,bhsd->bhts", a.astype(jnp.float32),
+                       k.astype(jnp.float32)) / np.sqrt(D)
+        s = jnp.where(mask[None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhts,bhsd->bhtd", p, v.astype(jnp.float32))
+
+    N = 20
+
+    def bench(fn):
+        @jax.jit
+        def run(a):
+            def body(c, _):
+                o = fn(c)
+                return (o / (1 + jnp.max(jnp.abs(o)))).astype(c.dtype), None
+            return jax.lax.scan(body, a, None, length=N)[0]
+        float(jnp.sum(run(q).astype(jnp.float32)))
+        best = float("inf")
+        for _ in range(3):  # tunnel timing swings >30%: best-of-3
+            t0 = time.perf_counter()
+            float(jnp.sum(run(q).astype(jnp.float32)))
+            best = min(best, (time.perf_counter() - t0) / N)
+        return best
+
+    t_sparse = bench(lambda a: block_sparse_attention(a, k, v, layout, block=16))
+    t_dense = bench(lambda a: dense_fn(a).astype(a.dtype))
+    assert t_dense / t_sparse >= 1.5, (t_sparse, t_dense)
