@@ -14,6 +14,8 @@ HF's `convert_llama_weights_to_hf.py`). Each adapter returns (GPTConfig, params)
 so callers can build either a training ModelSpec or a DecodeModelSpec.
 """
 
+import dataclasses
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -904,3 +906,97 @@ def hf_train_model(model, dtype=jnp.float32):
     spec = make_gpt_model(cfg=cfg, name=mt)
     spec.params = params
     return spec
+
+
+def from_megatron_gpt_moe(model_or_sd, hf_config=None, dtype=jnp.float32, *,
+                          num_heads=None, version=None):
+    """Megatron-LM GPT + DeepSpeed-MoE state dict → (MoEGPTConfig, params).
+
+    Reference: `module_inject/containers/megatron_gpt_moe.py:1`
+    (DS_MegatronGPTMoEContainer = Megatron attention/norm mapping + MoE
+    expert MLPs). Composes `from_megatron_gpt`'s layer mapping with the MoE
+    zoo layout (`models/moe_gpt.py`): layers whose MLP lives under
+    `mlp.deepspeed_moe.` contribute a gate (`gate.wg.weight`) and stacked
+    per-expert FFNs (`experts.deepspeed_experts.<e>.dense_{h_to_4h,4h_to_h}`,
+    the DeepSpeed-MoE checkpoint naming); their dense-MLP slots in the
+    stacked blocks are zero-filled (never read — `moe_gpt_forward` routes
+    those layers through the expert MLP)."""
+    from deepspeed_tpu.models.moe_gpt import MoEGPTConfig
+
+    raw = model_or_sd
+    if version is None and isinstance(raw, dict):
+        version = raw.get("checkpoint_version", 0)
+    if isinstance(raw, dict):
+        for env in ("module", "model"):
+            if env in raw and isinstance(raw[env], dict):
+                raw = raw[env]
+        if "language_model" in raw:
+            raw = raw["language_model"]
+    sd = _state_dict({k: v for k, v in raw.items()
+                      if hasattr(v, "shape") or hasattr(v, "detach")})
+    moe_prefix = "mlp.deepspeed_moe."
+    moe_keys = {k for k in sd if moe_prefix in k}
+    assert moe_keys, ("no deepspeed_moe keys found — use from_megatron_gpt "
+                      "for a dense Megatron checkpoint")
+
+    def layer_of(k):
+        return int(k.split(".")[2])
+
+    moe_ids = sorted({layer_of(k) for k in moe_keys})
+    # dense skeleton: satisfy from_megatron_gpt by zero-filling the MoE
+    # layers' dense-MLP entries (shapes from any expert's FFN)
+    any_moe = moe_ids[0]
+    up_w = sd[f"transformer.layers.{any_moe}.{moe_prefix}"
+              f"experts.deepspeed_experts.0.dense_h_to_4h.weight"]
+    F, D = up_w.shape
+    dense_sd = dict(sd)
+    for lid in moe_ids:
+        b = f"transformer.layers.{lid}."
+        dense_sd[b + "mlp.dense_h_to_4h.weight"] = np.zeros((F, D), np.float32)
+        dense_sd[b + "mlp.dense_h_to_4h.bias"] = np.zeros((F,), np.float32)
+        dense_sd[b + "mlp.dense_4h_to_h.weight"] = np.zeros((D, F), np.float32)
+        dense_sd[b + "mlp.dense_4h_to_h.bias"] = np.zeros((D,), np.float32)
+    dense_sd = {k: v for k, v in dense_sd.items() if moe_prefix not in k}
+    base_cfg, params = from_megatron_gpt(dense_sd, hf_config, dtype,
+                                         num_heads=num_heads, version=version)
+
+    # moe_freq must reproduce the checkpoint's MoE placement (the zoo places
+    # MoE at {i : i % freq == 1})
+    freq = None
+    for f in range(1, base_cfg.n_layer + 1):
+        if [i for i in range(base_cfg.n_layer) if i % f == 1] == moe_ids:
+            freq = f
+            break
+    assert freq is not None, \
+        f"MoE layer ids {moe_ids} do not match the zoo's every-freq pattern"
+
+    moe = {}
+    num_experts = None
+    for lid in moe_ids:
+        b = f"transformer.layers.{lid}.{moe_prefix}"
+        E = 1 + max(int(k.split("deepspeed_experts.")[1].split(".")[0])
+                    for k in moe_keys if k.startswith(b + "experts."))
+        num_experts = num_experts or E
+        assert E == num_experts, "expert count must match across layers"
+        ups, up_bs, downs, down_bs = [], [], [], []
+        for e in range(E):
+            eb = f"{b}experts.deepspeed_experts.{e}."
+            ups.append(sd[eb + "dense_h_to_4h.weight"].T)        # [D, F]
+            up_bs.append(sd[eb + "dense_h_to_4h.bias"])
+            downs.append(sd[eb + "dense_4h_to_h.weight"].T)      # [F, D]
+            down_bs.append(sd[eb + "dense_4h_to_h.bias"])
+        moe[str(lid)] = {
+            "gate_w": jnp.asarray(sd[b + "gate.wg.weight"].T, dtype),  # [D, E]
+            "w_up": jnp.asarray(np.stack(ups), dtype),
+            "b_up": jnp.asarray(np.stack(up_bs), dtype),
+            "w_down": jnp.asarray(np.stack(downs), dtype),
+            "b_down": jnp.asarray(np.stack(down_bs), dtype),
+        }
+    params["moe"] = moe
+
+    cfg = MoEGPTConfig(**{f.name: getattr(base_cfg, f.name)
+                          for f in dataclasses.fields(base_cfg)},
+                       num_experts=num_experts, moe_freq=freq)
+    logger.info(f"adapted Megatron GPT-MoE: {cfg.n_layer}L d={cfg.d_model} "
+                f"E={num_experts} moe_layers={moe_ids}")
+    return cfg, params

@@ -597,6 +597,7 @@ def make_gpt_model(cfg: GPTConfig = None, name="gpt2-125m", seed=0, attn_fn=None
         loss_fn=partial(gpt_loss, cfg=cfg, attn_fn=attn_fn),
         params=None if abstract else init_gpt_params(cfg, seed=seed),
         init_fn=gpt_init_fn(cfg) if abstract else None,
+        arch_cfg=cfg,
         param_specs=gpt_param_specs(cfg),
         apply_fn=partial(gpt_forward, cfg=cfg),
         name=name,

@@ -282,7 +282,9 @@ def _build_cached(layout, T, block, block_q):
     """Memoize _build's host-side visit-list loops AND the device uploads of
     the fine-mask constants — eager per-token callers would otherwise redo
     O(H*nq*nk) Python work and ~MBs of mask transfer every call."""
-    key = (hash(layout.tobytes()), layout.shape, T, block, block_q)
+    # key on the bytes themselves, not hash(): a 64-bit collision between two
+    # same-shape layouts would silently serve the wrong sparsity pattern
+    key = (layout.tobytes(), layout.shape, T, block, block_q)
     if key not in _BUILD_CACHE:
         (counts, idx, fine, countsT, idxT, fineT, _, _) = \
             _build(layout, T, block, block_q)

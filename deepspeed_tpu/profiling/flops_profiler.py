@@ -7,9 +7,15 @@ from the engine at `flops_profiler_profile_step`.
 TPU-native: XLA already knows the exact flop count of the compiled program —
 `jitted.lower(...).compile().cost_analysis()` exposes `flops`,
 `bytes accessed`, and `optimal_seconds`. The profiler wraps any jitted callable
-(or the engine's train step), reports program-level numbers, and derives
-utilization against the chip's peak. Per-module breakdown comes from
-`jax.named_scope` annotations surfaced in the xprof trace rather than hooks.
+(or the engine's train step) and reports program-level numbers plus derived
+utilization against the chip's peak.
+
+Per-module tree (the reference's `print_model_profile` MACs/latency tree):
+`ModuleProfile` cost-analyzes each submodule function separately (lowered
+with abstract ShapeDtypeStructs — no weights materialize) and assembles a
+depth-limited tree with flops/MACs/params and the share of the whole model;
+`gpt_module_profile` wires the GPT zoo's block structure (embed / N x
+{attn, mlp} / lm_head) into it.
 """
 
 import time
@@ -60,6 +66,12 @@ class FlopsProfiler:
         self.analysis = {}
         self.measured_seconds = None
         self.started = False
+        self.module_tree = None    # ModuleProfile root (set_module_tree)
+
+    def set_module_tree(self, tree):
+        """Attach a ModuleProfile tree (e.g. `gpt_module_profile(cfg)`) so
+        print_model_profile renders the reference's per-module breakdown."""
+        self.module_tree = tree
 
     def start_profile(self, ignore_list=None):
         self.started = True
@@ -120,8 +132,12 @@ class FlopsProfiler:
             f"achieved:                       {achieved/1e12:.2f} TFLOPS "
             f"({100*achieved/peak:.1f}% of peak)",
             f"bytes accessed:                 {_num_to_string(self.analysis.get('bytes accessed', 0))}B",
-            "----------------------------------------------------------------------------------",
         ]
+        if detailed and self.module_tree is not None:
+            lines.append("per-module (fwd flops):")
+            lines.extend(self.module_tree.render(module_depth=module_depth))
+        lines.append(
+            "----------------------------------------------------------------------------------")
         report = "\n".join(lines)
         if output_file:
             with open(output_file, "w") as f:
@@ -148,6 +164,113 @@ def get_model_profile(model, input_shape=None, args=(), kwargs=None, print_profi
     macs = prof.get_total_macs(as_string=as_string)
     params = prof.get_total_params(as_string=as_string)
     return flops, macs, params
+
+
+class ModuleProfile:
+    """One node of the per-module profile tree (reference
+    `flops_profiler/profiler.py:28` prints this per torch module; here each
+    node is a jittable submodule function cost-analyzed in isolation)."""
+
+    def __init__(self, name, flops=0.0, params=0, multiplier=1, children=()):
+        self.name = name
+        self.flops = float(flops)        # per instance
+        self.params = int(params)        # per instance
+        self.multiplier = multiplier     # e.g. n_layer for a block node
+        self.children = list(children)
+
+    @classmethod
+    def of(cls, name, fn, abstract_args, multiplier=1, params=0):
+        """Cost-analyze `fn` lowered against ShapeDtypeStructs."""
+        import jax
+        analysis = cost_analysis(jax.jit(fn), *abstract_args)
+        return cls(name, analysis.get("flops", 0.0), params, multiplier)
+
+    @property
+    def total_flops(self):
+        return self.multiplier * (self.flops +
+                                  sum(c.total_flops for c in self.children))
+
+    @property
+    def total_params(self):
+        return self.multiplier * (self.params +
+                                  sum(c.total_params for c in self.children))
+
+    def render(self, total=None, depth=0, module_depth=-1):
+        total = total or self.total_flops or 1.0
+        pct = 100.0 * self.total_flops / total
+        mult = f" x{self.multiplier}" if self.multiplier > 1 else ""
+        lines = [f"{'  ' * depth}{self.name}{mult}: "
+                 f"{_num_to_string(self.total_flops)}FLOPS "
+                 f"({_num_to_string(self.total_flops / 2)}MACs, {pct:.1f}%)"
+                 + (f", {_num_to_string(self.total_params)}params"
+                    if self.total_params else "")]
+        if module_depth < 0 or depth < module_depth:
+            for c in self.children:
+                lines.extend(c.render(total, depth + 1, module_depth))
+        return lines
+
+
+def gpt_module_profile(cfg, batch_size=1, seq_len=None):
+    """Per-module flops tree for a GPT-zoo config: embed / blocks x L
+    {attn, mlp} / lm_head — the reference's per-module report for its
+    injected transformer. Everything lowers abstractly (no weights)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+    from deepspeed_tpu.models import gpt as G
+
+    T = seq_len or min(cfg.max_seq_len, 512)
+    B, D, L = batch_size, cfg.d_model, cfg.n_layer
+    shapes = jax.eval_shape(G.gpt_init_fn(cfg, dtype=jnp.dtype(cfg.dtype)),
+                            jax.random.PRNGKey(0))
+    blocks = shapes["blocks"]
+    layer = jax.tree_util.tree_map(lambda s: S(s.shape[1:], s.dtype), blocks)
+    resident = {k: S(v.shape, v.dtype) for k, v in shapes.items()
+                if k != "blocks"}
+    x = S((B, T, D), jnp.dtype(cfg.dtype))
+    toks = S((B, T), jnp.int32)
+    pos = S((B, T), jnp.int32)
+
+    def nparams(tree):
+        import numpy as _np
+        return sum(int(_np.prod(s.shape))
+                   for s in jax.tree_util.tree_leaves(tree))
+
+    def attn_fn(x, p, positions):
+        return G._attn_half(x, p, cfg, positions)[0]
+
+    def mlp_fn(x, p):
+        return G._mlp(x, p, cfg)
+
+    def embed_fn(res, toks, pos):
+        return G._embed(res, toks, pos, cfg)
+
+    def head_fn(res, x):
+        return G._lm_head(res, x, cfg)
+
+    attn_keys = [k for k in layer if k.startswith(("attn_", "ln1"))]
+    mlp_keys = [k for k in layer if k.startswith(("mlp_", "ln2"))]
+    block_node = ModuleProfile(
+        "block", multiplier=L,
+        children=[
+            ModuleProfile.of("attn", attn_fn, (x, layer, pos),
+                             params=nparams({k: layer[k] for k in attn_keys})),
+            ModuleProfile.of("mlp", mlp_fn, (x, layer),
+                             params=nparams({k: layer[k] for k in mlp_keys})),
+        ])
+    # param attribution: the head weight (untied) and final norm belong to the
+    # lm_head node, everything else resident (wte/wpe/emb norms) to embed
+    head_keys = [k for k in resident if k.startswith(("lm_head", "lnf"))]
+    embed_params = nparams({k: v for k, v in resident.items()
+                            if k not in head_keys})
+    root = ModuleProfile(getattr(cfg, "name", "gpt"), children=[
+        ModuleProfile.of("embed", embed_fn, (resident, toks, pos),
+                         params=embed_params),
+        block_node,
+        ModuleProfile.of("lm_head", head_fn, (resident, x),
+                         params=nparams({k: resident[k] for k in head_keys})),
+    ])
+    return root
 
 
 def _num_to_string(num, precision=2):

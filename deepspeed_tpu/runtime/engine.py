@@ -67,6 +67,9 @@ class ModelSpec:
                                           # the engine advances it per step and
                                           # retraces when bit widths change
     has_aux: bool = False
+    arch_cfg: Any = None                  # architecture config (e.g. GPTConfig)
+                                          # — lets the flops profiler build a
+                                          # per-module tree for the zoo models
     name: str = "model"
 
 
@@ -1026,6 +1029,20 @@ class Engine:
         try:
             prof.analysis = cost_analysis(self._train_step, self.state, placed_batch)
             fp = self.config.flops_profiler
+            arch = getattr(self.model_spec, "arch_cfg", None)
+            if arch is not None and hasattr(arch, "n_layer"):
+                from deepspeed_tpu.profiling.flops_profiler import \
+                    gpt_module_profile
+                try:
+                    # the tree must describe the step being profiled: use the
+                    # actual token length of the placed batch
+                    toks = placed_batch.get("tokens",
+                                            placed_batch.get("input_ids"))
+                    seq = int(toks.shape[-1]) if toks is not None else None
+                    prof.set_module_tree(gpt_module_profile(
+                        arch, batch_size=self.micro_batch_size, seq_len=seq))
+                except Exception as e:
+                    logger.warning(f"per-module profile unavailable: {e}")
             prof.print_model_profile(profile_step=self.global_steps + 1,
                                      module_depth=fp.module_depth,
                                      top_modules=fp.top_modules,

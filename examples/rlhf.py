@@ -1,0 +1,103 @@
+"""RLHF end-to-end on the Hybrid Engine — the DS-Chat actor loop in miniature.
+
+Reference: `runtime/hybrid_engine.py:32` exists to serve DeepSpeed-Chat
+(`README.md:16`): inside one step the actor model GENERATES rollouts with
+inference-grade speed and TRAINS on them with ZeRO partitioning. Here the
+same loop runs TPU-native: `HybridEngine.generate()` samples rollouts from
+the CURRENT training params (no gather/release juggling — sharded params are
+logically whole), a reward scores them, and a REINFORCE-style policy-gradient
+`train_batch` updates the very same params.
+
+Toy objective: reward = fraction of rollout tokens equal to TARGET_TOKEN.
+With a random init that starts near 1/vocab; ~20 policy-gradient steps push
+it up by an order of magnitude, closing the generate -> reward -> train loop
+the reference's flagship claims are built on.
+
+Run:  python examples/rlhf.py        (CPU mesh or a real chip)
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt import (GPTConfig, gpt_forward, init_gpt_params,
+                                      make_gpt_decode_model)
+from deepspeed_tpu.runtime.engine import ModelSpec
+from deepspeed_tpu.runtime.hybrid_engine import HybridEngine
+from deepspeed_tpu.config.core import TpuTrainConfig
+
+TARGET_TOKEN = 7
+
+
+def build_actor(cfg: GPTConfig, ds_config, seed=0):
+    """HybridEngine whose training loss is REINFORCE on rollout tokens."""
+    params = init_gpt_params(cfg, seed=seed)
+
+    def pg_loss(p, batch, rng=None):
+        tokens = batch["tokens"]            # [B, T] prompt + rollout
+        mask = batch["rollout_mask"]        # [B, T] 1.0 on rollout positions
+        adv = batch["advantage"]            # [B] centered reward
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = gpt_forward(p, inputs, cfg)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        tok_logp = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        m = mask[:, 1:]
+        seq_logp = jnp.sum(tok_logp * m, axis=1) / jnp.maximum(jnp.sum(m, 1), 1.0)
+        return -jnp.mean(seq_logp * adv)
+
+    engine = HybridEngine(ModelSpec(loss_fn=pg_loss, params=params,
+                                    name="rlhf-actor"),
+                          TpuTrainConfig.load(ds_config))
+    engine.set_decode_spec(make_gpt_decode_model(cfg=cfg, name="rlhf-actor",
+                                                 params=params))
+    return engine
+
+
+def reward_fn(rollouts):
+    """[B, N] tokens -> [B] fraction equal to TARGET_TOKEN."""
+    return (np.asarray(rollouts) == TARGET_TOKEN).mean(axis=1)
+
+
+def rlhf_loop(steps=20, batch=16, prompt_len=8, max_new=8, seed=0,
+              verbose=True):
+    """generate -> reward -> policy-gradient train, on one set of params.
+    Returns the per-step mean rewards."""
+    cfg = GPTConfig(n_layer=2, n_head=4, d_model=64, d_ff=128, max_seq_len=64,
+                    vocab_size=64, dtype=jnp.float32, remat=False)
+    engine = build_actor(cfg, {
+        "train_micro_batch_size_per_gpu": batch,
+        "optimizer": {"type": "Adam", "params": {"lr": 5e-2}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 10 ** 9,
+    }, seed=seed)
+
+    rng = np.random.default_rng(seed)
+    rewards = []
+    for step in range(steps):
+        prompts = rng.integers(0, cfg.vocab_size, (batch, prompt_len)).astype(np.int32)
+        # 1) rollout from the CURRENT training params
+        rollouts = engine.generate(prompts, max_new_tokens=max_new,
+                                   greedy=False, temperature=1.0)
+        # 2) reward + centered advantage (REINFORCE baseline = batch mean)
+        r = reward_fn(rollouts)
+        adv = (r - r.mean()) / (r.std() + 1e-6)
+        # 3) train on the same params the rollout came from
+        tokens = np.concatenate([prompts, rollouts], axis=1)
+        mask = np.concatenate([np.zeros_like(prompts, np.float32),
+                               np.ones_like(rollouts, np.float32)], axis=1)
+        engine.train_batch({"tokens": tokens, "rollout_mask": mask,
+                            "advantage": adv.astype(np.float32)})
+        rewards.append(float(r.mean()))
+        if verbose:
+            print(f"step {step:3d}  reward {r.mean():.4f}")
+    return rewards
+
+
+if __name__ == "__main__":
+    rewards = rlhf_loop()
+    first, last = np.mean(rewards[:3]), np.mean(rewards[-3:])
+    print(f"mean reward: first3 {first:.4f} -> last3 {last:.4f}")
+    assert last > first, "reward did not improve"

@@ -150,6 +150,32 @@ def test_flops_profiler():
     assert flops >= 2 * 256**3 * 0.9
 
 
+def test_flops_profiler_module_tree():
+    """Per-module breakdown (reference profiler.py:28 prints a MACs tree per
+    module): gpt2-125m shows the per-block attn/mlp split and the tree total
+    tracks the analytic 2*N*T forward flops."""
+    from deepspeed_tpu.models.gpt import GPT2_CONFIGS
+    from deepspeed_tpu.profiling.flops_profiler import (FlopsProfiler,
+                                                        gpt_module_profile)
+    cfg = GPT2_CONFIGS["gpt2-125m"]
+    tree = gpt_module_profile(cfg, batch_size=1, seq_len=512)
+    names = {c.name for c in tree.children}
+    assert {"embed", "block", "lm_head"} <= names
+    block = next(c for c in tree.children if c.name == "block")
+    kids = {c.name: c for c in block.children}
+    assert "attn" in kids and "mlp" in kids
+    assert kids["mlp"].total_flops > kids["attn"].total_flops > 0
+    assert block.multiplier == cfg.n_layer
+    analytic = 2 * cfg.num_params() * 512
+    assert 0.9 * analytic < tree.total_flops < 1.3 * analytic
+    prof = FlopsProfiler()
+    prof.analysis = {"flops": tree.total_flops}
+    prof.measured_seconds = 0.1
+    prof.set_module_tree(tree)
+    report = prof.print_model_profile(output_file=None)
+    assert "attn" in report and "mlp" in report and "x12" in report
+
+
 def test_activation_checkpointing_api():
     from deepspeed_tpu.runtime import activation_checkpointing as ac
     ac.configure(partition_activations=True, policy="dots")

@@ -1,0 +1,22 @@
+"""RLHF end-to-end: the Hybrid Engine actor loop (reference
+`runtime/hybrid_engine.py:174` generate + DS-Chat claim `README.md:16`) —
+generate -> reward -> policy-gradient train on the SAME params, reward must
+improve on a toy objective."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples"))
+
+
+def test_rlhf_reward_improves():
+    from rlhf import rlhf_loop
+    rewards = rlhf_loop(steps=14, verbose=False, seed=0)
+    first, last = np.mean(rewards[:3]), np.mean(rewards[-3:])
+    # random-init baseline is ~1/64 per token (empirically ~0.2 after the
+    # first sampled batches); the policy-gradient loop drives it toward 1
+    assert last > first + 0.2, (first, last, rewards)
+    assert last > 0.5, rewards
