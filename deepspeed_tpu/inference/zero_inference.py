@@ -46,6 +46,9 @@ class LayeredModelSpec:
     init_layer_cache: Callable  # (B, max_len, dtype) -> (ck, cv) one layer
     resident_specs: Any = None  # PartitionSpecs for TP sharding of resident
     block_specs: Any = None     # per-LAYER PartitionSpecs (no leading L dim)
+    # training-side spill (runtime/infinity.py):
+    layer_train_fn: Optional[Callable] = None  # (layer_p, x, positions) -> x
+    train_loss_fn: Optional[Callable] = None   # (resident, x, labels) -> loss
     eos_token_id: Optional[int] = None
     name: str = "model"
 

@@ -798,9 +798,25 @@ def make_gpt_layered_model(cfg: GPTConfig = None, name="gpt2-125m", params=None,
     block_specs = jax.tree_util.tree_map(lambda s: P(*tuple(s)[1:]),
                                          specs["blocks"])
 
+    # training-side spill (ZeRO-Infinity params): cache-free block + CE head
+    def layer_train_fn(p, x, positions):
+        return _block(x, p, cfg, positions)
+
+    def train_loss_fn(res, x, labels):
+        logits = _lm_head(res, x, cfg)
+        m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+        sumexp = jnp.sum(jnp.exp((logits - m).astype(jnp.float32)), axis=-1)
+        logz = m[..., 0].astype(jnp.float32) + jnp.log(sumexp)
+        safe = jnp.maximum(labels, 0)
+        gold = jnp.take_along_axis(logits, safe[..., None],
+                                   axis=-1)[..., 0].astype(jnp.float32)
+        mask = (labels >= 0).astype(jnp.float32)
+        return ((logz - gold) * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
     return LayeredModelSpec(
         embed_fn=embed_fn, layer_prefill_fn=layer_prefill_fn,
         layer_decode_fn=layer_decode_fn, final_fn=final_fn,
+        layer_train_fn=layer_train_fn, train_loss_fn=train_loss_fn,
         resident=resident, blocks=blocks, num_layers=cfg.n_layer,
         init_layer_cache=init_layer_cache, resident_specs=resident_specs,
         block_specs=block_specs, name=name)

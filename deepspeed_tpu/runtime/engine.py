@@ -756,7 +756,12 @@ class Engine:
             grads = jax.tree_util.tree_map(lambda g: g / n, grads)
             return apply_grads(state, grads, loss / n)
 
-        self._apply_step = jax.jit(apply, donate_argnums=(0, 1),
+        # donate the state only: per leaf the program has params+mu+nu+grads
+        # donated in but only params+mu+nu out, so one buffer per leaf can
+        # never alias — donating grads too just trips XLA's "donated buffers
+        # were not usable" warning without freeing anything extra (the grads
+        # buffer dies at the end of the program either way)
+        self._apply_step = jax.jit(apply, donate_argnums=(0,),
                                    out_shardings=(self.state_shardings, None))
 
     # ------------------------------------------------------------------

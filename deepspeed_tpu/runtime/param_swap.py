@@ -73,6 +73,8 @@ class LayerParamStore:
             assert swap_folder is not None, "nvme offload needs a swap_folder"
             self._swapper = AsyncTensorSwapper(swap_folder,
                                                num_threads=aio_threads)
+            self._swap_folder = swap_folder
+            self._wswapper = None  # created lazily on first put()
             for i, layer in enumerate(host_layers):
                 for j, arr in enumerate(layer):
                     self._swapper.swap_out(f"layer{i}_leaf{j}", arr)
@@ -130,9 +132,46 @@ class LayerParamStore:
     def get_tree(self, i):
         return jax.tree_util.tree_unflatten(self.treedef, self.get(i))
 
+    def put(self, i, leaves, blocking=False):
+        """Write layer i's (updated) host leaves back to the store — the
+        training-side swap-out (reference `AsyncPartitionedParameterSwapper`
+        writes updated fp16 partitions back after the optimizer step).
+
+        Writes go through a SEPARATE swapper so queued read-ahead stays in
+        flight (a shared queue would make every put a full barrier). With
+        `blocking=False` (default) the caller must `flush_writes()` before
+        the next read of this layer — the training loop does it once per
+        step, not per layer."""
+        leaves = [np.asarray(l) for l in leaves]
+        if self._layers is not None:
+            self._layers[i] = leaves
+            return
+        if i in self._inflight:
+            # a read of the OLD content is mid-flight into ring buffers under
+            # the same names — let it land before the overwrite
+            self._swapper.wait()
+            self._inflight.clear()
+        slot = self._slot_for(i)
+        if self._ring[slot][0] == i:
+            self._ring[slot] = (None, None)  # staged copy is now stale
+        if self._wswapper is None:
+            from deepspeed_tpu.runtime.swap_tensor import AsyncTensorSwapper
+            self._wswapper = AsyncTensorSwapper(self._swap_folder)
+        for j, arr in enumerate(leaves):
+            self._wswapper.swap_out(f"layer{i}_leaf{j}", arr)
+        if blocking:
+            self._wswapper.wait()
+
+    def flush_writes(self):
+        """Barrier on outstanding put() writes (reads are unaffected)."""
+        if getattr(self, "_wswapper", None) is not None:
+            self._wswapper.wait()
+
     def release(self):
         if self._swapper is not None:
             self._swapper.release()
+        if getattr(self, "_wswapper", None) is not None:
+            self._wswapper.release()
 
 
 class LayerStreamer:
@@ -165,18 +204,23 @@ class LayerStreamer:
         self.uploads += 1
         self.peak_live_layers = max(self.peak_live_layers, len(self._live))
 
-    def layer(self, i):
-        """Device param tree for layer i; drops layers < i, uploads ahead."""
+    def layer(self, i, direction=1):
+        """Device param tree for layer i; evicts layers outside the look-ahead
+        window and uploads ahead in `direction` (+1 for the forward pass, -1
+        for the reversed backward pass of the Infinity trainer)."""
+        lo, hi = ((i, i + self.lookahead) if direction >= 0
+                  else (i - self.lookahead, i))
         for j in list(self._live):
-            # frees the HBM buffers (no other reference remains); j > window
-            # catches the wrap between forward passes (layer L-1 -> layer 0)
-            if j < i or j > i + self.lookahead:
+            # frees the HBM buffers (no other reference remains); the out-of-
+            # window check also catches the wrap between passes (L-1 -> 0)
+            if j < lo or j > hi:
                 del self._live[j]
         # uploads first (their get() may take the completion barrier), THEN
         # queue the next NVMe read-ahead so it stays truly asynchronous
+        step = 1 if direction >= 0 else -1
         for d in range(0, self.lookahead + 1):
-            self._upload(i + d)
-        self.store.prefetch(i + self.lookahead + 1)
+            self._upload(i + d * step)
+        self.store.prefetch(i + (self.lookahead + 1) * step)
         return jax.tree_util.tree_unflatten(self.store.treedef, self._live[i])
 
     def reset(self):

@@ -271,8 +271,7 @@ def dataclasses_replace_f32(cfg):
     return dataclasses.replace(cfg, dtype=jnp.float32)
 
 
-def _toy_megatron_moe_sd(seed=0, L=4, D=32, H=4, V=64, T=16, E=2,
-                         identical_experts=False):
+def _toy_megatron_moe_sd(seed=0, L=4, D=32, H=4, V=64, T=16, E=2):
     """Megatron + DeepSpeed-MoE state dict: every odd layer's MLP lives under
     mlp.deepspeed_moe (gate + per-expert FFNs, the DS-MoE checkpoint naming);
     even layers stay dense."""
@@ -286,18 +285,12 @@ def _toy_megatron_moe_sd(seed=0, L=4, D=32, H=4, V=64, T=16, E=2,
             del sd[b + key]
         m = b + "mlp.deepspeed_moe."
         sd[m + "gate.wg.weight"] = r(E, D)
-        first = None
         for e in range(E):
             eb = f"{m}experts.deepspeed_experts.{e}."
-            w = {"dense_h_to_4h.weight": r(4 * D, D),
-                 "dense_h_to_4h.bias": r(4 * D),
-                 "dense_4h_to_h.weight": r(D, 4 * D),
-                 "dense_4h_to_h.bias": r(D)}
-            if identical_experts:
-                first = first or w
-                w = first
-            for k_, v_ in w.items():
-                sd[eb + k_] = v_
+            sd[eb + "dense_h_to_4h.weight"] = r(4 * D, D)
+            sd[eb + "dense_h_to_4h.bias"] = r(4 * D)
+            sd[eb + "dense_4h_to_h.weight"] = r(D, 4 * D)
+            sd[eb + "dense_4h_to_h.bias"] = r(D)
     return sd
 
 

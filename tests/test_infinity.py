@@ -1,0 +1,76 @@
+"""ZeRO-Infinity training tier (reference
+`runtime/swap_tensor/partitioned_param_swapper.py:36` + `zero/stage3.py`
+NVMe integration): streamed-layer training with host-resident fp32 state."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                      make_gpt_layered_model, gpt_loss)
+from deepspeed_tpu.runtime.infinity import InfinityEngine
+
+DEEP = GPTConfig(n_layer=6, n_head=4, d_model=64, d_ff=128, max_seq_len=64,
+                 vocab_size=128, dtype=jnp.float32, remat=False)
+
+
+def _batches(n, B=4, T=17, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"tokens": rng.integers(0, DEEP.vocab_size, (B, T)).astype(np.int32)}
+            for _ in range(n)]
+
+
+@pytest.mark.parametrize("offload_device", ["cpu", "nvme"])
+def test_infinity_trains_and_bounds_hbm(offload_device, tmp_path):
+    """Loss decreases over steps while device memory never holds more than
+    lookahead+1 layers of weights — training a model the device could not
+    hold is the whole capability."""
+    params = init_gpt_params(DEEP, seed=0)
+    spec = make_gpt_layered_model(cfg=DEEP, name="inf", params=params)
+    kw = {"offload_device": offload_device}
+    if offload_device == "nvme":
+        kw["nvme_path"] = str(tmp_path / "w")
+        kw["optimizer_nvme_path"] = str(tmp_path / "opt")
+    eng = InfinityEngine(spec, lr=1e-2, dtype=jnp.float32, **kw)
+    batch = _batches(1)[0]
+    losses = [eng.train_batch(batch) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+    assert eng.streamer.peak_live_layers <= 2
+    assert eng.peak_param_hbm_bytes * 3 <= eng.store.layer_bytes * eng.L
+    eng.release()
+
+
+def test_infinity_matches_dense_adamw_trajectory():
+    """The streamed layer-at-a-time backward + per-layer host Adam must walk
+    the SAME trajectory as an ordinary whole-model Adam on the same loss
+    (fp32 everywhere, same init): losses match step-for-step to fp32
+    tolerance. This pins the per-layer vjp composition (boundary activations,
+    tied-embedding grad accumulation across head+embed) and the C++ Adam
+    against optax."""
+    import optax
+    params = init_gpt_params(DEEP, seed=1)
+    spec = make_gpt_layered_model(cfg=DEEP, name="inf", params=params)
+    eng = InfinityEngine(spec, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                         weight_decay=0.0, dtype=jnp.float32,
+                         offload_device="cpu")
+
+    opt = optax.adam(1e-3, b1=0.9, b2=0.999, eps=1e-8)
+    ref_params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, jnp.float32),
+                                        params)
+    opt_state = opt.init(ref_params)
+
+    @jax.jit
+    def ref_step(p, s, tokens):
+        loss, g = jax.value_and_grad(
+            lambda p_: gpt_loss(p_, {"tokens": tokens}, None, cfg=DEEP))(p)
+        upd, s = opt.update(g, s, p)
+        return optax.apply_updates(p, upd), s, loss
+
+    for step, b in enumerate(_batches(5, seed=3)):
+        loss_inf = eng.train_batch(b)
+        ref_params, opt_state, loss_ref = ref_step(ref_params, opt_state,
+                                                   jnp.asarray(b["tokens"]))
+        np.testing.assert_allclose(loss_inf, float(loss_ref), rtol=2e-4,
+                                   atol=2e-4, err_msg=f"step {step}")
+    eng.release()
