@@ -1136,6 +1136,45 @@ def initialize(args=None,
 
     if hasattr(model, "to_model_spec"):   # e.g. pipe.PipelineModule
         model = model.to_model_spec()
+    # ZeRO-Infinity parameter spill in TRAINING (reference: stage 3 +
+    # offload_param device cpu/nvme, `zero/stage3.py` + swap_tensor): a
+    # LayeredModelSpec routes to the layer-streaming InfinityEngine
+    from deepspeed_tpu.inference.zero_inference import LayeredModelSpec
+    if isinstance(model, LayeredModelSpec):
+        off = cfg.zero_optimization.offload_param
+        assert off is not None and off.device in ("cpu", "nvme"), \
+            "a LayeredModelSpec trains via the Infinity tier: set " \
+            "zero_optimization.offload_param.device to 'cpu' or 'nvme'"
+        assert optimizer is None and lr_scheduler is None, \
+            "the Infinity tier builds its host optimizers from the config " \
+            "(optimizer/scheduler blocks); passing objects is not supported"
+        from deepspeed_tpu.runtime.infinity import InfinityEngine
+        opt_off = cfg.zero_optimization.offload_optimizer
+        opt_type = (cfg.optimizer.type.lower() if cfg.optimizer else "adamw")
+        host_opt = {"adam": "adam", "adamw": "adam",
+                    "deepspeedcpuadam": "adam", "lion": "lion",
+                    "deepspeedcpulion": "lion", "adagrad": "adagrad",
+                    "deepspeedcpuadagrad": "adagrad"}.get(opt_type)
+        assert host_opt is not None, \
+            f"Infinity host tier supports adam/adamw/lion/adagrad, not {opt_type}"
+        opt_cfg = cfg.optimizer.params if cfg.optimizer else {}
+        schedule_fn = lr_schedules.build_schedule(cfg.scheduler)
+        inf = InfinityEngine(
+            model,
+            lr=opt_cfg.get("lr", 1e-3),
+            betas=tuple(opt_cfg.get("betas", (0.9, 0.999))),
+            eps=opt_cfg.get("eps", 1e-8),
+            weight_decay=opt_cfg.get("weight_decay", 0.0),
+            dtype=cfg.compute_dtype(),
+            offload_device=off.device,
+            nvme_path=off.nvme_path,
+            optimizer_nvme_path=(opt_off.nvme_path
+                                 if opt_off is not None and
+                                 opt_off.device == "nvme" else None),
+            optimizer=host_opt,
+            adamw_mode=(opt_type != "adam"),  # Adam = coupled L2 decay
+            lr_schedule=schedule_fn)
+        return inf, None, None, None
     if not isinstance(model, ModelSpec):
         assert callable(model), "model must be a ModelSpec or a loss callable"
         assert model_parameters is not None, \

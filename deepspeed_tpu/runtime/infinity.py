@@ -47,7 +47,7 @@ class InfinityEngine:
     def __init__(self, spec, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=0.0, dtype=jnp.bfloat16, offload_device="cpu",
                  nvme_path=None, optimizer_nvme_path=None, lookahead=1,
-                 optimizer="adam"):
+                 optimizer="adam", adamw_mode=True, lr_schedule=None):
         assert spec.layer_train_fn is not None and spec.train_loss_fn is not None, \
             "InfinityEngine needs a LayeredModelSpec with train fns " \
             "(models.gpt.make_gpt_layered_model provides them)"
@@ -60,12 +60,18 @@ class InfinityEngine:
         self.streamer = LayerStreamer(self.store, lookahead=lookahead)
         self.L = self.store.num_layers
 
-        # fp32 masters + moments on host, one optimizer per layer + resident
+        # fp32 masters + moments on host, one optimizer per layer + resident.
+        # Masters come straight from spec.blocks (full init precision, no
+        # store round-trip — on the nvme tier that would be a whole-model
+        # write-then-read before step 0, and fp32(bit16(w)) would lose the
+        # init's low bits).
         opt_kw = dict(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
-                      optimizer=optimizer)
+                      optimizer=optimizer, adamw_mode=adamw_mode,
+                      lr_schedule=lr_schedule)
+        blocks_host = [np.asarray(l, np.float32)
+                       for l in jax.tree_util.tree_leaves(spec.blocks)]
         layer_fp32 = [jax.tree_util.tree_unflatten(
-            self.store.treedef,
-            [np.asarray(l, np.float32) for l in self.store.get(i)])
+            self.store.treedef, [l[i] for l in blocks_host])
             for i in range(self.L)]
         self.layer_opts = [
             HostOffloadOptimizer(
@@ -166,11 +172,18 @@ class InfinityEngine:
         # Adam -> bit16 write-back (the updated layer re-uploads next step).
         # No reset here: layer L-1's device copy from the forward is exactly
         # what the backward needs first; the direction-aware eviction window
-        # handles the turn-around.
+        # handles the turn-around. The host Adam for layer i runs AFTER layer
+        # i-1's vjp is dispatched, so the CPU step overlaps device compute
+        # (the tier's raison d'etre) — g_x is already available as a future.
+        pending = None
         for i in reversed(range(self.L)):
             p = self.streamer.layer(i, direction=-1)
             g_p, g_x = self._block_vjp(p, boundaries[i], positions, g_x)
-            self._layer_step(i, g_p)
+            if pending is not None:
+                self._layer_step(*pending)
+            pending = (i, g_p)
+        if pending is not None:
+            self._layer_step(*pending)
         self.streamer.reset()  # device copies are stale after write-back
         self.store.flush_writes()  # one barrier per step, not per layer
 

@@ -74,3 +74,32 @@ def test_infinity_matches_dense_adamw_trajectory():
         np.testing.assert_allclose(loss_inf, float(loss_ref), rtol=2e-4,
                                    atol=2e-4, err_msg=f"step {step}")
     eng.release()
+
+
+def test_initialize_routes_layered_spec_to_infinity(tmp_path):
+    """Reference config surface: deepspeed.initialize with stage-3 param
+    offload reaches the swap tier — here a LayeredModelSpec + offload_param
+    device routes to InfinityEngine through the same initialize() call."""
+    import deepspeed_tpu
+    params = init_gpt_params(DEEP, seed=2)
+    spec = make_gpt_layered_model(cfg=DEEP, name="inf", params=params)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=spec, config={
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {
+            "stage": 3,
+            "offload_param": {"device": "nvme",
+                              "nvme_path": str(tmp_path / "w")},
+            "offload_optimizer": {"device": "nvme",
+                                  "nvme_path": str(tmp_path / "o")}}})
+    assert isinstance(eng, InfinityEngine)
+    batch = _batches(1, seed=9)[0]
+    losses = [eng.train_batch(batch) for _ in range(5)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+    eng.release()
+
+    # refusal: layered spec without an offload device is a config error
+    with pytest.raises(AssertionError, match="offload_param"):
+        deepspeed_tpu.initialize(model=spec, config={
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
