@@ -1148,6 +1148,21 @@ def initialize(args=None,
         assert optimizer is None and lr_scheduler is None, \
             "the Infinity tier builds its host optimizers from the config " \
             "(optimizer/scheduler blocks); passing objects is not supported"
+        # refuse config the streaming trainer does not honor rather than
+        # silently diverging from the reference semantics
+        assert training_data is None, \
+            "Infinity tier: feed batches to train_batch directly (no dataloader)"
+        _, _, gas = cfg.resolve_batch_sizes(1)
+        assert gas == 1, \
+            "Infinity tier: gradient accumulation is not supported yet " \
+            "(each step streams the weights once); set " \
+            "gradient_accumulation_steps to 1"
+        assert not cfg.fp16_enabled, \
+            "Infinity tier: use bf16 compute (no dynamic loss scaling on " \
+            "the layer-streaming path)"
+        assert not cfg.gradient_clipping, \
+            "Infinity tier: gradient_clipping is not supported yet (a global " \
+            "norm needs all layer grads, which never coexist)"
         from deepspeed_tpu.runtime.infinity import InfinityEngine
         opt_off = cfg.zero_optimization.offload_optimizer
         opt_type = (cfg.optimizer.type.lower() if cfg.optimizer else "adamw")

@@ -68,10 +68,12 @@ class InfinityEngine:
         opt_kw = dict(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
                       optimizer=optimizer, adamw_mode=adamw_mode,
                       lr_schedule=lr_schedule)
-        blocks_host = [np.asarray(l, np.float32)
-                       for l in jax.tree_util.tree_leaves(spec.blocks)]
+        # per-layer slicing: never materialize the whole model fp32 at once
+        # (the tier exists because the model exceeds memory)
+        block_leaves = jax.tree_util.tree_leaves(spec.blocks)
         layer_fp32 = [jax.tree_util.tree_unflatten(
-            self.store.treedef, [l[i] for l in blocks_host])
+            self.store.treedef,
+            [np.asarray(l[i], np.float32) for l in block_leaves])
             for i in range(self.L)]
         self.layer_opts = [
             HostOffloadOptimizer(
@@ -126,18 +128,20 @@ class InfinityEngine:
                  f"weights={offload_device} "
                  f"opt={'nvme' if optimizer_nvme_path else 'host'}", ranks=[0])
 
-    def _unflatten_host(self, flat, like_leaves):
+    @staticmethod
+    def _unflatten_host(flat, shapes):
         out, off = [], 0
-        for ref in like_leaves:
-            n = int(np.prod(ref.shape)) if ref.shape else 1
-            out.append(np.asarray(flat[off:off + n]).reshape(ref.shape))
+        for shape in shapes:
+            n = int(np.prod(shape)) if shape else 1
+            out.append(np.asarray(flat[off:off + n]).reshape(shape))
             off += n
         return out
 
-    def _layer_step(self, i, g_p):
-        """Host optimizer step for layer i; bit16 write-back to the store."""
-        flat = np.asarray(jax.device_get(self._flatten(g_p)))
-        g_host = self._unflatten_host(flat, jax.tree_util.tree_leaves(g_p))
+    def _layer_step(self, i, g_flat):
+        """Host optimizer step for layer i from the pre-dispatched fused grad
+        vector; bit16 write-back to the store."""
+        flat = np.asarray(jax.device_get(g_flat))
+        g_host = self._unflatten_host(flat, [s for s, _ in self.store.leaf_meta])
         g_tree = jax.tree_util.tree_unflatten(self.store.treedef, g_host)
         new_master = self.layer_opts[i].step(g_tree)
         self.store.put(i, [np.asarray(l).astype(self.store.leaf_meta[j][1])
@@ -179,9 +183,14 @@ class InfinityEngine:
         for i in reversed(range(self.L)):
             p = self.streamer.layer(i, direction=-1)
             g_p, g_x = self._block_vjp(p, boundaries[i], positions, g_x)
+            # dispatch the fused-grad flatten NOW (device future), then run
+            # the PREVIOUS layer's host Adam while vjp(i-1) and this flatten
+            # execute on the device — the fetch inside _layer_step no longer
+            # waits behind freshly-enqueued device work
+            g_flat = self._flatten(g_p)
             if pending is not None:
                 self._layer_step(*pending)
-            pending = (i, g_p)
+            pending = (i, g_flat)
         if pending is not None:
             self._layer_step(*pending)
         self.streamer.reset()  # device copies are stale after write-back
@@ -192,7 +201,9 @@ class InfinityEngine:
         res_flat = np.asarray(jax.device_get(self._flatten(g_res)))
         g_res_host = jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(g_res),
-            self._unflatten_host(res_flat, jax.tree_util.tree_leaves(g_res)))
+            self._unflatten_host(
+                res_flat,
+                [l.shape for l in jax.tree_util.tree_leaves(g_res)]))
         new_res_master = self.resident_opt.step(g_res_host)
         self.resident = jax.device_put(tree_cast(new_res_master, self.dtype))
         self.step_count += 1
