@@ -255,3 +255,23 @@ class SparseSelfAttention:
 class BertSparseSelfAttention(SparseSelfAttention):
     """Name-parity wrapper (reference `bert_sparse_self_attention.py`)."""
     pass
+
+
+def sparse_attn_fn(sparsity_config, softmax_scale=None):
+    """Adapter for the model zoo's `attn_fn` slot (`models/gpt.py::_attention`:
+    q,k,v as [B, T, H, hd]) — GPT-style training/inference with block-sparse
+    attention, the reference's `SparseSelfAttention` drop-in for long
+    sequences. Use a config with attention="unidirectional" for causal LMs
+    (the layout carries the causal mask; no separate masking is applied).
+
+        model = make_gpt_model(cfg=cfg, attn_fn=sparse_attn_fn(
+            FixedSparsityConfig(num_heads=cfg.n_head, attention="unidirectional")))
+    """
+    attn = SparseSelfAttention(sparsity_config, softmax_scale=softmax_scale)
+
+    def fn(q, k, v):
+        q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))   # -> [B,H,T,hd]
+        out = attn(q, k, v)
+        return jnp.swapaxes(out, 1, 2)
+
+    return fn

@@ -1188,7 +1188,8 @@ def initialize(args=None,
                                  opt_off.device == "nvme" else None),
             optimizer=host_opt,
             adamw_mode=(opt_type != "adam"),  # Adam = coupled L2 decay
-            lr_schedule=schedule_fn)
+            lr_schedule=schedule_fn,
+            micro_batch_size=cfg.resolve_batch_sizes(1)[1])
         return inf, None, None, None
     if not isinstance(model, ModelSpec):
         assert callable(model), "model must be a ModelSpec or a loss callable"

@@ -47,11 +47,13 @@ class InfinityEngine:
     def __init__(self, spec, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=0.0, dtype=jnp.bfloat16, offload_device="cpu",
                  nvme_path=None, optimizer_nvme_path=None, lookahead=1,
-                 optimizer="adam", adamw_mode=True, lr_schedule=None):
+                 optimizer="adam", adamw_mode=True, lr_schedule=None,
+                 micro_batch_size=None):
         assert spec.layer_train_fn is not None and spec.train_loss_fn is not None, \
             "InfinityEngine needs a LayeredModelSpec with train fns " \
             "(models.gpt.make_gpt_layered_model provides them)"
         self.spec = spec
+        self.micro_batch_size = micro_batch_size
         self.dtype = jnp.dtype(dtype)
         self.resident = jax.device_put(tree_cast(spec.resident, self.dtype))
         self.store = LayerParamStore(tree_cast(spec.blocks, self.dtype),
@@ -68,19 +70,21 @@ class InfinityEngine:
         opt_kw = dict(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
                       optimizer=optimizer, adamw_mode=adamw_mode,
                       lr_schedule=lr_schedule)
-        # per-layer slicing: never materialize the whole model fp32 at once
-        # (the tier exists because the model exceeds memory)
+        # per-layer slicing INSIDE the loop: at most one extra layer of fp32
+        # exists transiently (the tier exists because the model exceeds
+        # memory; a list of all slices would peak at ~2x whole-model fp32
+        # on top of the optimizers' own master copies)
         block_leaves = jax.tree_util.tree_leaves(spec.blocks)
-        layer_fp32 = [jax.tree_util.tree_unflatten(
-            self.store.treedef,
-            [np.asarray(l[i], np.float32) for l in block_leaves])
-            for i in range(self.L)]
-        self.layer_opts = [
-            HostOffloadOptimizer(
-                layer_fp32[i],
+        self.layer_opts = []
+        for i in range(self.L):
+            layer_i = jax.tree_util.tree_unflatten(
+                self.store.treedef,
+                [np.asarray(l[i], np.float32) for l in block_leaves])
+            self.layer_opts.append(HostOffloadOptimizer(
+                layer_i,
                 nvme_folder=(f"{optimizer_nvme_path}/layer{i}"
-                             if optimizer_nvme_path else None), **opt_kw)
-            for i in range(self.L)]
+                             if optimizer_nvme_path else None), **opt_kw))
+            del layer_i
         self.resident_opt = HostOffloadOptimizer(
             jax.device_get(tree_cast(spec.resident, jnp.float32)),
             nvme_folder=(f"{optimizer_nvme_path}/resident"
@@ -160,6 +164,10 @@ class InfinityEngine:
         inputs = jnp.asarray(inputs, jnp.int32)
         labels = jnp.asarray(labels, jnp.int32)
         B, T = inputs.shape
+        if self.micro_batch_size is not None:
+            assert B == self.micro_batch_size, (
+                f"batch of {B} fed to an engine configured for "
+                f"train_micro_batch_size_per_gpu={self.micro_batch_size}")
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None],
                                      (B, T))
 

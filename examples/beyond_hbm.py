@@ -15,7 +15,6 @@ resident leaves + 2 layers + activations, independent of depth.
 """
 
 import argparse
-import tempfile
 
 import numpy as np
 import jax.numpy as jnp
@@ -39,7 +38,7 @@ def main():
     spec = make_gpt_layered_model(cfg=cfg, name="beyond-hbm", params=params)
 
     device = "nvme" if args.nvme else "cpu"
-    nvme = args.nvme or tempfile.mkdtemp()
+    nvme = args.nvme or ""  # unused on the host-RAM tier
 
     # ---- training: the reference's stage-3 + offload_param config surface
     engine, _, _, _ = deepspeed_tpu.initialize(model=spec, config={
@@ -47,8 +46,10 @@ def main():
         "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
         "zero_optimization": {
             "stage": 3,
-            "offload_param": {"device": device, "nvme_path": nvme + "/w"},
-            "offload_optimizer": {"device": device, "nvme_path": nvme + "/o"},
+            "offload_param": {"device": device,
+                              "nvme_path": nvme + "/w" if args.nvme else None},
+            "offload_optimizer": {"device": device,
+                                  "nvme_path": nvme + "/o" if args.nvme else None},
         }})
     rng = np.random.default_rng(0)
     batch = {"tokens": rng.integers(0, cfg.vocab_size, (8, 65)).astype(np.int32)}
@@ -63,8 +64,9 @@ def main():
     infer = deepspeed_tpu.init_inference(
         model=make_gpt_layered_model(cfg=cfg, name="beyond-hbm", params=params),
         config={"dtype": "bfloat16", "greedy": True,
-                "zero": {"offload_param": {"device": device,
-                                           "nvme_path": nvme + "/iw"}}})
+                "zero": {"offload_param": {
+                    "device": device,
+                    "nvme_path": nvme + "/iw" if args.nvme else None}}})
     prompts = rng.integers(0, cfg.vocab_size, (4, 12)).astype(np.int32)
     out = infer.generate(prompts, max_new_tokens=16)
     print("generated:", out.shape, "— total params",
