@@ -598,8 +598,10 @@ def make_gpt_model(cfg: GPTConfig = None, name="gpt2-125m", seed=0, attn_fn=None
         params=None if abstract else init_gpt_params(cfg, seed=seed),
         init_fn=gpt_init_fn(cfg) if abstract else None,
         arch_cfg=cfg,
+        # same attention on the eval/inference forward as in training (a
+        # sparse/custom attn_fn must not silently fall back to dense here)
+        apply_fn=partial(gpt_forward, cfg=cfg, attn_fn=attn_fn),
         param_specs=gpt_param_specs(cfg),
-        apply_fn=partial(gpt_forward, cfg=cfg),
         name=name,
     )
 

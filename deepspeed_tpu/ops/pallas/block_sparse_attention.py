@@ -107,8 +107,19 @@ def _select_row(mat, i):
     return row.reshape((mat.shape[1],))
 
 
+def _causal_tile(qi, block_q, j, transpose=False):
+    """[block_q, BLOCK_K] bool (or its transpose): token-granular q >= k for
+    q-tile qi vs k-block j — the layout's unidirectional tril is only
+    block-granular, so diagonal blocks need this intra-block mask."""
+    shape = (BLOCK_K, block_q) if transpose else (block_q, BLOCK_K)
+    qdim, kdim = (1, 0) if transpose else (0, 1)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, qdim)
+    k_pos = j * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, shape, kdim)
+    return q_pos >= k_pos
+
+
 def _fwd_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
-                o_ref, lse_ref):
+                o_ref, lse_ref, *, causal):
     # counts_ref: [H, nbq] SMEM; idx_ref: [H, nbq, maxv] SMEM;
     # layout_ref: [fq, n16] f32 (this q-tile's fine mask rows);
     # q_ref: [block_q, D]; k/v_ref: [T, D]; lse_ref: [nbq, block_q] whole
@@ -127,6 +138,8 @@ def _fwd_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
                                 preferred_element_type=jnp.float32)
         tile = _select_cols(layout_ref[:, :], j, FPK_K)
         s = jnp.where(_expand_mask(tile, block_q, BLOCK_K) > 0, s, NEG_INF)
+        if causal:
+            s = jnp.where(_causal_tile(qi, block_q, j), s, NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
@@ -146,7 +159,7 @@ def _fwd_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
 
 
 def _bwd_dq_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
-                   do_ref, lse_ref, delta_ref, dq_ref):
+                   do_ref, lse_ref, delta_ref, dq_ref, *, causal):
     h, qi = pl.program_id(1), pl.program_id(2)
     block_q, D = q_ref.shape
     q = q_ref[:, :].astype(jnp.float32)
@@ -164,6 +177,8 @@ def _bwd_dq_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
                                 preferred_element_type=jnp.float32)
         tile = _select_cols(layout_ref[:, :], j, FPK_K)
         s = jnp.where(_expand_mask(tile, block_q, BLOCK_K) > 0, s, NEG_INF)
+        if causal:
+            s = jnp.where(_causal_tile(qi, block_q, j), s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -176,7 +191,8 @@ def _bwd_dq_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
 
 
 def _bwd_dkv_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
-                    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q):
+                    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q,
+                    causal):
     # transposed visit lists: for THIS k-block, which q-tiles touch it.
     # layout_ref is this k-row of layout^T: [FPK_K, n16].
     h, ki = pl.program_id(1), pl.program_id(2)
@@ -198,6 +214,9 @@ def _bwd_dkv_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
                                  preferred_element_type=jnp.float32)  # [bk, bq]
         tileT = _select_cols(layout_ref[:, :], i, fq)                 # [FPK_K, fq]
         sT = jnp.where(_expand_mask(tileT, BLOCK_K, block_q) > 0, sT, NEG_INF)
+        if causal:
+            sT = jnp.where(_causal_tile(i, block_q, ki, transpose=True),
+                           sT, NEG_INF)
         pT = jnp.exp(sT - lse[None, :])
         dv = dv + jax.lax.dot_general(pT, do, (((1,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -252,10 +271,15 @@ def _build(layout, T, block, block_q):
 
 
 def block_sparse_attention(q, k, v, layout, block=16, sm_scale=None,
-                           block_q=None, interpret=None):
+                           block_q=None, causal=False, interpret=None):
     """q,k,v: [B, H, T, D]; layout: [H, T//block, T//block] bool (numpy,
     static). Differentiable; compute scales with layout density. The softmax
-    scale is folded into q once up front (not per-block)."""
+    scale is folded into q once up front (not per-block).
+
+    `causal=True` adds TOKEN-granular q>=k masking inside visited blocks —
+    the unidirectional layouts' tril is block-granular only (a diagonal
+    block is fully open, leaking up to block-1 future tokens), so causal
+    LMs must set this."""
     if interpret is None:
         interpret = _use_interpret()
     B, H, T, D = q.shape
@@ -272,39 +296,40 @@ def block_sparse_attention(q, k, v, layout, block=16, sm_scale=None,
     assert layout.shape[0] == H, (layout.shape, H)
     args = _build_cached(layout, T, block, block_q)
     return _sparse(q, k, v, *args, float(sm_scale), int(block_q),
-                   bool(interpret))
+                   bool(causal), bool(interpret))
 
 
 _BUILD_CACHE = {}
 
 
 def _build_cached(layout, T, block, block_q):
-    """Memoize _build's host-side visit-list loops AND the device uploads of
-    the fine-mask constants — eager per-token callers would otherwise redo
-    O(H*nq*nk) Python work and ~MBs of mask transfer every call."""
+    """Memoize _build's host-side visit-list loops — eager per-token callers
+    would otherwise redo O(H*nq*nk) Python work every call. Cached values are
+    HOST numpy, converted per call site: caching jnp arrays would capture
+    tracers when the first call happens under a jit trace and leak them into
+    later traces (observed UnexpectedTracerError)."""
     # key on the bytes themselves, not hash(): a 64-bit collision between two
     # same-shape layouts would silently serve the wrong sparsity pattern
     key = (layout.tobytes(), layout.shape, T, block, block_q)
     if key not in _BUILD_CACHE:
         (counts, idx, fine, countsT, idxT, fineT, _, _) = \
             _build(layout, T, block, block_q)
-        _BUILD_CACHE[key] = (jnp.asarray(counts), jnp.asarray(idx),
-                             jnp.asarray(fine), jnp.asarray(countsT),
-                             jnp.asarray(idxT), jnp.asarray(fineT))
-        if len(_BUILD_CACHE) > 32:  # bound resident mask constants
+        _BUILD_CACHE[key] = (counts, idx, fine, countsT, idxT, fineT)
+        if len(_BUILD_CACHE) > 32:  # bound resident mask tables
             _BUILD_CACHE.pop(next(iter(_BUILD_CACHE)))
-    return _BUILD_CACHE[key]
+    return tuple(jnp.asarray(a) for a in _BUILD_CACHE[key])
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12))
 def _sparse(q, k, v, counts, idx, fine, countsT, idxT, fineT,
-            sm_scale, block_q, interpret):
+            sm_scale, block_q, causal, interpret):
     out, _ = _sparse_fwd_impl(q, k, v, counts, idx, fine, sm_scale, block_q,
-                              interpret)
+                              causal, interpret)
     return out
 
 
-def _sparse_fwd_impl(q, k, v, counts, idx, fine, sm_scale, block_q, interpret):
+def _sparse_fwd_impl(q, k, v, counts, idx, fine, sm_scale, block_q, causal,
+                     interpret):
     B, H, T, D = q.shape
     nbq = T // block_q
     n16 = fine.shape[-1]
@@ -331,7 +356,7 @@ def _sparse_fwd_impl(q, k, v, counts, idx, fine, sm_scale, block_q, interpret):
     # fine mask rows regrouped per q-tile: [H, nbq, fq, n16] -> block (fq, n16)
     fine_q = fine.reshape(H, nbq, fq, n16)
     out, lse = pl.pallas_call(
-        _fwd_kernel,
+        functools.partial(_fwd_kernel, causal=causal),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
@@ -343,13 +368,13 @@ def _sparse_fwd_impl(q, k, v, counts, idx, fine, sm_scale, block_q, interpret):
 
 
 def _sparse_vjp_fwd(q, k, v, counts, idx, fine, countsT, idxT, fineT,
-                    sm_scale, block_q, interpret):
+                    sm_scale, block_q, causal, interpret):
     out, lse = _sparse_fwd_impl(q, k, v, counts, idx, fine, sm_scale, block_q,
-                                interpret)
+                                causal, interpret)
     return out, (q, k, v, out, lse, counts, idx, fine, countsT, idxT, fineT)
 
 
-def _sparse_vjp_bwd(sm_scale, block_q, interpret, res, g):
+def _sparse_vjp_bwd(sm_scale, block_q, causal, interpret, res, g):
     q, k, v, out, lse, counts, idx, fine, countsT, idxT, fineT = res
     B, H, T, D = q.shape
     nbq, nbk = T // block_q, T // BLOCK_K
@@ -382,7 +407,7 @@ def _sparse_vjp_bwd(sm_scale, block_q, interpret, res, g):
                                lambda b, h, qi, *_: (b, h, qi, 0)),
     )
     dq = pl.pallas_call(
-        _bwd_dq_kernel, grid_spec=dq_spec,
+        functools.partial(_bwd_dq_kernel, causal=causal), grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
         interpret=interpret,
     )(counts, idx, fine_q, qs, k, v, do, lse, delta)
@@ -415,7 +440,7 @@ def _sparse_vjp_bwd(sm_scale, block_q, interpret, res, g):
         ],
     )
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, block_q=block_q),
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, causal=causal),
         grid_spec=dkv_spec,
         out_shape=[
             jax.ShapeDtypeStruct((B, H, T, D), q.dtype),

@@ -257,21 +257,36 @@ class BertSparseSelfAttention(SparseSelfAttention):
     pass
 
 
-def sparse_attn_fn(sparsity_config, softmax_scale=None):
+def sparse_attn_fn(sparsity_config, softmax_scale=None, causal=None):
     """Adapter for the model zoo's `attn_fn` slot (`models/gpt.py::_attention`:
     q,k,v as [B, T, H, hd]) — GPT-style training/inference with block-sparse
     attention, the reference's `SparseSelfAttention` drop-in for long
-    sequences. Use a config with attention="unidirectional" for causal LMs
-    (the layout carries the causal mask; no separate masking is applied).
+    sequences.
+
+    Causality: a config with attention="unidirectional" tril-masks the layout
+    at BLOCK granularity only (a diagonal block is fully open), so this
+    adapter additionally applies TOKEN-granular causal masking inside the
+    kernel for such configs (`causal` defaults to that inference; override
+    explicitly for encoder use).
 
         model = make_gpt_model(cfg=cfg, attn_fn=sparse_attn_fn(
             FixedSparsityConfig(num_heads=cfg.n_head, attention="unidirectional")))
     """
-    attn = SparseSelfAttention(sparsity_config, softmax_scale=softmax_scale)
+    from deepspeed_tpu.ops.pallas.block_sparse_attention import \
+        block_sparse_attention
+    if causal is None:
+        causal = getattr(sparsity_config, "attention", "") == "unidirectional"
+    layouts = {}
 
     def fn(q, k, v):
         q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))   # -> [B,H,T,hd]
-        out = attn(q, k, v)
+        B, H, T, hd = q.shape
+        scale = softmax_scale or 1.0 / math.sqrt(hd)
+        if T not in layouts:
+            layouts[T] = sparsity_config.make_layout(T)
+        out = block_sparse_attention(q, k, v, layouts[T],
+                                     block=sparsity_config.block,
+                                     sm_scale=scale, causal=causal)
         return jnp.swapaxes(out, 1, 2)
 
     return fn

@@ -1152,7 +1152,7 @@ def initialize(args=None,
         # silently diverging from the reference semantics
         assert training_data is None, \
             "Infinity tier: feed batches to train_batch directly (no dataloader)"
-        _, _, gas = cfg.resolve_batch_sizes(1)
+        _, inf_mbs, gas = cfg.resolve_batch_sizes(1)
         assert gas == 1, \
             "Infinity tier: gradient accumulation is not supported yet " \
             "(each step streams the weights once); set " \
@@ -1189,7 +1189,7 @@ def initialize(args=None,
             optimizer=host_opt,
             adamw_mode=(opt_type != "adam"),  # Adam = coupled L2 decay
             lr_schedule=schedule_fn,
-            micro_batch_size=cfg.resolve_batch_sizes(1)[1])
+            micro_batch_size=inf_mbs)
         return inf, None, None, None
     if not isinstance(model, ModelSpec):
         assert callable(model), "model must be a ModelSpec or a loss callable"

@@ -11,7 +11,8 @@ import pytest
 from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
                                                 BSLongformerSparsityConfig,
                                                 FixedSparsityConfig,
-                                                SparseSelfAttention)
+                                                SparseSelfAttention,
+                                                VariableSparsityConfig)
 from deepspeed_tpu.ops.pallas.block_sparse_attention import (
     block_sparse_attention, _build)
 
@@ -46,6 +47,11 @@ LAYOUT_FAMILIES = [
     ("bslongformer", BSLongformerSparsityConfig(num_heads=H, block=16,
                                                 num_sliding_window_blocks=5,
                                                 global_block_indices=(0, 7))),
+    ("variable", VariableSparsityConfig(num_heads=H, block=16,
+                                        num_random_blocks=1,
+                                        local_window_blocks=(2, 4, 8),
+                                        global_block_indices=(0,),
+                                        different_layout_per_head=True)),
 ]
 
 
@@ -168,45 +174,68 @@ def test_tpu_sparse_speedup_at_8k():
     assert t_dense / t_sparse >= 1.5, (t_sparse, t_dense)
 
 
-def test_gpt_trains_with_sparse_attention():
-    """The reference trains BERT with SparseSelfAttention swapped in; here the
-    GPT zoo takes the sparse kernel through the attn_fn slot: full-density
-    unidirectional layout matches dense causal attention exactly, and a
-    sparse layout trains (loss decreases under the engine)."""
-    import deepspeed_tpu
-    from deepspeed_tpu.comm import mesh as mesh_mod
-    from deepspeed_tpu.models.gpt import (GPTConfig, init_gpt_params, gpt_loss,
-                                          make_gpt_model)
+def test_sparse_attn_fn_is_token_causal():
+    """The unidirectional layouts tril only at BLOCK granularity — a diagonal
+    block is fully open. sparse_attn_fn must therefore be token-causal via
+    the kernel's causal flag: perturbing a FUTURE token must not change any
+    earlier output (the direct leak probe), and full-density causal must
+    match plain causal attention per-op tight."""
+    from deepspeed_tpu.models.gpt import _attention
     from deepspeed_tpu.ops.sparse_attention import (DenseSparsityConfig,
                                                     sparse_attn_fn)
-    mesh_mod._CURRENT_MESH = None
-    mesh_mod._CURRENT_SPEC = None
-    cfg = GPTConfig(n_layer=2, n_head=4, d_model=64, max_seq_len=256,
-                    vocab_size=256, dtype=jnp.float32, remat=False)
-    params = init_gpt_params(cfg, seed=0)
-    toks = np.random.default_rng(0).integers(0, 256, (2, 128)).astype(np.int32)
-    # explicit labels keep the model's T at 128 (a 16/128-multiple) instead
-    # of the shift-by-one 127
-    batch = {"tokens": toks, "labels": np.roll(toks, -1, axis=1)}
-    # full-density unidirectional == plain causal attention
+
     class CausalDense(DenseSparsityConfig):
+        attention = "unidirectional"
+
         def make_layout(self, seq_len):
             lay = super().make_layout(seq_len)
             return lay & np.tril(np.ones(lay.shape[1:], bool))[None]
 
-    causal_full = sparse_attn_fn(CausalDense(num_heads=4, block=16))
-    loss_sparse = float(jax.jit(lambda p: gpt_loss(
-        p, batch, None, cfg=cfg, attn_fn=causal_full))(params))
-    loss_ref = float(jax.jit(lambda p: gpt_loss(p, batch, None, cfg=cfg))(params))
-    # end-to-end through 2 layers + CE: online-softmax reassociation compounds
-    # (per-op exactness is covered by test_kernel_matches_dense_masked)
-    np.testing.assert_allclose(loss_sparse, loss_ref, rtol=5e-4, atol=5e-4)
+    fn = sparse_attn_fn(CausalDense(num_heads=4, block=16))
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(0, 1, (2, 128, 4, 16)), jnp.float32)
+               for _ in range(3))  # zoo layout [B, T, H, hd]
+    out = np.asarray(fn(q, k, v))
+    # leak probe: change token 5's key+value; outputs at positions < 5 of a
+    # causal attention are untouched (position 5 is INSIDE the first 16-token
+    # block, so block-granular masking alone would leak it)
+    k2 = k.at[:, 5].set(k[:, 5] + 100.0)
+    v2 = v.at[:, 5].set(v[:, 5] - 100.0)
+    out2 = np.asarray(fn(q, k2, v2))
+    np.testing.assert_array_equal(out[:, :5], out2[:, :5])
+    assert np.abs(out[:, 5:] - out2[:, 5:]).max() > 1e-3  # probe is live
 
-    # sparse layout under the engine: trains
+    # per-op parity vs the zoo's dense causal attention
+    T = 128
+    causal_mask = np.tril(np.ones((T, T), bool))[None]
+    from deepspeed_tpu.models.gpt import GPTConfig
+    cfg = GPTConfig(n_layer=1, n_head=4, d_model=64, dtype=jnp.float32)
+    ref = np.asarray(_attention(q, k, v, jnp.asarray(causal_mask), cfg))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_gpt_trains_with_sparse_attention():
+    """The reference trains BERT with SparseSelfAttention swapped in; here the
+    GPT zoo takes the sparse kernel through the attn_fn slot and trains —
+    and the spec's apply_fn (eval/inference forward) uses the SAME sparse
+    attention, not a silent dense fallback."""
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                          make_gpt_model)
+    from deepspeed_tpu.ops.sparse_attention import sparse_attn_fn
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    cfg = GPTConfig(n_layer=2, n_head=4, d_model=64, max_seq_len=256,
+                    vocab_size=256, dtype=jnp.float32, remat=False)
+    toks = np.random.default_rng(0).integers(0, 256, (2, 128)).astype(np.int32)
+    batch = {"tokens": toks, "labels": np.roll(toks, -1, axis=1)}
     sparse = sparse_attn_fn(FixedSparsityConfig(
         num_heads=4, block=16, num_local_blocks=4, num_global_blocks=1,
         attention="unidirectional"))
     model = make_gpt_model(cfg=cfg, name="sparse-gpt", attn_fn=sparse)
+    # apply_fn carries the sparse attention too (not the dense default)
+    assert model.apply_fn.keywords.get("attn_fn") is sparse
     eng, *_ = deepspeed_tpu.initialize(model=model, config={
         "train_micro_batch_size_per_gpu": 2,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
