@@ -123,11 +123,18 @@ def save_universal_checkpoint(engine, save_dir, tag="universal",
         flat.update({k: np.asarray(v, np.float32)
                      for k, v in _flatten(opt_host, (OPT_PREFIX,)).items()})
         has_opt = True
+    scaler = engine.state.scaler
     return _write_universal(flat, pathlib.Path(save_dir) / tag, {
         "format_version": 2,
         "has_optimizer_state": has_opt,
         "global_steps": engine.global_steps,
         "step": int(engine.state.step),
+        # fp16 dynamic loss-scaler scalars (reference ds_to_universal keeps
+        # them with the optimizer slices); harmless constants under bf16
+        "scaler": {"scale": float(scaler.scale),
+                   "good_steps": int(scaler.good_steps),
+                   "overflows": int(scaler.overflows),
+                   "hysteresis_left": int(scaler.hysteresis_left)},
         "zero_stage": engine.zero_stage,
         "mesh": str(engine.spec),
     })
@@ -193,6 +200,23 @@ def load_universal_checkpoint(engine, load_dir, tag="universal", strict=True,
     if meta.get("step") is not None:
         state = state._replace(step=jax.device_put(
             jnp.asarray(meta["step"], state.step.dtype), state.step.sharding))
+    if meta.get("scaler") and load_optimizer_states:
+        # scaler rides with the optimizer slices (reference keeps them
+        # together); a weights-only load keeps the engine's fresh scale
+        sc = meta["scaler"]
+        old = state.scaler
+        state = state._replace(scaler=type(old)(
+            scale=jax.device_put(jnp.asarray(sc["scale"], old.scale.dtype),
+                                 old.scale.sharding),
+            good_steps=jax.device_put(
+                jnp.asarray(sc["good_steps"], old.good_steps.dtype),
+                old.good_steps.sharding),
+            overflows=jax.device_put(
+                jnp.asarray(sc["overflows"], old.overflows.dtype),
+                old.overflows.sharding),
+            hysteresis_left=jax.device_put(
+                jnp.asarray(sc["hysteresis_left"], old.hysteresis_left.dtype),
+                old.hysteresis_left.sharding)))
     engine.state = state
     if meta.get("global_steps") is not None and hasattr(engine, "global_steps"):
         engine.global_steps = int(meta["global_steps"])  # keep counters in sync
@@ -260,6 +284,24 @@ def convert_checkpoint_to_universal(ckpt_dir, out_dir, tag=None, out_tag="univer
              "source_checkpoint": str(ckpt_dir), "tag": str(tag)}
     if step is not None and np.ndim(step) == 0:
         extra["step"] = int(step)
+    scaler = field("scaler")
+    if scaler is not None:
+        def sfield(name, idx):
+            v = (scaler.get(name) if isinstance(scaler, dict)
+                 else getattr(scaler, name, None))
+            if v is None and not isinstance(scaler, dict):
+                try:
+                    v = scaler[idx]
+                except Exception:
+                    v = None
+            return v
+        vals = {n: sfield(n, i) for i, n in enumerate(
+            ("scale", "good_steps", "overflows", "hysteresis_left"))}
+        if all(v is not None for v in vals.values()):
+            extra["scaler"] = {"scale": float(np.asarray(vals["scale"])),
+                               "good_steps": int(np.asarray(vals["good_steps"])),
+                               "overflows": int(np.asarray(vals["overflows"])),
+                               "hysteresis_left": int(np.asarray(vals["hysteresis_left"]))}
     return _write_universal(flat, pathlib.Path(out_dir) / out_tag, extra)
 
 

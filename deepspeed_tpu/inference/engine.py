@@ -30,6 +30,18 @@ from deepspeed_tpu.utils.logging import logger, log_dist
 from deepspeed_tpu.utils.tree import tree_cast
 
 
+def sample_logits(logits, rng, greedy=True, temperature=1.0, top_k=0):
+    """One sampling rule for every inference engine (resident + spill):
+    greedy argmax, or temperature/top-k categorical."""
+    if greedy or rng is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
 @dataclasses.dataclass
 class DecodeModelSpec:
     prefill_fn: Callable       # (params, tokens[B,T], cache, pad_mask) -> (logits[B,T,V], cache)
@@ -107,13 +119,8 @@ class InferenceEngine:
         top_k = self.config.top_k
 
         def sample(logits, rng):
-            if greedy:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            logits = logits / jnp.maximum(temperature, 1e-6)
-            if top_k and top_k > 0:
-                kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-            return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+            return sample_logits(logits, rng, greedy=greedy,
+                                 temperature=temperature, top_k=top_k)
 
         def generate(params, tokens, cache, prompt_len, max_new, rng, eos_id, pad_id):
             B, T = tokens.shape

@@ -149,15 +149,30 @@ class ZeroInferenceEngine:
         logits = self._final(self.resident, x)[:, 0]
         return logits, caches
 
+    def _sample(self, logits, rng):
+        """Config-driven sampling — the SAME rule as the resident engine."""
+        from deepspeed_tpu.inference.engine import sample_logits
+        return sample_logits(logits, rng, greedy=self.config.greedy,
+                             temperature=self.config.temperature,
+                             top_k=self.config.top_k)
+
     def generate(self, tokens, max_new_tokens=16, eos_token_id=None,
-                 pad_token_id=0):
-        """Greedy generation. Each emitted token streams the full weight set
-        through HBM — the ZeRO-Inference cost model; batch wide to amortize."""
+                 pad_token_id=0, rng=None):
+        """Generation (greedy, or sampled per the config's
+        temperature/top_k when greedy=False and an rng is given). Each
+        emitted token streams the full weight set through HBM — the
+        ZeRO-Inference cost model; batch wide to amortize."""
         tokens = jnp.asarray(tokens, jnp.int32)
         B, T = tokens.shape
+        if rng is None and not self.config.greedy:
+            rng = jax.random.PRNGKey(0)
         caches = self._init_caches(B, T + max_new_tokens)
         logits, caches = self.forward(tokens, caches)
-        tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        if rng is not None:
+            rng, sub = jax.random.split(rng)
+        else:
+            sub = None
+        tok = self._sample(logits[:, -1, :], sub)
         pos = jnp.full((B,), T, jnp.int32)
         eos = self.model_spec.eos_token_id if eos_token_id is None else eos_token_id
         out = []
@@ -172,7 +187,11 @@ class ZeroInferenceEngine:
             if step == max_new_tokens - 1 or done.all():
                 break
             logits, caches = self._decode_step(tok, pos, caches)
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            tok = self._sample(logits, sub)
             pos = pos + 1
         return np.stack(out, axis=1)
 

@@ -74,6 +74,7 @@ def test_universal_checkpoint_optimizer_state_resumes_trajectory(tmp_path):
     for _ in range(5):
         ea.train_batch(batch)
     save_universal_checkpoint(ea, str(tmp_path))
+    scale_at_save = float(ea.state.scaler.scale)
     # continuation on the ORIGINAL engine = ground-truth trajectory. NB the
     # loss train_batch returns is PRE-update, so the moments' effect shows up
     # one step later — compare the SECOND continuation step.
@@ -87,6 +88,11 @@ def test_universal_checkpoint_optimizer_state_resumes_trajectory(tmp_path):
     eb.train_batch(batch2)
     resumed = float(eb.train_batch(batch))
     assert abs(truth - resumed) < 1e-4, (truth, resumed)
+    # the loss-scaler scalars ride along in meta (fp16 resumes keep their
+    # scale instead of resetting; trivially-constant under bf16/fp32) —
+    # compared against the SAVE-time value, not post-save training
+    assert meta["scaler"]["scale"] == scale_at_save
+    assert float(eb.state.scaler.scale) == scale_at_save
 
     # counter-check the test's sensitivity: a weights-only load (moments
     # reset) diverges from the trajectory at the same point
