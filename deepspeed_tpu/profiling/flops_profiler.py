@@ -134,8 +134,15 @@ class FlopsProfiler:
             f"bytes accessed:                 {_num_to_string(self.analysis.get('bytes accessed', 0))}B",
         ]
         if detailed and self.module_tree is not None:
-            lines.append("per-module (fwd flops):")
-            lines.extend(self.module_tree.render(module_depth=module_depth))
+            tree_secs = None
+            if dur and flops:
+                # attribute the measured step time to the fwd tree by its
+                # share of the program's total flops (bwd+update included in
+                # `flops`, so the fwd tree gets its proportional slice)
+                tree_secs = dur * self.module_tree.total_flops / flops
+            lines.append("per-module (fwd flops, est. latency):")
+            lines.extend(self.module_tree.render(module_depth=module_depth,
+                                                 total_seconds=tree_secs))
         lines.append(
             "----------------------------------------------------------------------------------")
         report = "\n".join(lines)
@@ -195,18 +202,26 @@ class ModuleProfile:
         return self.multiplier * (self.params +
                                   sum(c.total_params for c in self.children))
 
-    def render(self, total=None, depth=0, module_depth=-1):
+    def render(self, total=None, depth=0, module_depth=-1, total_seconds=None):
+        """Depth-limited lines; with `total_seconds` (a measured fwd walltime)
+        each node also shows its flops-proportional latency estimate — the
+        reference profiler's per-module latency column (`profiler.py:28`),
+        attributed by share instead of per-hook timers."""
         total = total or self.total_flops or 1.0
         pct = 100.0 * self.total_flops / total
         mult = f" x{self.multiplier}" if self.multiplier > 1 else ""
+        lat = ""
+        if total_seconds:
+            lat = f", ~{1e3 * total_seconds * self.total_flops / total:.2f} ms"
         lines = [f"{'  ' * depth}{self.name}{mult}: "
                  f"{_num_to_string(self.total_flops)}FLOPS "
                  f"({_num_to_string(self.total_flops / 2)}MACs, {pct:.1f}%)"
                  + (f", {_num_to_string(self.total_params)}params"
-                    if self.total_params else "")]
+                    if self.total_params else "") + lat]
         if module_depth < 0 or depth < module_depth:
             for c in self.children:
-                lines.extend(c.render(total, depth + 1, module_depth))
+                lines.extend(c.render(total, depth + 1, module_depth,
+                                      total_seconds))
         return lines
 
 
