@@ -1153,10 +1153,6 @@ def initialize(args=None,
         assert training_data is None, \
             "Infinity tier: feed batches to train_batch directly (no dataloader)"
         _, inf_mbs, gas = cfg.resolve_batch_sizes(1)
-        assert gas == 1, \
-            "Infinity tier: gradient accumulation is not supported yet " \
-            "(each step streams the weights once); set " \
-            "gradient_accumulation_steps to 1"
         assert not cfg.fp16_enabled, \
             "Infinity tier: use bf16 compute (no dynamic loss scaling on " \
             "the layer-streaming path)"
@@ -1189,7 +1185,8 @@ def initialize(args=None,
             optimizer=host_opt,
             adamw_mode=(opt_type != "adam"),  # Adam = coupled L2 decay
             lr_schedule=schedule_fn,
-            micro_batch_size=inf_mbs)
+            micro_batch_size=inf_mbs,
+            gradient_accumulation_steps=gas)
         return inf, None, None, None
     if not isinstance(model, ModelSpec):
         assert callable(model), "model must be a ModelSpec or a loss callable"

@@ -48,12 +48,13 @@ class InfinityEngine:
                  weight_decay=0.0, dtype=jnp.bfloat16, offload_device="cpu",
                  nvme_path=None, optimizer_nvme_path=None, lookahead=1,
                  optimizer="adam", adamw_mode=True, lr_schedule=None,
-                 micro_batch_size=None):
+                 micro_batch_size=None, gradient_accumulation_steps=1):
         assert spec.layer_train_fn is not None and spec.train_loss_fn is not None, \
             "InfinityEngine needs a LayeredModelSpec with train fns " \
             "(models.gpt.make_gpt_layered_model provides them)"
         self.spec = spec
         self.micro_batch_size = micro_batch_size
+        self.gas = max(1, int(gradient_accumulation_steps))
         self.dtype = jnp.dtype(dtype)
         self.resident = jax.device_put(tree_cast(spec.resident, self.dtype))
         self.store = LayerParamStore(tree_cast(spec.blocks, self.dtype),
@@ -141,10 +142,9 @@ class InfinityEngine:
             off += n
         return out
 
-    def _layer_step(self, i, g_flat):
-        """Host optimizer step for layer i from the pre-dispatched fused grad
-        vector; bit16 write-back to the store."""
-        flat = np.asarray(jax.device_get(g_flat))
+    def _layer_step_host(self, i, flat):
+        """Host optimizer step for layer i from a host fp32 grad flat; bit16
+        write-back to the store."""
         g_host = self._unflatten_host(flat, [s for s, _ in self.store.leaf_meta])
         g_tree = jax.tree_util.tree_unflatten(self.store.treedef, g_host)
         new_master = self.layer_opts[i].step(g_tree)
@@ -152,9 +152,76 @@ class InfinityEngine:
                            for j, l in enumerate(
                                jax.tree_util.tree_leaves(new_master))])
 
+    def _layer_step(self, i, g_flat):
+        self._layer_step_host(i, np.asarray(jax.device_get(g_flat)))
+
+    def _micro_pass(self, inputs, labels, acc, res_acc, mode):
+        """One micro-batch forward+backward. `mode`:
+        "apply"      — gas==1: each layer's host Adam runs overlapped inside
+                       the backward loop;
+        "accumulate" — non-final gas micro: host grad flats accumulate into
+                       `acc`/`res_acc` (weights stay constant, as
+                       accumulation semantics require);
+        "finalize"   — FINAL gas micro: each layer's mean grad
+                       (acc[i]+flat)/gas steps the host Adam inside the same
+                       overlapped pipeline, and acc[i] is freed as consumed —
+                       overlap is preserved and accumulator memory falls
+                       layer by layer through the last backward."""
+        B, T = inputs.shape
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None],
+                                     (B, T))
+        x = self._embed(self.resident, inputs, positions)
+        boundaries = []
+        for i in range(self.L):
+            boundaries.append(x)
+            x = self._block(self.streamer.layer(i), x, positions)
+
+        loss, g_res, g_x = self._head(self.resident, x, labels)
+
+        # backward: stream layers in reverse. No reset first: layer L-1's
+        # device copy from the forward is exactly what the backward needs;
+        # the direction-aware eviction window handles the turn-around. The
+        # host work for layer i runs AFTER layer i-1's vjp is dispatched, so
+        # the CPU overlaps device compute (the tier's raison d'etre).
+        pending = None
+        for i in reversed(range(self.L)):
+            p = self.streamer.layer(i, direction=-1)
+            g_p, g_x = self._block_vjp(p, boundaries[i], positions, g_x)
+            g_flat = self._flatten(g_p)
+            if pending is not None:
+                self._consume(acc, mode, *pending)
+            pending = (i, g_flat)
+        if pending is not None:
+            self._consume(acc, mode, *pending)
+
+        g_res = self._add(g_res, self._embed_vjp(self.resident, inputs,
+                                                 positions, g_x))
+        res_flat = np.asarray(jax.device_get(self._flatten(g_res)))
+        if res_acc is None:
+            res_acc = res_flat.copy()  # device_get arrays are read-only
+        else:
+            res_acc += res_flat
+        return float(loss), res_acc
+
+    def _consume(self, acc, mode, i, g_flat):
+        if mode == "apply":
+            self._layer_step(i, g_flat)
+            return
+        flat = np.asarray(jax.device_get(g_flat))
+        if mode == "finalize":
+            mean = (acc[i] + flat) / self.gas
+            acc[i] = None  # accumulator memory falls as the backward proceeds
+            self._layer_step_host(i, mean)
+        elif acc[i] is None:
+            acc[i] = flat.copy()  # device_get arrays are read-only
+        else:
+            acc[i] += flat
+
     def train_batch(self, batch):
-        """One full step: streamed forward, streamed reversed backward with
-        per-layer host optimizer steps, resident update last. Returns loss."""
+        """One full step over the GLOBAL batch (micro_batch x gas rows, like
+        the main engine): streamed forward/backward per micro-batch, host
+        optimizer steps on the mean gradient at the gas boundary, bit16
+        write-back, resident update last. Returns the mean loss."""
         tokens = np.asarray(batch.get("tokens", batch.get("input_ids")))
         labels = batch.get("labels")
         if labels is None:
@@ -164,54 +231,37 @@ class InfinityEngine:
         inputs = jnp.asarray(inputs, jnp.int32)
         labels = jnp.asarray(labels, jnp.int32)
         B, T = inputs.shape
+        assert B % self.gas == 0, (
+            f"global batch {B} not divisible by "
+            f"gradient_accumulation_steps={self.gas}")
+        mbs = B // self.gas
         if self.micro_batch_size is not None:
-            assert B == self.micro_batch_size, (
-                f"batch of {B} fed to an engine configured for "
-                f"train_micro_batch_size_per_gpu={self.micro_batch_size}")
-        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None],
-                                     (B, T))
+            assert mbs == self.micro_batch_size, (
+                f"global batch of {B} with gas={self.gas} implies micro "
+                f"batch {mbs}, engine configured for {self.micro_batch_size}")
 
-        # ---- forward: stream layers, stash boundary activations
-        x = self._embed(self.resident, inputs, positions)
-        boundaries = []
-        for i in range(self.L):
-            boundaries.append(x)
-            x = self._block(self.streamer.layer(i), x, positions)
-
-        loss, g_res, g_x = self._head(self.resident, x, labels)
-
-        # ---- backward: stream layers in reverse; per-layer grad -> host
-        # Adam -> bit16 write-back (the updated layer re-uploads next step).
-        # No reset here: layer L-1's device copy from the forward is exactly
-        # what the backward needs first; the direction-aware eviction window
-        # handles the turn-around. The host Adam for layer i runs AFTER layer
-        # i-1's vjp is dispatched, so the CPU step overlaps device compute
-        # (the tier's raison d'etre) — g_x is already available as a future.
-        pending = None
-        for i in reversed(range(self.L)):
-            p = self.streamer.layer(i, direction=-1)
-            g_p, g_x = self._block_vjp(p, boundaries[i], positions, g_x)
-            # dispatch the fused-grad flatten NOW (device future), then run
-            # the PREVIOUS layer's host Adam while vjp(i-1) and this flatten
-            # execute on the device — the fetch inside _layer_step no longer
-            # waits behind freshly-enqueued device work
-            g_flat = self._flatten(g_p)
-            if pending is not None:
-                self._layer_step(*pending)
-            pending = (i, g_flat)
-        if pending is not None:
-            self._layer_step(*pending)
+        acc = [None] * self.L
+        res_acc = None
+        losses = []
+        for m in range(self.gas):
+            sl = slice(m * mbs, (m + 1) * mbs)
+            if self.gas == 1:
+                mode = "apply"
+            else:
+                mode = "finalize" if m == self.gas - 1 else "accumulate"
+            loss, res_acc = self._micro_pass(inputs[sl], labels[sl], acc,
+                                             res_acc, mode)
+            losses.append(loss)
+        loss = float(np.mean(losses))
+        g_res_flat = res_acc / self.gas
         self.streamer.reset()  # device copies are stale after write-back
         self.store.flush_writes()  # one barrier per step, not per layer
 
-        g_res = self._add(g_res, self._embed_vjp(self.resident, inputs,
-                                                 positions, g_x))
-        res_flat = np.asarray(jax.device_get(self._flatten(g_res)))
+        res_leaves = jax.tree_util.tree_leaves(self.resident)
         g_res_host = jax.tree_util.tree_unflatten(
-            jax.tree_util.tree_structure(g_res),
-            self._unflatten_host(
-                res_flat,
-                [l.shape for l in jax.tree_util.tree_leaves(g_res)]))
+            jax.tree_util.tree_structure(self.resident),
+            self._unflatten_host(g_res_flat,
+                                 [l.shape for l in res_leaves]))
         new_res_master = self.resident_opt.step(g_res_host)
         self.resident = jax.device_put(tree_cast(new_res_master, self.dtype))
         self.step_count += 1

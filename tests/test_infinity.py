@@ -103,3 +103,25 @@ def test_initialize_routes_layered_spec_to_infinity(tmp_path):
         deepspeed_tpu.initialize(model=spec, config={
             "train_micro_batch_size_per_gpu": 4,
             "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+
+
+def test_infinity_gradient_accumulation_matches_big_batch():
+    """gas=2 over two micro-batches must walk the same trajectory as gas=1
+    on the concatenated batch (mean-loss semantics make the mean of
+    micro-grads equal the big-batch grad)."""
+    params = init_gpt_params(DEEP, seed=4)
+    spec = make_gpt_layered_model(cfg=DEEP, name="inf", params=params)
+    big = _batches(3, B=8, seed=11)
+
+    e_gas = InfinityEngine(spec, lr=1e-2, dtype=jnp.float32,
+                           offload_device="cpu",
+                           gradient_accumulation_steps=2)
+    e_ref = InfinityEngine(spec, lr=1e-2, dtype=jnp.float32,
+                           offload_device="cpu")
+    for step, b in enumerate(big):
+        l1 = e_gas.train_batch(b)    # split internally into 2 micro-batches
+        l2 = e_ref.train_batch(b)    # one big batch
+        np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=2e-4,
+                                   err_msg=f"step {step}")
+    e_gas.release()
+    e_ref.release()
