@@ -3,11 +3,26 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-`vs_baseline` is our model-flops utilization (MFU) divided by the reference's
-best published GPT MFU on A100 — 204.49 TFLOPs/GPU of 312 peak = 0.655
-(`docs/_posts/2022-07-26-deepspeed-azure.md:97`, see BASELINE.md). That compares
-"how well each framework drives its own silicon", the only meaningful
-cross-hardware comparison available.
+`vs_baseline` compares "how well each framework drives its own silicon" —
+our model-flops utilization (MFU) over the reference's best published GPT
+MFU on A100 — computed on the SAME flops convention for both sides.
+
+The reference's 204.49 TFLOPs/GPU (`docs/_posts/2022-07-26-deepspeed-azure.md:97`)
+is computed with the Megatron-paper formula stated in that same post
+(`:91-93`): 96*B*s*l*h^2*(1 + s/6h + V/16lh) — the factor-8 "hardware flops"
+convention that counts the full activation-checkpointing forward recompute
+as throughput (8 = 2 fwd + 4 bwd + 2 recompute passes per matmul; the
+model-flops version of the identical formula is 72*... = factor 6). Our
+bench reports strict 6N model flops (no recompute credit — we use selective
+remat precisely so most of the recompute never happens). Comparing our 6N
+MFU against their factor-8 number would hand the reference a free 33%:
+  reference, model-flops convention: 204.49 * 6/8 = 153.4 TF / 312 peak = 0.4916
+  (at 175B the formula's attention/vocab correction terms are <1%, so the
+  6/8 rescale is exact to 3 digits)
+So vs_baseline = our_6N_mfu / 0.4916. Both conventions are reported in
+`extra`: `mfu` (6N, the honest one — excludes our remat recompute AND the
+attention einsums) and `mfu_megatron` (their factor-8 formula applied to our
+run verbatim, for a like-for-like read against 204.49/312 = 0.655).
 
 Default shape mirrors the reference's headline benchmark (seq 512, micro-bs
 near capacity — their 204.49 TFLOPs number is GPT-175B at mbs 32/seq 512 on
@@ -128,13 +143,22 @@ def main():
     samples_per_sec = engine.train_batch_size() / step_time
     samples_per_sec_chip = samples_per_sec / n_chips
 
-    # 6 * N * tokens flops per fwd+bwd (remat adds ~1 fwd → factor 8 if remat on;
-    # report standard 6N convention like the reference's flops profiler)
+    # 6 * N * tokens model flops (no recompute credit); the reference baseline
+    # number uses the Megatron factor-8 formula — see module docstring for the
+    # convention reconciliation behind vs_baseline.
     n_params = cfg.num_params()
-    flops_per_step = 6.0 * n_params * engine.train_batch_size() * seq
+    tokens_per_step = engine.train_batch_size() * seq
+    flops_per_step = 6.0 * n_params * tokens_per_step
     tflops_per_chip = flops_per_step / step_time / n_chips / 1e12
-    mfu = tflops_per_chip / peak_bf16_tflops()
-    vs_baseline = mfu / 0.655
+    peak = peak_bf16_tflops()
+    mfu = tflops_per_chip / peak
+    # reference's own formula applied to our run verbatim (azure post :91-93)
+    h, l, V = cfg.d_model, cfg.n_layer, cfg.vocab_size
+    megatron_flops = (96.0 * engine.train_batch_size() * seq * l * h * h
+                      * (1 + seq / (6.0 * h) + V / (16.0 * l * h)))
+    mfu_megatron = megatron_flops / step_time / n_chips / 1e12 / peak
+    REF_MODEL_FLOPS_MFU = 204.49 * (6.0 / 8.0) / 312.0  # = 0.4916
+    vs_baseline = mfu / REF_MODEL_FLOPS_MFU
 
     print(json.dumps({
         "metric": f"{model_name}_bf16_zero{engine.zero_stage}_train_samples_per_sec_per_chip",
@@ -145,6 +169,8 @@ def main():
             "step_time_ms": round(step_time * 1e3, 2),
             "tflops_per_chip": round(tflops_per_chip, 2),
             "mfu": round(mfu, 4),
+            "mfu_megatron": round(mfu_megatron, 4),
+            "ref_mfu_model_flops": round(REF_MODEL_FLOPS_MFU, 4),
             "seq_len": seq,
             "global_batch": engine.train_batch_size(),
             "n_chips": n_chips,

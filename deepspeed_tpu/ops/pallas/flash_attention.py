@@ -38,10 +38,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
     # q_ref: [block_q, D]; k_ref/v_ref: [T, D]; o_ref: [block_q, D];
     # lse_ref: [T//block_q, block_q] (whole-array block; row qi written per program —
     # TPU grid iterations run sequentially, so disjoint row writes are safe)
+    #
+    # Dots run on NATIVE-dtype operands (bf16 in, fp32 out via
+    # preferred_element_type): casting inputs to fp32 first forces the MXU's
+    # fp32 path (~4x slower) and was measured to make the whole kernel lose
+    # to XLA attention at seq 512. `p` narrows back to the input dtype for
+    # the p@v dot — standard TPU flash practice; softmax stats stay fp32.
     qi = pl.program_id(1)
     block_q, D = q_ref.shape
     T = k_ref.shape[0]
-    q = q_ref[:, :].astype(jnp.float32) * sm_scale
+    in_dtype = q_ref.dtype
+    q = q_ref[:, :]
 
     nblocks = T // block_k
     if causal:
@@ -52,10 +59,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
 
     def body(j, carry):
         acc, m_prev, l_prev = carry
-        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [bq, bk]
+                                preferred_element_type=jnp.float32) * sm_scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -66,7 +73,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
         p = jnp.exp(s - m_new[:, None])
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(in_dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
     acc0 = jnp.zeros((block_q, D), jnp.float32)
@@ -119,8 +127,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qi = pl.program_id(1)
     block_q, D = q_ref.shape
     T = k_ref.shape[0]
-    q = q_ref[:, :].astype(jnp.float32) * sm_scale
-    do = do_ref[:, :].astype(jnp.float32)
+    in_dtype = q_ref.dtype
+    q = q_ref[:, :]
+    do = do_ref[:, :]
     lse = lse_ref[qi, :]
     delta = delta_ref[qi, :]
 
@@ -129,10 +138,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         if causal else nblocks
 
     def body(j, dq):
-        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * sm_scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -140,7 +149,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = (p * (dp - delta[:, None])).astype(in_dtype)
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
@@ -153,30 +162,31 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
     ki = pl.program_id(1)
     block_k, D = k_ref.shape
     T = q_ref.shape[0]
-    k = k_ref[:, :].astype(jnp.float32)
-    v = v_ref[:, :].astype(jnp.float32)
+    in_dtype = k_ref.dtype
+    k = k_ref[:, :]
+    v = v_ref[:, :]
 
     nblocks = T // block_q
     start = (ki * block_k) // block_q if causal else 0
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32) * sm_scale
-        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[pl.ds(i * block_q, block_q), :]
+        do = do_ref[pl.ds(i * block_q, block_q), :]
         lse = lse_ref[i, :]
         delta = delta_ref[i, :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [bq, bk]
+                                preferred_element_type=jnp.float32) * sm_scale
         if causal:
             q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                                 # [bq, bk]
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        dv = dv + jax.lax.dot_general(p.astype(in_dtype), do, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = (p * (dp - delta[:, None])).astype(in_dtype)
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
@@ -184,7 +194,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
     dk0 = jnp.zeros((block_k, D), jnp.float32)
     dv0 = jnp.zeros((block_k, D), jnp.float32)
     dk, dv = jax.lax.fori_loop(start, nblocks, body, (dk0, dv0))
-    dk_ref[:, :] = dk.astype(dk_ref.dtype)
+    dk_ref[:, :] = (dk * sm_scale).astype(dk_ref.dtype)
     dv_ref[:, :] = dv.astype(dv_ref.dtype)
 
 
