@@ -15,6 +15,7 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <fcntl.h>
@@ -118,20 +119,48 @@ class AioHandle {
         int flags = req.is_write ? (O_WRONLY | O_CREAT) : O_RDONLY;
         // O_DIRECT (NVMe queue-depth path: no page cache, no write-back
         // serialization) needs 4K-aligned buffer/offset/length — the Python
-        // swapper pads its staging buffers; unaligned requests and
-        // filesystems without O_DIRECT (tmpfs) fall back to buffered I/O.
+        // swapper pads its read staging buffers; unaligned WRITE buffers are
+        // bounced through an aligned copy HERE, in the worker thread (a
+        // submit-side copy would serialize the async-submit window, and a
+        // buffered write mixed with later O_DIRECT reads of the same file
+        // leans on page-cache flush ordering, which open(2) discourages).
+        // Unaligned reads and filesystems without O_DIRECT (tmpfs) still
+        // fall back to buffered I/O.
         const int64_t kAlign = 4096;
-        bool direct = use_odirect_ && aligned(req.buffer, req.num_bytes, kAlign)
-                      && (req.file_offset % kAlign) == 0;
+        char* bounce = nullptr;
+        char* data = static_cast<char*>(req.buffer);
+        int64_t nbytes = req.num_bytes;
+        bool direct = use_odirect_ && (req.file_offset % kAlign) == 0;
+        if (direct && !aligned(req.buffer, req.num_bytes, kAlign)) {
+            if (req.is_write) {
+                int64_t padded = (req.num_bytes + kAlign - 1) / kAlign * kAlign;
+                void* p = nullptr;
+                if (::posix_memalign(&p, kAlign, padded) == 0) {
+                    bounce = static_cast<char*>(p);
+                    ::memcpy(bounce, req.buffer, req.num_bytes);
+                    ::memset(bounce + req.num_bytes, 0,
+                             padded - req.num_bytes);  // slack to the 4K pad
+                    data = bounce;
+                    nbytes = padded;
+                } else {
+                    direct = false;
+                }
+            } else {
+                direct = false;
+            }
+        }
         int fd = -1;
         if (direct) fd = ::open(req.path.c_str(), flags | O_DIRECT, 0644);
         if (fd < 0) {
             direct = false;
             fd = ::open(req.path.c_str(), flags, 0644);
         }
-        if (fd < 0) return false;
-        char* buf = static_cast<char*>(req.buffer);
-        int64_t remaining = req.num_bytes;
+        if (fd < 0) {
+            ::free(bounce);
+            return false;
+        }
+        char* buf = data;
+        int64_t remaining = nbytes;
         int64_t offset = req.file_offset;
         bool ok = true;
         while (remaining > 0) {
@@ -146,7 +175,7 @@ class AioHandle {
                     ::close(fd);
                     direct = false;
                     fd = ::open(req.path.c_str(), flags, 0644);
-                    if (fd < 0) return false;
+                    if (fd < 0) { ::free(bounce); return false; }
                     continue;
                 }
                 ok = false;
@@ -171,6 +200,7 @@ class AioHandle {
                 ::ftruncate(fd, padded);
         }
         ::close(fd);
+        ::free(bounce);
         return ok;
     }
 

@@ -197,7 +197,10 @@ def load_universal_checkpoint(engine, load_dir, tag="universal", strict=True,
     if meta_file.exists():
         with open(meta_file) as f:
             meta = json.load(f)
-    if meta.get("step") is not None:
+    if meta.get("step") is not None and load_optimizer_states:
+        # counters ride with the optimizer state: a weights-only warm start
+        # keeps fresh step/LR-schedule counters (reference module-only load
+        # semantics, `runtime/engine.py` load_module_only)
         state = state._replace(step=jax.device_put(
             jnp.asarray(meta["step"], state.step.dtype), state.step.sharding))
     if meta.get("scaler") and load_optimizer_states:
@@ -218,7 +221,8 @@ def load_universal_checkpoint(engine, load_dir, tag="universal", strict=True,
                 jnp.asarray(sc["hysteresis_left"], old.hysteresis_left.dtype),
                 old.hysteresis_left.sharding)))
     engine.state = state
-    if meta.get("global_steps") is not None and hasattr(engine, "global_steps"):
+    if meta.get("global_steps") is not None and load_optimizer_states \
+            and hasattr(engine, "global_steps"):
         engine.global_steps = int(meta["global_steps"])  # keep counters in sync
     log_dist(f"loaded universal checkpoint from {in_dir} "
              f"(optimizer state {'restored' if opt_flat else 'reset'})",

@@ -355,13 +355,48 @@ def scatter(tensor, scatter_list=None, src=0, axis=None, group=None):
 def all_to_all_single(output=None, input=None, output_split_sizes=None,
                       input_split_sizes=None, axis=None, group=None):
     """Reference `all_to_all_single` (one tensor split/concat on dim 0).
-    Uneven splits have no static-shape SPMD formulation — fail loudly."""
-    if output_split_sizes is not None or input_split_sizes is not None:
-        raise NotImplementedError(
-            "all_to_all_single: uneven output/input_split_sizes are not "
-            "supported (static-shape SPMD) — pad to even splits")
-    tensor = input if input is not None else output
-    return all_to_all(tensor, axis=axis, group=group, split_axis=0, concat_axis=0)
+
+    Even splits run the native tiled `lax.all_to_all`. Uneven splits have no
+    static-shape SPMD formulation, so they go pad → exchange → slice: in the
+    eager facade's global view the input is the concatenation of W per-rank
+    blocks (each `sum(split_sizes)` long, chunk r of every block addressed to
+    rank r); each chunk pads to `max(split_sizes)`, one even exchange runs,
+    and the output re-assembles as the concatenation of W per-rank receive
+    blocks (rank r's block is its W received chunks, `split_sizes[r]` each —
+    exactly torch's per-rank `output_split_sizes = [in_splits[r]] * W`)."""
+    tensor = jnp.asarray(input if input is not None else output)
+    if output_split_sizes is None and input_split_sizes is None:
+        return all_to_all(tensor, axis=axis, group=group, split_axis=0,
+                          concat_axis=0)
+    splits = [int(s) for s in (input_split_sizes
+                               if input_split_sizes is not None
+                               else output_split_sizes)]
+    axes = _axis_tuple(axis if axis is not None else group)
+    W = mesh_mod.axis_size(axes)
+    assert len(splits) == W, (len(splits), W)
+    if output_split_sizes is not None and input_split_sizes is not None:
+        assert list(map(int, output_split_sizes)) == splits, \
+            "global-view uneven all_to_all_single needs symmetric splits " \
+            "(every rank shares one split list)"
+    S = sum(splits)
+    rest = tensor.shape[1:]
+    assert tensor.shape[0] == W * S, (tensor.shape, W, S)
+    m = max(splits)
+    if m * W == S:   # actually even
+        return all_to_all(tensor, axis=axis, group=group, split_axis=0,
+                          concat_axis=0)
+    blocks = tensor.reshape(W, S, *rest)
+    offs = np.cumsum([0] + splits)
+    padded = jnp.stack(
+        [jnp.pad(blocks[:, offs[r]:offs[r + 1]],
+                 ((0, 0), (0, m - splits[r])) + ((0, 0),) * len(rest))
+         for r in range(W)], axis=1)                     # [W_send, W_recv, m, ...]
+    ex = all_to_all(padded.reshape(W * W * m, *rest), axis=axis, group=group,
+                    split_axis=0, concat_axis=0)         # block transpose
+    ex = ex.reshape(W, W, m, *rest)                      # [W_recv, W_send, m]
+    return jnp.concatenate(
+        [ex[r, :, :splits[r]].reshape(W * splits[r], *rest) for r in range(W)],
+        axis=0)
 
 
 def all_gather_into_tensor(output_tensor=None, input_tensor=None, axis=None,
@@ -445,19 +480,32 @@ def _data_domain_is_world() -> bool:
                          mesh_mod.TENSOR_AXIS))
 
 
-def get_global_rank(group=None, group_rank=0):
-    """Reference `get_global_rank`. Identity for the world group (and for the
-    data domain when it spans the whole mesh); for a sub-axis group the
-    mapping depends on mesh position, which a flat group_rank cannot express —
-    fail loudly rather than return a wrong rank (same policy as the eager p2p
-    stubs)."""
+def get_global_rank(group=None, group_rank=0, coords=None):
+    """Reference `get_global_rank`: group-local rank → global (device) rank.
+
+    Global ranks are lexicographic positions in `mesh.devices` (the order the
+    launcher lays world ranks onto the mesh). A sub-axis group has one
+    INSTANCE per coordinate of the non-group axes — information torch carries
+    in the group object; pass it as `coords` ({axis_name: coord}, default 0s
+    = the first instance, matching the reference's common
+    `get_global_rank(tp_group, 0)` leader lookup — reference
+    `utils/groups.py:473` derives the same thing from topology)."""
     if group is None or _axis_tuple(group) == tuple(mesh_mod.ALL_AXES):
         return group_rank
     if _axis_tuple(group) == tuple(mesh_mod.ZERO_AXES) and _data_domain_is_world():
         return group_rank
-    raise NotImplementedError(
-        "get_global_rank for a sub-axis group: ranks are mesh coordinates on "
-        "TPU — derive positions from comm.mesh.get_mesh().devices instead")
+    mesh = mesh_mod.get_mesh()
+    names = list(mesh.axis_names)
+    shape = [mesh.shape[n] for n in names]
+    gaxes = [n for n in names if n in _axis_tuple(group)]
+    assert gaxes, f"unknown group axes {group} for mesh axes {names}"
+    gshape = [mesh.shape[n] for n in gaxes]
+    total = int(np.prod(gshape))
+    assert 0 <= group_rank < total, (group_rank, total)
+    gcoords = dict(zip(gaxes, np.unravel_index(group_rank, gshape)))
+    fixed = dict(coords or {})
+    full = [int(gcoords.get(n, fixed.get(n, 0))) for n in names]
+    return int(np.ravel_multi_index(full, shape))
 
 
 def get_world_group():

@@ -69,6 +69,10 @@ class GPTConfig:
                                      # saved ~150MB/layer from HBM; kept as an
                                      # option for bandwidth-rich parts
     use_flash_attention: bool = False  # pallas kernel (ops/pallas/flash_attention.py)
+    loss_chunks: int = 0             # >0: chunked-vocab CE (ops/chunked_ce.py)
+                                     # — never materializes [B,T,V] logits;
+                                     # frees ~1.2G peak HBM at 50k vocab for
+                                     # one extra head-matmul pass in the bwd
     softmax_dtype: Any = jnp.float32  # attention softmax accumulation dtype;
                                      # bf16 halves the dominant HBM traffic of
                                      # materialized attention (max-subtracted,
@@ -102,14 +106,20 @@ class GPTConfig:
         return emb + head + wpe + self.n_layer * per_block
 
 
-# Reference model sizes used in the baseline ladder (BASELINE.md).
+# Reference model sizes used in the baseline ladder (BASELINE.md). Head counts
+# for the training-bench sizes are chosen so head_dim == 128, the MXU lane
+# width (head_dim 64/96 leaves 25-50% of every attention dot's lanes padded —
+# measured +14% MFU on the 1.3B lane, +3.5% on 760m). Param count is
+# head-count invariant, and the reference's own ZeRO tutorial picks 16 heads
+# for its 1.5B GPT-2 (`docs/_tutorials/zero.md:35`); HF-checkpoint adapters
+# (`inference/adapters.py`) carry each checkpoint's true head count instead.
 GPT2_CONFIGS = {
     "gpt2-tiny": GPTConfig(n_layer=2, n_head=4, d_model=128, max_seq_len=256, vocab_size=1024),
     "gpt2-125m": GPTConfig(n_layer=12, n_head=12, d_model=768, max_seq_len=1024),
-    "gpt2-350m": GPTConfig(n_layer=24, n_head=16, d_model=1024, max_seq_len=1024),
-    "gpt2-760m": GPTConfig(n_layer=24, n_head=16, d_model=1536, max_seq_len=1024),
-    "gpt2-1.3b": GPTConfig(n_layer=24, n_head=32, d_model=2048, max_seq_len=1024),
-    "gpt2-2.7b": GPTConfig(n_layer=32, n_head=32, d_model=2560, max_seq_len=1024),
+    "gpt2-350m": GPTConfig(n_layer=24, n_head=8, d_model=1024, max_seq_len=1024),
+    "gpt2-760m": GPTConfig(n_layer=24, n_head=12, d_model=1536, max_seq_len=1024),
+    "gpt2-1.3b": GPTConfig(n_layer=24, n_head=16, d_model=2048, max_seq_len=1024),
+    "gpt2-2.7b": GPTConfig(n_layer=32, n_head=20, d_model=2560, max_seq_len=1024),
     "gpt2-6.7b": GPTConfig(n_layer=32, n_head=32, d_model=4096, max_seq_len=1024),
 }
 
@@ -488,15 +498,24 @@ def _residual_mlp(x, attn_out, p, cfg: GPTConfig, constrain=True, mlp_fn=None):
     return x + mlp_fn(h2)
 
 
+def _head_table(params, cfg: GPTConfig):
+    """The (tied) LM-head weight table [V, D] — single source of truth."""
+    return params["lm_head"] if not cfg.tie_embeddings else params["wte"]
+
+
+def _head_logits(params, x, cfg: GPTConfig):
+    """LM-head matmul (+ GPT-J's tied bias). x: [B, T, D] -> [B, T, V]."""
+    logits = jnp.einsum("btd,vd->btv", x, _head_table(params, cfg).astype(x.dtype))
+    if "lm_head_bias" in params:  # GPT-J ties a bias to the LM head
+        logits = logits + params["lm_head_bias"].astype(logits.dtype)
+    return logits
+
+
 def _lm_head(params, x, cfg: GPTConfig):
     """Final norm + (tied) LM head. x: [B, T, D] -> logits [B, T, V]."""
     x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm,
               cfg.norm_eps)
-    head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
-    logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
-    if "lm_head_bias" in params:  # GPT-J ties a bias to the LM head
-        logits = logits + params["lm_head_bias"].astype(logits.dtype)
-    return logits
+    return _head_logits(params, x, cfg)
 
 
 def _embed(params, tokens, positions, cfg: GPTConfig):
@@ -530,8 +549,8 @@ def _block(x, p, cfg: GPTConfig, positions, dropout_rng=None, attn_fn=None,
     return shard_constraint(x, BATCH_AXES, SEQ_AXIS, None)
 
 
-def gpt_forward(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None):
-    """tokens: [B, T] int32 → logits [B, T, vocab]."""
+def gpt_hidden(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None):
+    """tokens: [B, T] int32 → final-norm'd hidden states [B, T, D]."""
     B, T = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
@@ -558,7 +577,14 @@ def gpt_forward(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None):
             return block_fn(x, layer_params, flag), None
         x, _ = jax.lax.scan(scan_body, x, (params["blocks"], flags))
 
-    return _lm_head(params, x, cfg)
+    return _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.use_rmsnorm,
+                 cfg.norm_eps)
+
+
+def gpt_forward(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None):
+    """tokens: [B, T] int32 → logits [B, T, vocab]."""
+    x = gpt_hidden(params, tokens, cfg, positions=positions, attn_fn=attn_fn)
+    return _head_logits(params, x, cfg)
 
 
 def gpt_loss(params, batch, rng, cfg: GPTConfig, attn_fn=None):
@@ -569,6 +595,17 @@ def gpt_loss(params, batch, rng, cfg: GPTConfig, attn_fn=None):
         inputs, labels = tokens[:, :-1], tokens[:, 1:]
     else:
         inputs = tokens
+    if cfg.loss_chunks:
+        from deepspeed_tpu.ops.chunked_ce import chunked_softmax_xent
+        B, T = inputs.shape
+        x = gpt_hidden(params, inputs, cfg, attn_fn=attn_fn)
+        assert "lm_head_bias" not in params, \
+            "chunked CE does not support a tied LM-head bias"
+        head = _head_table(params, cfg)
+        nll = chunked_softmax_xent(x.reshape(B * T, -1), head.astype(x.dtype),
+                                   labels.reshape(B * T), cfg.loss_chunks)
+        mask = (labels.reshape(B * T) >= 0).astype(jnp.float32)
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
     logits = gpt_forward(params, inputs, cfg, attn_fn=attn_fn)
     # cross entropy WITHOUT materializing an fp32 [B,T,V] buffer (1.65G at
     # mbs16/seq512/50k vocab): logits stay in compute dtype, the exp/sum runs

@@ -125,15 +125,20 @@ def _fwd_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
     # q_ref: [block_q, D]; k/v_ref: [T, D]; lse_ref: [nbq, block_q] whole
     h, qi = pl.program_id(1), pl.program_id(2)
     block_q, D = q_ref.shape
-    q = q_ref[:, :].astype(jnp.float32)
+    # dots run on native-dtype operands (bf16 in, fp32 accumulate) — casting
+    # inputs to fp32 first forces the MXU's ~4x-slower fp32 path (same fix as
+    # flash_attention.py); p/ds narrow back to the input dtype for the second
+    # dot of each pair, softmax stats stay fp32
+    in_dtype = q_ref.dtype
+    q = q_ref[:, :]
     n_visit = counts_ref[h, qi]
 
     def body(t, carry):
         acc, m_prev, l_prev = carry
         j = idx_ref[h, qi, t]
         start = pl.multiple_of(j * BLOCK_K, BLOCK_K)
-        k = k_ref[pl.ds(start, BLOCK_K), :].astype(jnp.float32)
-        v = v_ref[pl.ds(start, BLOCK_K), :].astype(jnp.float32)
+        k = k_ref[pl.ds(start, BLOCK_K), :]
+        v = v_ref[pl.ds(start, BLOCK_K), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         tile = _select_cols(layout_ref[:, :], j, FPK_K)
@@ -146,7 +151,8 @@ def _fwd_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
         p = jnp.exp(s - m_new[:, None])
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(in_dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
     acc0 = jnp.zeros((block_q, D), jnp.float32)
@@ -162,8 +168,9 @@ def _bwd_dq_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
                    do_ref, lse_ref, delta_ref, dq_ref, *, causal):
     h, qi = pl.program_id(1), pl.program_id(2)
     block_q, D = q_ref.shape
-    q = q_ref[:, :].astype(jnp.float32)
-    do = do_ref[:, :].astype(jnp.float32)
+    in_dtype = q_ref.dtype
+    q = q_ref[:, :]
+    do = do_ref[:, :]
     lse = lse_ref[qi, :]
     delta = delta_ref[qi, :]
     n_visit = counts_ref[h, qi]
@@ -171,8 +178,8 @@ def _bwd_dq_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
     def body(t, dq):
         j = idx_ref[h, qi, t]
         start = pl.multiple_of(j * BLOCK_K, BLOCK_K)
-        k = k_ref[pl.ds(start, BLOCK_K), :].astype(jnp.float32)
-        v = v_ref[pl.ds(start, BLOCK_K), :].astype(jnp.float32)
+        k = k_ref[pl.ds(start, BLOCK_K), :]
+        v = v_ref[pl.ds(start, BLOCK_K), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         tile = _select_cols(layout_ref[:, :], j, FPK_K)
@@ -182,7 +189,7 @@ def _bwd_dq_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = (p * (dp - delta[:, None])).astype(in_dtype)
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
@@ -197,8 +204,9 @@ def _bwd_dkv_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
     # layout_ref is this k-row of layout^T: [FPK_K, n16].
     h, ki = pl.program_id(1), pl.program_id(2)
     block_k, D = dk_ref.shape
-    k = k_ref[:, :].astype(jnp.float32)
-    v = v_ref[:, :].astype(jnp.float32)
+    in_dtype = k_ref.dtype
+    k = k_ref[:, :]
+    v = v_ref[:, :]
     n_visit = counts_ref[h, ki]
     fq = block_q // FINE
 
@@ -206,8 +214,8 @@ def _bwd_dkv_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
         dk, dv = carry
         i = idx_ref[h, ki, t]
         start = pl.multiple_of(i * block_q, block_q)
-        q = q_ref[pl.ds(start, block_q), :].astype(jnp.float32)
-        do = do_ref[pl.ds(start, block_q), :].astype(jnp.float32)
+        q = q_ref[pl.ds(start, block_q), :]
+        do = do_ref[pl.ds(start, block_q), :]
         lse = _select_row(lse_ref[:, :], i)
         delta = _select_row(delta_ref[:, :], i)
         sT = jax.lax.dot_general(k, q, (((1,), (1,)), ((), ())),
@@ -218,11 +226,11 @@ def _bwd_dkv_kernel(counts_ref, idx_ref, layout_ref, q_ref, k_ref, v_ref,
             sT = jnp.where(_causal_tile(i, block_q, ki, transpose=True),
                            sT, NEG_INF)
         pT = jnp.exp(sT - lse[None, :])
-        dv = dv + jax.lax.dot_general(pT, do, (((1,), (0,)), ((), ())),
+        dv = dv + jax.lax.dot_general(pT.astype(in_dtype), do, (((1,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dpT = jax.lax.dot_general(v, do, (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.float32)  # [bk, bq]
-        dsT = pT * (dpT - delta[None, :])
+        dsT = (pT * (dpT - delta[None, :])).astype(in_dtype)
         dk = dk + jax.lax.dot_general(dsT, q, (((1,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
@@ -251,7 +259,7 @@ def _normalize_16(layout, block):
     return layout.reshape(H, n16, r, n16, r).any((2, 4))
 
 
-def _build(layout, T, block, block_q):
+def _build(layout, T, block, block_q, causal=False):
     """Host-side static prep: 16-granular fine masks (f32, both orientations)
     + visit lists at (block_q x BLOCK_K) granularity, all numpy."""
     fine = _normalize_16(layout, block)                # [H, n16, n16]
@@ -263,6 +271,15 @@ def _build(layout, T, block, block_q):
     coarse = fine.reshape(H, nbq, fq, nbk, FPK_K).any((2, 4))
     assert coarse.any(-1).all(), \
         "sparsity layout has a fully-masked query row (undefined softmax)"
+    if causal:
+        # the intersection with the token-granular causal mask must also keep
+        # >=1 key per query row (else m stays -inf and the kernel emits a
+        # spurious mean-of-V with bogus grads): a fine row survives iff some
+        # visited fine tile lies on or below the diagonal — a strictly-upper
+        # layout row dies even though the layout-only check above passes
+        assert np.tril(np.ones((n16, n16), bool))[None].__and__(fine).any(-1).all(), \
+            "causal=True: some query row's visited blocks are entirely in " \
+            "the future (fully masked after the causal intersection)"
     counts, idx = _visit_lists(coarse)
     countsT, idxT = _visit_lists(coarse.transpose(0, 2, 1))
     fineT = fine.transpose(0, 2, 1)
@@ -294,7 +311,7 @@ def block_sparse_attention(q, k, v, layout, block=16, sm_scale=None,
         # head-broadcast layout (the configs allow num_heads=1 shared layouts)
         layout = np.broadcast_to(layout, (H,) + layout.shape[1:])
     assert layout.shape[0] == H, (layout.shape, H)
-    args = _build_cached(layout, T, block, block_q)
+    args = _build_cached(layout, T, block, block_q, bool(causal))
     return _sparse(q, k, v, *args, float(sm_scale), int(block_q),
                    bool(causal), bool(interpret))
 
@@ -302,7 +319,7 @@ def block_sparse_attention(q, k, v, layout, block=16, sm_scale=None,
 _BUILD_CACHE = {}
 
 
-def _build_cached(layout, T, block, block_q):
+def _build_cached(layout, T, block, block_q, causal=False):
     """Memoize _build's host-side visit-list loops — eager per-token callers
     would otherwise redo O(H*nq*nk) Python work every call. Cached values are
     HOST numpy, converted per call site: caching jnp arrays would capture
@@ -310,10 +327,10 @@ def _build_cached(layout, T, block, block_q):
     later traces (observed UnexpectedTracerError)."""
     # key on the bytes themselves, not hash(): a 64-bit collision between two
     # same-shape layouts would silently serve the wrong sparsity pattern
-    key = (layout.tobytes(), layout.shape, T, block, block_q)
+    key = (layout.tobytes(), layout.shape, T, block, block_q, causal)
     if key not in _BUILD_CACHE:
         (counts, idx, fine, countsT, idxT, fineT, _, _) = \
-            _build(layout, T, block, block_q)
+            _build(layout, T, block, block_q, causal)
         _BUILD_CACHE[key] = (counts, idx, fine, countsT, idxT, fineT)
         if len(_BUILD_CACHE) > 32:  # bound resident mask tables
             _BUILD_CACHE.pop(next(iter(_BUILD_CACHE)))

@@ -1152,6 +1152,9 @@ def initialize(args=None,
         # silently diverging from the reference semantics
         assert training_data is None, \
             "Infinity tier: feed batches to train_batch directly (no dataloader)"
+        assert model_parameters is None, \
+            "Infinity tier: the LayeredModelSpec carries its own params " \
+            "(resident + blocks); model_parameters is not honored"
         _, inf_mbs, gas = cfg.resolve_batch_sizes(1)
         assert not cfg.fp16_enabled, \
             "Infinity tier: use bf16 compute (no dynamic loss scaling on " \

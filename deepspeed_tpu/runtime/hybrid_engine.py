@@ -40,15 +40,18 @@ class HybridEngine(Engine):
         self._decode_spec = decode_spec
         self._generate_fn = None
 
-    def _build_generate(self, max_new, greedy, temperature):
+    def _build_generate(self, max_new, greedy, temperature, top_k):
         spec = self._decode_spec
         assert spec is not None, "HybridEngine needs a DecodeModelSpec (set_decode_spec)"
+        # one sampling rule across the framework: the inference engines'
+        # sample_logits (greedy / temperature / top-k) — the RLHF rollout
+        # path must not grow a second, weaker sampler (reference
+        # `hybrid_engine.py:174` generates through its inference module)
+        from deepspeed_tpu.inference.engine import sample_logits
 
         def sample(logits, rng):
-            if greedy:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return jax.random.categorical(rng, logits / jnp.maximum(temperature, 1e-6),
-                                          axis=-1).astype(jnp.int32)
+            return sample_logits(logits, None if greedy else rng, greedy=greedy,
+                                 temperature=temperature, top_k=top_k)
 
         def generate(params, tokens, cache, prompt_len, rng):
             logits, cache = spec.prefill_fn(params, tokens, cache, None)
@@ -70,11 +73,12 @@ class HybridEngine(Engine):
         return jax.jit(generate)
 
     def generate(self, tokens, max_new_tokens=32, greedy=True, temperature=1.0,
-                 rng=None):
+                 top_k=0, rng=None):
         """Rollout with the CURRENT training params (reference `generate` :174)."""
-        key = (max_new_tokens, greedy, float(temperature))
+        key = (max_new_tokens, greedy, float(temperature), int(top_k))
         if self._generate_fn is None or getattr(self, "_gen_key", None) != key:
-            self._generate_fn = self._build_generate(max_new_tokens, greedy, temperature)
+            self._generate_fn = self._build_generate(max_new_tokens, greedy,
+                                                     temperature, top_k)
             self._gen_key = key
         tokens = jnp.asarray(tokens)
         B, T = tokens.shape

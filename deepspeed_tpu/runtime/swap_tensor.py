@@ -61,14 +61,15 @@ class AsyncTensorSwapper:
 
         Zero-copy submit: the caller's buffer is handed to the AIO threads
         as-is (a staging memcpy here would serialize the submit phase — the
-        window where the next step's compute overlaps this swap-out). The
-        O_DIRECT fast path engages only when the buffer is already 4K-aligned
-        AND 4K-sized (e.g. from `aligned_empty`); anything else goes through
-        the buffered fallback in csrc/aio."""
+        window where the next step's compute overlaps this swap-out).
+        Arbitrarily-aligned buffers stay O_DIRECT end-to-end anyway: the
+        WORKER thread bounces them through an aligned copy before the pwrite
+        (csrc/aio), so the file never mixes buffered writes with O_DIRECT
+        reads (a coherency pattern open(2) discourages)."""
         arr = np.ascontiguousarray(array)
         self._buffers[name] = arr
-        # exact length; file padding to the 4K read boundary is the grow-only
-        # ftruncate in csrc/aio, not a submit-side concern
+        # exact length; padding to the 4K read boundary happens in csrc/aio
+        # (bounce-buffer write length + grow-only ftruncate)
         self.lib.dstpu_aio_pwrite(self.handle, self.path_for(name).encode(),
                                   arr.ctypes.data, arr.nbytes, 0)
 

@@ -62,7 +62,7 @@ def reward_fn(rollouts):
 
 
 def rlhf_loop(steps=20, batch=16, prompt_len=8, max_new=8, seed=0,
-              verbose=True):
+              top_k=0, verbose=True):
     """generate -> reward -> policy-gradient train, on one set of params.
     Returns the per-step mean rewards."""
     cfg = GPTConfig(n_layer=2, n_head=4, d_model=64, d_ff=128, max_seq_len=64,
@@ -80,7 +80,7 @@ def rlhf_loop(steps=20, batch=16, prompt_len=8, max_new=8, seed=0,
         prompts = rng.integers(0, cfg.vocab_size, (batch, prompt_len)).astype(np.int32)
         # 1) rollout from the CURRENT training params
         rollouts = engine.generate(prompts, max_new_tokens=max_new,
-                                   greedy=False, temperature=1.0)
+                                   greedy=False, temperature=1.0, top_k=top_k)
         # 2) reward + centered advantage (REINFORCE baseline = batch mean)
         r = reward_fn(rollouts)
         adv = (r - r.mean()) / (r.std() + 1e-6)

@@ -98,6 +98,9 @@ def test_universal_checkpoint_optimizer_state_resumes_trajectory(tmp_path):
     # reset) diverges from the trajectory at the same point
     ec = _engine({"data": 2, "tensor": 4}, stage=1, seed=7)
     load_universal_checkpoint(ec, str(tmp_path), load_optimizer_states=False)
+    # a weights-only warm start keeps FRESH counters (reference module-only
+    # load): resuming mid-LR-schedule from step 0 is the caller's choice
+    assert int(ec.state.step) == 0 and ec.global_steps == 0
     ec.train_batch(batch2)
     reset_step = float(ec.train_batch(batch))
     assert abs(truth - reset_step) > 1e-5, (truth, reset_step)

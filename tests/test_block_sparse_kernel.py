@@ -127,6 +127,22 @@ def test_dead_query_row_rejected():
         block_sparse_attention(q, k, v, layout, block=16, block_q=128)
 
 
+def test_causal_dead_row_rejected():
+    """causal=True: a q row whose only visited blocks are strictly in the
+    future dies after the token-granular causal intersection even though the
+    layout-only check passes; _build must reject the combination."""
+    n = T // 16
+    layout = np.zeros((1, n, n), bool)
+    layout[:, :, -1] = True          # every row visits only the LAST k-block
+    layout[0, -1, 0] = True          # keep the final kernel row layout-alive
+    q, k, v = (x[:, :1] for x in _qkv(4))
+    # non-causal: legal (every row has a live block)
+    block_sparse_attention(q, k, v, layout, block=16, block_q=128)
+    with pytest.raises(AssertionError, match="causal"):
+        block_sparse_attention(q, k, v, layout, block=16, block_q=128,
+                               causal=True)
+
+
 @pytest.mark.tpu
 def test_tpu_sparse_speedup_at_8k():
     """Real-chip lane: at T=8k / ~26% density the kernel must beat the dense

@@ -232,9 +232,52 @@ class TestCommParitySurface:
         out = comm.scatter(None, scatter_list=chunks, axis="data")
         np.testing.assert_allclose(np.asarray(out),
                                    np.repeat(np.arange(8, dtype=np.float32), 2))
-        with pytest.raises(NotImplementedError, match="split_sizes"):
-            comm.all_to_all_single(input=jnp.arange(8.0), axis="data",
-                                   input_split_sizes=[1, 7])
+
+    def test_all_to_all_single_uneven(self):
+        """pad → exchange → slice path: result equals the numpy block
+        transpose at uneven chunk granularity."""
+        import deepspeed_tpu.comm as comm
+        self._mesh(data=4)
+        W, splits = 4, [1, 3, 0, 2]
+        S = sum(splits)
+        x = np.arange(W * S, dtype=np.float32)
+        out = np.asarray(comm.all_to_all_single(
+            input=jnp.asarray(x), axis="data", input_split_sizes=splits))
+        # expected: receiver block r = concat over senders s of sender s's
+        # chunk r (splits[r] long)
+        offs = np.cumsum([0] + splits)
+        blocks = x.reshape(W, S)
+        expect = np.concatenate(
+            [blocks[:, offs[r]:offs[r + 1]].reshape(-1) for r in range(W)])
+        np.testing.assert_allclose(out, expect)
+        assert out.shape == x.shape
+        # asymmetric split lists are rejected (no global-view formulation)
+        with pytest.raises(AssertionError, match="symmetric"):
+            comm.all_to_all_single(input=jnp.asarray(x), axis="data",
+                                   input_split_sizes=splits,
+                                   output_split_sizes=[2, 2, 1, 1])
+
+    def test_get_global_rank_sub_axis(self):
+        """Mesh-coordinate rank math for sub-axis groups (reference
+        utils/groups.py:473 role): global rank = lexicographic mesh position."""
+        import deepspeed_tpu.comm as comm
+        mesh = self._mesh(data=2, tensor=4)
+        names = list(mesh.axis_names)
+        # tensor group, first instance (data coord 0): ranks 0..3
+        t_idx, d_idx = names.index("tensor"), names.index("data")
+        for gr in range(4):
+            want = np.ravel_multi_index(
+                [gr if n == "tensor" else 0 for n in names],
+                [mesh.shape[n] for n in names])
+            assert comm.get_global_rank("tensor", gr) == want
+        # second data row via coords
+        got = comm.get_global_rank("tensor", 1, coords={"data": 1})
+        want = np.ravel_multi_index(
+            [1 if n in ("tensor", "data") else 0 for n in names],
+            [mesh.shape[n] for n in names])
+        assert got == want
+        # world group stays identity
+        assert comm.get_global_rank(comm.get_world_group(), 6) == 6
 
     def test_inference_all_reduce_honors_group(self):
         import deepspeed_tpu.comm as comm
@@ -255,8 +298,9 @@ class TestCommParitySurface:
         gath = comm.all_gather_coalesced(xs, axis="data")
         assert gath[0].shape == (8,) and gath[1].shape == (16,)
         assert comm.get_global_rank(None, 3) == 3
-        with pytest.raises(NotImplementedError):
-            comm.get_global_rank("tensor", 1)
+        # pure-data mesh: "tensor" has size 1 here -> sub-axis math still
+        # resolves (group rank 0 of a singleton axis = instance coords)
+        assert comm.get_global_rank("tensor", 0) == 0
 
     def test_destroy_process_group(self):
         import deepspeed_tpu.comm as comm

@@ -14,9 +14,48 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
 
 def test_rlhf_reward_improves():
     from rlhf import rlhf_loop
-    rewards = rlhf_loop(steps=14, verbose=False, seed=0)
+    # top_k=16: the rollout path samples through the shared sample_logits
+    # (greedy/temperature/top-k) like the inference engines
+    rewards = rlhf_loop(steps=14, verbose=False, seed=0, top_k=16)
     first, last = np.mean(rewards[:3]), np.mean(rewards[-3:])
     # random-init baseline is ~1/64 per token (empirically ~0.2 after the
     # first sampled batches); the policy-gradient loop drives it toward 1
     assert last > first + 0.2, (first, last, rewards)
     assert last > 0.5, rewards
+
+
+def test_generate_topk_restricts_and_reuses_cache():
+    """top_k rollouts only ever emit tokens from the per-step top-k logit set,
+    and consecutive decode steps REUSE the same KV cache program (one compiled
+    generate fn per (max_new, sampling) key — the hybrid engine's analog of
+    the reference's inference-cache retake)."""
+    import jax.numpy as jnp
+    from rlhf import build_actor
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    cfg = GPTConfig(n_layer=2, n_head=4, d_model=64, d_ff=128, max_seq_len=64,
+                    vocab_size=64, dtype=jnp.float32, remat=False)
+    engine = build_actor(cfg, {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 10 ** 9,
+    }, seed=0)
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size, (4, 8)).astype(np.int32)
+
+    out1 = engine.generate(prompts, max_new_tokens=4, greedy=False,
+                           temperature=1.0, top_k=1)
+    fn1 = engine._generate_fn
+    out2 = engine.generate(prompts, max_new_tokens=4, greedy=False,
+                           temperature=1.0, top_k=1)
+    # same sampling key -> the compiled rollout program is reused as-is
+    assert engine._generate_fn is fn1
+    # top_k=1 == greedy: must match argmax decoding exactly, and be
+    # deterministic across calls (rng has no surviving effect)
+    greedy = engine.generate(prompts, max_new_tokens=4, greedy=True)
+    np.testing.assert_array_equal(out1, out2)
+    np.testing.assert_array_equal(out1, greedy)
+    # a different top_k recompiles (new sampling rule)
+    engine.generate(prompts, max_new_tokens=4, greedy=False, top_k=8)
+    assert engine._generate_fn is not fn1
