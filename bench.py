@@ -226,11 +226,14 @@ def main():
             BENCH_STEPS=env("BENCH_NS_STEPS", "6"))
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                               env=child_env, capture_output=True, text=True)
-        for line in proc.stdout.strip().splitlines():
+        for line in reversed(proc.stdout.strip().splitlines()):
             try:
-                north = json.loads(line)
+                cand = json.loads(line)
             except json.JSONDecodeError:
                 continue
+            if isinstance(cand, dict) and "metric" in cand:
+                north = cand
+                break
         if north is not None:
             print(json.dumps(north))
         else:

@@ -132,7 +132,17 @@ class AioHandle {
         int64_t nbytes = req.num_bytes;
         bool direct = use_odirect_ && (req.file_offset % kAlign) == 0;
         if (direct && !aligned(req.buffer, req.num_bytes, kAlign)) {
-            if (req.is_write) {
+            // The bounce write rounds the length up to 4K, writing zero pad
+            // bytes past num_bytes — only legal when that pad merely extends
+            // EOF (like the grow-only ftruncate below). If live file content
+            // sits in the pad window (packed multi-tensor files writing at
+            // an interior offset), fall back to the buffered exact-length
+            // write rather than clobber it.
+            struct stat pre;
+            bool pad_extends_eof =
+                (::stat(req.path.c_str(), &pre) != 0) ||
+                pre.st_size <= req.file_offset + req.num_bytes;
+            if (req.is_write && pad_extends_eof) {
                 int64_t padded = (req.num_bytes + kAlign - 1) / kAlign * kAlign;
                 void* p = nullptr;
                 if (::posix_memalign(&p, kAlign, padded) == 0) {

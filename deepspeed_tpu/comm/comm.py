@@ -368,13 +368,19 @@ def all_to_all_single(output=None, input=None, output_split_sizes=None,
     if output_split_sizes is None and input_split_sizes is None:
         return all_to_all(tensor, axis=axis, group=group, split_axis=0,
                           concat_axis=0)
-    splits = [int(s) for s in (input_split_sizes
-                               if input_split_sizes is not None
-                               else output_split_sizes)]
+    if input_split_sizes is None:
+        # torch's output-only form means "input split evenly, receive sizes
+        # given" — per-rank receive sizes have no global-view formulation
+        # here; fail loudly like the asymmetric case below
+        raise NotImplementedError(
+            "all_to_all_single: output_split_sizes without input_split_sizes "
+            "(per-rank receive sizes) has no global-view formulation — pass "
+            "symmetric input_split_sizes")
+    splits = [int(s) for s in input_split_sizes]
     axes = _axis_tuple(axis if axis is not None else group)
     W = mesh_mod.axis_size(axes)
     assert len(splits) == W, (len(splits), W)
-    if output_split_sizes is not None and input_split_sizes is not None:
+    if output_split_sizes is not None:
         assert list(map(int, output_split_sizes)) == splits, \
             "global-view uneven all_to_all_single needs symmetric splits " \
             "(every rank shares one split list)"

@@ -34,7 +34,8 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm import mesh as mesh_mod
-from deepspeed_tpu.comm.mesh import BATCH_AXES, DATA_AXIS, PIPE_AXIS, SEQ_AXIS, ZERO_INNER_AXIS
+from deepspeed_tpu.comm.mesh import (BATCH_AXES, DATA_AXIS, PIPE_AXIS, SEQ_AXIS,
+                                     TENSOR_AXIS, ZERO_INNER_AXIS)
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -107,16 +108,185 @@ def partition_layers(n_layers, n_stages, method="uniform", costs=None, names=Non
 # ----------------------------------------------------------------------
 
 
-def _pipe_inner_specs(params):
+def _block_specs(params, block_tp_specs=None):
+    """blocks-leaf PartitionSpecs: leading dim on `pipe`, optional TP tails
+    (one composition point for the outer param specs AND shard_map in_specs —
+    they must never disagree or every step pays a reshard)."""
+    if block_tp_specs is None:
+        return jax.tree_util.tree_map(
+            lambda l: P(*([PIPE_AXIS] + [None] * (l.ndim - 1))), params["blocks"])
+    return jax.tree_util.tree_map(
+        lambda l, s: P(*([PIPE_AXIS] + list(tuple(s)))),
+        params["blocks"], block_tp_specs)
+
+
+def _pipe_inner_specs(params, block_tp_specs=None):
     """shard_map in_specs for the pipeline param layout (embed/head replicated,
     blocks leading-dim sharded on pipe) — one source of truth for both the
-    training (1F1B) and inference schedules."""
+    training (1F1B) and inference schedules.
+
+    `block_tp_specs`: optional tree matching params["blocks"] whose leaves are
+    PartitionSpecs WITHOUT the leading layer dim (Megatron TP tails, e.g.
+    P(None, "tensor") for a column-parallel [D, F] weight) — composed as
+    P(pipe, *tail) for 3D pp x tp (x dp/zero outside)."""
     return {
         "embed": jax.tree_util.tree_map(lambda _: P(), params["embed"]),
-        "blocks": jax.tree_util.tree_map(
-            lambda l: P(*([PIPE_AXIS] + [None] * (l.ndim - 1))), params["blocks"]),
+        "blocks": _block_specs(params, block_tp_specs),
         "head": jax.tree_util.tree_map(lambda _: P(), params["head"]),
     }
+
+
+# ----------------------------------------------------------------------
+# Megatron-style tensor parallelism INSIDE the pipeline stage
+# ----------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def _tp_copy(x):
+    """Megatron's `f` operator at a TP branch input: identity forward,
+    all-reduce (psum over `tensor`) backward — the branch's column-parallel
+    consumers each see the full activation, and its cotangent re-assembles
+    the full gradient before flowing into the replicated region (reference
+    equivalent: megatron's copy_to_tensor_model_parallel_region; the row
+    outputs' forward psum plays `g`, whose transpose is identity)."""
+    return x
+
+
+def _tp_copy_fwd(x):
+    return x, None
+
+
+def _tp_copy_bwd(_, g):
+    return (jax.lax.psum(g, TENSOR_AXIS),)
+
+
+_tp_copy.defvjp(_tp_copy_fwd, _tp_copy_bwd)
+
+
+@jax.custom_vjp
+def _tp_reduce(x):
+    """Megatron's `g` operator at a TP row-parallel output: psum forward,
+    IDENTITY backward. Must be a custom_vjp: under shard_map(check_vma=False)
+    a raw `lax.psum` transposes to psum again (the unchecked-replication
+    transpose rule), which double-counts every TP cotangent by a factor of
+    tp — measured as exactly-2x weight grads at tp=2 before this wrapper."""
+    return jax.lax.psum(x, TENSOR_AXIS)
+
+
+def _tp_reduce_fwd(x):
+    return jax.lax.psum(x, TENSOR_AXIS), None
+
+
+def _tp_reduce_bwd(_, g):
+    return (g,)
+
+
+_tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
+
+
+def make_tp_block_fn(cfg, tp):
+    """Transformer block over TENSOR-SHARDED leaves inside a fully-manual
+    shard_map (pipeline stages): column-parallel q/k/v/up (separate leaves —
+    a fused qkv dim cannot be evenly chunked into per-rank q|k|v runs), heads
+    computed locally, row-parallel out/down followed by an explicit psum over
+    `tensor`. LayerNorms run replicated; `_tp_copy` at each branch input
+    makes their backward exact. Activation layout between blocks: replicated
+    over `tensor` (classic Megatron; sequence-parallel LN sharding composes
+    via the `sequence` axis outside).
+
+    Supported config subset under TP is asserted in `split_block_params`."""
+    from deepspeed_tpu.models.gpt import _attention, _norm, _rope, _act
+
+    Hl = cfg.n_head // tp
+    Hkvl = cfg.n_kv_head // tp
+    hd = cfg.head_dim
+    lcfg = dataclasses.replace(cfg, use_flash_attention=False)
+
+    def block_fn(p, x, rng):
+        B, T, D = x.shape
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+
+        h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm,
+                  cfg.norm_eps)
+        h = _tp_copy(h)
+        q = (h @ p["attn_q_w"] + p["attn_q_b"]).reshape(B, T, Hl, hd)
+        k = (h @ p["attn_k_w"] + p["attn_k_b"]).reshape(B, T, Hkvl, hd)
+        v = (h @ p["attn_v_w"] + p["attn_v_b"]).reshape(B, T, Hkvl, hd)
+        if cfg.use_rotary:
+            rd = int(cfg.rotary_pct * hd) // 2 * 2
+            q = _rope(q, positions, rd, cfg.rope_theta)
+            k = _rope(k, positions, rd, cfg.rope_theta)
+        causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
+        attn = _attention(q, k, v, causal, lcfg)           # local heads
+        attn_o = attn.reshape(B, T, Hl * hd) @ p["attn_out_w"]  # row parallel
+        attn_o = _tp_reduce(attn_o) + p["attn_out_b"]
+
+        use_rms = cfg.use_rmsnorm
+        if cfg.parallel_residual:
+            h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
+        else:
+            x = x + attn_o
+            h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
+        h2 = _tp_copy(h2)
+        if cfg.use_swiglu:
+            up = jax.nn.silu(h2 @ p["mlp_gate_w"]) * (h2 @ p["mlp_up_w"])
+        else:
+            up = _act(h2 @ p["mlp_up_w"] + p["mlp_up_b"], cfg)
+        down = _tp_reduce(up @ p["mlp_down_w"]) + p["mlp_out_b"]
+        if cfg.parallel_residual:
+            return x + attn_o + down
+        return x + down
+
+    return block_fn
+
+
+def split_block_params(cfg, blocks):
+    """Fused-qkv stacked block params → the TP layout (separate q/k/v leaves).
+
+    The fused [L, D, (H+2Hkv)*hd] weight cannot shard its output dim over
+    `tensor`: equal chunks straddle the q|k|v boundaries. Splitting restores
+    clean per-leaf column sharding; `checkpoint/universal.py` already
+    converts fused↔split qkv orderings for resharding."""
+    assert not cfg.use_alibi, "alibi slopes need global head indices under TP"
+    assert cfg.attn_layer_types is None and not cfg.sliding_window, \
+        "per-layer local attention is not wired for the TP pipeline block yet"
+    H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    out = dict(blocks)
+    qkv_w = out.pop("attn_qkv_w")
+    qkv_b = out.pop("attn_qkv_b")
+    q_end, k_end = H * hd, (H + Hkv) * hd
+    out["attn_q_w"], out["attn_k_w"], out["attn_v_w"] = (
+        qkv_w[..., :q_end], qkv_w[..., q_end:k_end], qkv_w[..., k_end:])
+    out["attn_q_b"], out["attn_k_b"], out["attn_v_b"] = (
+        qkv_b[..., :q_end], qkv_b[..., q_end:k_end], qkv_b[..., k_end:])
+    return out
+
+
+def tp_block_specs(cfg, blocks_split):
+    """PartitionSpec tails (no layer dim) for the split TP block layout."""
+    t = TENSOR_AXIS
+    col_w, col_b = P(None, t), P(t)
+    row_w, rep_v, rep_b = P(t, None), P(None), P(None)
+    specs = {
+        "ln1_scale": rep_v, "ln2_scale": rep_v,
+        "attn_q_w": col_w, "attn_k_w": col_w, "attn_v_w": col_w,
+        "attn_q_b": col_b, "attn_k_b": col_b, "attn_v_b": col_b,
+        "attn_out_w": row_w, "attn_out_b": rep_b, "mlp_out_b": rep_b,
+    }
+    if not cfg.use_rmsnorm:
+        specs["ln1_bias"] = rep_v
+        specs["ln2_bias"] = rep_v
+    if cfg.use_swiglu:
+        specs["mlp_gate_w"] = col_w
+        specs["mlp_up_w"] = col_w
+        specs["mlp_down_w"] = row_w
+    else:
+        specs["mlp_up_w"] = col_w
+        specs["mlp_up_b"] = col_b
+        specs["mlp_down_w"] = row_w
+    assert set(specs) == set(blocks_split), (
+        sorted(set(blocks_split) ^ set(specs)))
+    return specs
 
 
 def _mb_view(batch, i, M):
@@ -143,7 +313,7 @@ def _make_stage_apply(block_fn, blocks):
 
 
 def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
-                     num_microbatches, remat_blocks=True):
+                     num_microbatches, remat_blocks=True, block_tp_specs=None):
     """Builds loss_fn(params, batch, rng) running the pipelined schedule.
 
     params = {"embed": <replicated>, "blocks": <stacked [PP*Lp, ...] leaves,
@@ -223,7 +393,8 @@ def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
         batch_spec = jax.tree_util.tree_map(lambda _: P(BATCH_AXES), batch)
         with mesh_mod.constraints_disabled():
             fn = shard_map(local, mesh=mesh,
-                           in_specs=(_pipe_inner_specs(params), batch_spec, P()),
+                           in_specs=(_pipe_inner_specs(params, block_tp_specs),
+                                     batch_spec, P()),
                            out_specs=P(), check_vma=False)
             return fn(params, batch, rng)
 
@@ -231,7 +402,7 @@ def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
 
 
 def pipeline_grad_fn(embed_fn, block_fn, head_loss_fn, num_stages,
-                     num_microbatches, remat_blocks=True):
+                     num_microbatches, remat_blocks=True, block_tp_specs=None):
     """1F1B-structured pipelined (loss, grads) — reference `TrainSchedule`
     (`runtime/pipe/schedule.py:189`).
 
@@ -398,17 +569,19 @@ def pipeline_grad_fn(embed_fn, block_fn, head_loss_fn, num_stages,
     def grad_fn(params, batch, rng):
         mesh = mesh_mod.get_mesh()
         batch_spec = jax.tree_util.tree_map(lambda _: P(BATCH_AXES), batch)
+        specs = _pipe_inner_specs(params, block_tp_specs)
         with mesh_mod.constraints_disabled():
             fn = shard_map(local, mesh=mesh,
-                           in_specs=(_pipe_inner_specs(params), batch_spec, P()),
-                           out_specs=(P(), _pipe_inner_specs(params)),
+                           in_specs=(specs, batch_spec, P()),
+                           out_specs=(P(), specs),
                            check_vma=False)
             return fn(params, batch, rng)
 
     return grad_fn
 
 
-def pipeline_forward_fn(embed_fn, block_fn, head_fn, num_stages, num_microbatches):
+def pipeline_forward_fn(embed_fn, block_fn, head_fn, num_stages,
+                        num_microbatches, block_tp_specs=None):
     """Pipelined forward-only schedule (reference `InferenceSchedule`,
     `runtime/pipe/schedule.py:135`): microbatches stream through the stages,
     the last stage applies `head_fn(params, act, micro_batch, rng) -> out
@@ -476,19 +649,20 @@ def pipeline_forward_fn(embed_fn, block_fn, head_fn, num_stages, num_microbatche
         batch_spec = jax.tree_util.tree_map(lambda _: P(BATCH_AXES), batch)
         with mesh_mod.constraints_disabled():
             fn = shard_map(local, mesh=mesh,
-                           in_specs=(_pipe_inner_specs(params), batch_spec, P()),
+                           in_specs=(_pipe_inner_specs(params, block_tp_specs),
+                                     batch_spec, P()),
                            out_specs=P(BATCH_AXES), check_vma=False)
             return fn(params, batch, rng)
 
     return forward
 
 
-def pipeline_param_specs(params):
-    """PartitionSpecs matching pipeline_loss_fn's layout."""
+def pipeline_param_specs(params, block_tp_specs=None):
+    """PartitionSpecs matching pipeline_loss_fn's layout (TP tails optional)."""
+    blocks = _block_specs(params, block_tp_specs)
     return {
         "embed": jax.tree_util.tree_map(lambda l: P(*([None] * l.ndim)), params["embed"]),
-        "blocks": jax.tree_util.tree_map(
-            lambda l: P(*([PIPE_AXIS] + [None] * (l.ndim - 1))), params["blocks"]),
+        "blocks": blocks,
         "head": jax.tree_util.tree_map(lambda l: P(*([None] * l.ndim)), params["head"]),
     }
 
@@ -499,13 +673,21 @@ def pipeline_param_specs(params):
 
 
 def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
-                            num_microbatches=4, seed=0, schedule="1f1b"):
+                            num_microbatches=4, seed=0, schedule="1f1b",
+                            tensor_parallel=None):
     """Pipeline-parallel GPT ModelSpec: blocks stacked [PP*Lp, ...] on `pipe`.
 
     schedule: "1f1b" (default — reference TrainSchedule memory bound) trains
     via `pipeline_grad_fn`; "gpipe" trains by autodiff through the fill-drain
     loss (O(M) activation memory, kept for comparison/debugging).
-    """
+
+    tensor_parallel: Megatron TP degree INSIDE each stage (3D pp x tp x
+    dp/zero — reference `runtime/pipe/topology.py:251`
+    PipeModelDataParallelTopology). Default: the current mesh's `tensor`
+    axis size. With tp > 1, block weights use the split-qkv TP layout and the
+    stage body runs `make_tp_block_fn` (explicit psum collectives); embed and
+    head stay tensor-replicated (their flops run once per tp rank — vocab
+    parallelism is a future optimization)."""
     from deepspeed_tpu.models.gpt import (GPTConfig, GPT2_CONFIGS, init_gpt_params,
                                           _block, _norm)
     from deepspeed_tpu.runtime.engine import ModelSpec
@@ -513,11 +695,23 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
     cfg = cfg or GPT2_CONFIGS.get(name) or GPTConfig()
     assert cfg.n_layer % num_stages == 0, \
         f"n_layer {cfg.n_layer} must divide evenly into {num_stages} stages"
+    if tensor_parallel is None:
+        tensor_parallel = (mesh_mod.axis_size(TENSOR_AXIS)
+                           if mesh_mod.has_mesh() else 1)
+    tp = int(tensor_parallel)
     raw = init_gpt_params(cfg, seed=seed)
+
+    blocks = raw["blocks"]
+    block_tp_specs = None
+    if tp > 1:
+        assert cfg.n_head % tp == 0 and cfg.n_kv_head % tp == 0, \
+            f"n_head {cfg.n_head}/n_kv_head {cfg.n_kv_head} must divide tp={tp}"
+        blocks = split_block_params(cfg, blocks)
+        block_tp_specs = tp_block_specs(cfg, blocks)
 
     params = {
         "embed": {"wte": raw["wte"], **({"wpe": raw["wpe"]} if not cfg.use_rotary else {})},
-        "blocks": raw["blocks"],
+        "blocks": blocks,
         "head": {"lnf_scale": raw["lnf_scale"],
                  **({"lnf_bias": raw["lnf_bias"]} if not cfg.use_rmsnorm else {})},
     }
@@ -545,10 +739,13 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
         inputs = tokens if micro_batch.get("labels") is not None else tokens[:, :-1]
         return _embed_tokens(ep, inputs)
 
-    def block_fn(lp, x, rng):
-        B, T, D = x.shape
-        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
-        return _block(x, lp, cfg=cfg, positions=positions)
+    if tp > 1:
+        block_fn = make_tp_block_fn(cfg, tp)
+    else:
+        def block_fn(lp, x, rng):
+            B, T, D = x.shape
+            positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+            return _block(x, lp, cfg=cfg, positions=positions)
 
     def head_loss_fn(full_params, x, micro_batch, rng):
         labels = micro_batch.get("labels")
@@ -565,7 +762,8 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
     loss_fn = pipeline_loss_fn(embed_fn, block_fn, head_loss_fn,
                                num_stages=num_stages,
                                num_microbatches=num_microbatches,
-                               remat_blocks=cfg.remat)
+                               remat_blocks=cfg.remat,
+                               block_tp_specs=block_tp_specs)
     # training backward: 1F1B schedule (O(PP) live activations); the
     # fill-drain loss_fn above stays as the cheaper eval/forward-only path
     schedule = schedule.lower()
@@ -575,7 +773,8 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
     grad_fn = (pipeline_grad_fn(embed_fn, block_fn, head_loss_fn,
                                 num_stages=num_stages,
                                 num_microbatches=num_microbatches,
-                                remat_blocks=cfg.remat)
+                                remat_blocks=cfg.remat,
+                                block_tp_specs=block_tp_specs)
                if schedule == "1f1b" else None)
 
     # pipelined inference forward (reference InferenceSchedule): full-sequence
@@ -588,7 +787,8 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
 
     pipelined_fwd = pipeline_forward_fn(fwd_embed_fn, block_fn, fwd_head_fn,
                                         num_stages=num_stages,
-                                        num_microbatches=num_microbatches)
+                                        num_microbatches=num_microbatches,
+                                        block_tp_specs=block_tp_specs)
 
     def apply_fn(params, tokens, rng=None):
         # uniform ModelSpec.apply_fn contract: raw [B, T] token array
@@ -598,4 +798,5 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
 
     return ModelSpec(loss_fn=loss_fn, params=params, apply_fn=apply_fn,
                      grad_fn=grad_fn,
-                     param_specs=pipeline_param_specs(params), name=name)
+                     param_specs=pipeline_param_specs(params, block_tp_specs),
+                     name=name)

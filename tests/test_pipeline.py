@@ -269,3 +269,60 @@ def test_moe_namespace_import_paths():
     from deepspeed_tpu.moe import MoE as MoE2
     from deepspeed_tpu.parallel.moe import MoE as MoE3
     assert MoE1 is MoE2 is MoE3
+
+
+def test_3d_pp_tp_zero_loss_and_grads_match_plain():
+    """3D in one mesh (pipe=2 x tensor=2 x data=2, ZeRO stage 1 — reference
+    `runtime/pipe/topology.py:251` PipeModelDataParallelTopology): pipelined
+    TP loss AND 1F1B grads must match the plain single-program model on the
+    same initialization."""
+    mesh = _mk_mesh(pipe=2, tensor=2, data=2)
+    pipe_model = make_gpt_pipeline_model(cfg=TINY, num_stages=2,
+                                         num_microbatches=2, tensor_parallel=2)
+    plain_model = make_gpt_model(cfg=TINY, name="plain")
+    batch = {"tokens": jnp.asarray(_tokens(8, 33, TINY.vocab_size))}
+    rng = jax.random.PRNGKey(0)
+
+    # TP layout splits fused qkv; verify the split leaves exist + specs carry tensor
+    assert "attn_q_w" in pipe_model.params["blocks"]
+    assert "tensor" in str(pipe_model.param_specs["blocks"]["attn_q_w"])
+
+    pipe_loss = jax.jit(pipe_model.loss_fn)(pipe_model.params, batch, rng)
+    plain_loss = plain_model.loss_fn(plain_model.params, batch, rng)
+    np.testing.assert_allclose(float(pipe_loss), float(plain_loss), rtol=1e-4)
+
+    # 1F1B grads vs the plain model's autodiff, mapped through the split layout
+    loss_1f1b, g = jax.jit(pipe_model.grad_fn)(pipe_model.params, batch, rng)
+    np.testing.assert_allclose(float(loss_1f1b), float(plain_loss), rtol=1e-4)
+    g_plain = jax.grad(plain_model.loss_fn)(plain_model.params, batch, rng)
+    H, hd = TINY.n_head, TINY.head_dim
+    q_end = H * hd
+    np.testing.assert_allclose(np.asarray(g["blocks"]["attn_q_w"]),
+                               np.asarray(g_plain["blocks"]["attn_qkv_w"][..., :q_end]),
+                               rtol=2e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g["blocks"]["mlp_down_w"]),
+                               np.asarray(g_plain["blocks"]["mlp_down_w"]),
+                               rtol=2e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g["blocks"]["ln1_scale"]),
+                               np.asarray(g_plain["blocks"]["ln1_scale"]),
+                               rtol=2e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g["embed"]["wte"]),
+                               np.asarray(g_plain["wte"]), rtol=2e-3, atol=1e-5)
+
+
+def test_3d_trains_under_engine():
+    """pp=2 x tp=2 x dp=2 + ZeRO-1 trains end to end through initialize()."""
+    mesh = _mk_mesh(pipe=2, tensor=2, data=2)
+    model = make_gpt_pipeline_model(cfg=TINY, num_stages=2, num_microbatches=2,
+                                    tensor_parallel=2)
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 1000,
+    }, mesh=mesh)
+    qw = engine.state.params["blocks"]["attn_q_w"]
+    assert "pipe" in str(qw.sharding.spec) and "tensor" in str(qw.sharding.spec)
+    batch = {"tokens": _tokens(8, 33, TINY.vocab_size)}
+    losses = [float(engine.train_batch(batch)) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
