@@ -131,29 +131,25 @@ class AioHandle {
         char* data = static_cast<char*>(req.buffer);
         int64_t nbytes = req.num_bytes;
         bool direct = use_odirect_ && (req.file_offset % kAlign) == 0;
+        int64_t tail_bytes = 0;  // buffered remainder after the direct body
         if (direct && !aligned(req.buffer, req.num_bytes, kAlign)) {
-            // The bounce write rounds the length up to 4K, writing zero pad
-            // bytes past num_bytes — only legal when that pad merely extends
-            // EOF (like the grow-only ftruncate below). If live file content
-            // sits in the pad window (packed multi-tensor files writing at
-            // an interior offset), fall back to the buffered exact-length
-            // write rather than clobber it.
-            struct stat pre;
-            bool pad_extends_eof =
-                (::stat(req.path.c_str(), &pre) != 0) ||
-                pre.st_size <= req.file_offset + req.num_bytes;
-            if (req.is_write && pad_extends_eof) {
-                int64_t padded = (req.num_bytes + kAlign - 1) / kAlign * kAlign;
+            if (req.is_write) {
+                // Direct-write only the aligned BODY from the bounce copy and
+                // finish the sub-4K tail with an exact-length buffered pwrite:
+                // writes never touch a byte past num_bytes, so concurrent
+                // writers to a packed file cannot be clobbered (a stat-based
+                // "pad only extends EOF" check would be TOCTOU-racy across
+                // the worker pool).
+                int64_t body = req.num_bytes / kAlign * kAlign;
                 void* p = nullptr;
-                if (::posix_memalign(&p, kAlign, padded) == 0) {
+                if (body > 0 && ::posix_memalign(&p, kAlign, body) == 0) {
                     bounce = static_cast<char*>(p);
-                    ::memcpy(bounce, req.buffer, req.num_bytes);
-                    ::memset(bounce + req.num_bytes, 0,
-                             padded - req.num_bytes);  // slack to the 4K pad
+                    ::memcpy(bounce, req.buffer, body);
                     data = bounce;
-                    nbytes = padded;
+                    nbytes = body;
+                    tail_bytes = req.num_bytes - body;
                 } else {
-                    direct = false;
+                    direct = false;  // tiny (<4K) or OOM: all buffered
                 }
             } else {
                 direct = false;
@@ -194,6 +190,21 @@ class AioHandle {
             buf += n;
             offset += n;
             remaining -= n;
+        }
+        if (req.is_write && ok && tail_bytes > 0) {
+            // buffered exact-length tail (the only non-O_DIRECT bytes; the
+            // grow-only ftruncate below still pads the FILE for aligned reads)
+            int tfd = ::open(req.path.c_str(), O_WRONLY | O_CREAT, 0644);
+            if (tfd < 0) {
+                ok = false;
+            } else {
+                const char* tsrc = static_cast<const char*>(req.buffer)
+                                   + (req.num_bytes - tail_bytes);
+                ssize_t tn = ::pwrite(tfd, tsrc, tail_bytes,
+                                      req.file_offset + req.num_bytes - tail_bytes);
+                if (tn != tail_bytes) ok = false;
+                ::close(tfd);
+            }
         }
         // No fsync by default: swap files are scratch state rewritten every
         // step — durability costs NVMe queue depth for nothing. Opt in via

@@ -341,6 +341,15 @@ class DataEfficiencyConfig(ConfigModel):
 
 
 @dataclass
+class ProgressiveLayerDropConfig(ConfigModel):
+    """Reference: `runtime/config.py` progressive_layer_drop block +
+    `runtime/progressive_layer_drop.py` (theta schedule)."""
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
+@dataclass
 class CheckpointConfig(ConfigModel):
     """Reference: checkpoint block + `runtime/checkpoint_engine/`."""
     tag_validation: str = "Warn"     # Ignore | Warn | Fail
@@ -408,6 +417,8 @@ class TpuTrainConfig(ConfigModel):
     autotuning: AutotuningConfig = field(default_factory=AutotuningConfig)
     elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
     data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
+    progressive_layer_drop: ProgressiveLayerDropConfig = field(
+        default_factory=ProgressiveLayerDropConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     moe: MoEConfig = field(default_factory=MoEConfig)
 

@@ -549,8 +549,23 @@ def _block(x, p, cfg: GPTConfig, positions, dropout_rng=None, attn_fn=None,
     return shard_constraint(x, BATCH_AXES, SEQ_AXIS, None)
 
 
-def gpt_hidden(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None):
-    """tokens: [B, T] int32 → final-norm'd hidden states [B, T, D]."""
+def gpt_hidden(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None,
+               pld=None, ltd=None):
+    """tokens: [B, T] int32 → final-norm'd hidden states [B, T, D].
+
+    `pld`: (keep_idx [n_keep] int32, theta scalar) — progressive layer drop
+    (reference `runtime/progressive_layer_drop.py`): only the kept layers'
+    params are gathered and scanned (real flop savings — one compiled
+    program per kept count), each kept layer's residual delta rescaled by
+    1/theta (inverted stochastic depth, expectation-preserving).
+
+    `ltd`: (start_layer int, keep_idx [B, n_ltd, K] int32) — random-LTD
+    (reference `data_routing/basic_layer.py`): layers [start, start+n_ltd)
+    process only each sample's K kept token positions (gather → block →
+    scatter); dropped tokens bypass those layers unchanged. Attention inside
+    the subset stays causal in ORIGINAL positions (indices arrive sorted);
+    rotary embeddings read the true positions.
+    """
     B, T = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
@@ -567,7 +582,54 @@ def gpt_hidden(params, tokens, cfg: GPTConfig, positions=None, attn_fn=None):
     if cfg.remat:
         block_fn = jax.checkpoint(block_fn, policy=resolve_remat_policy(cfg.remat_policy))
 
-    if flags is None:
+    if pld is not None:
+        assert flags is None and ltd is None, \
+            "progressive_layer_drop composes with neither per-layer attention "\
+            "flags nor random-LTD"
+        keep_idx, theta = pld
+        kept = jax.tree_util.tree_map(
+            lambda l: jnp.take(l, keep_idx, axis=0), params["blocks"])
+        inv = (1.0 / jnp.maximum(theta, 1e-6)).astype(x.dtype)
+
+        def pld_body(x, layer_params):
+            return x + (block_fn(x, layer_params) - x) * inv, None
+
+        x, _ = jax.lax.scan(pld_body, x, kept)
+    elif ltd is not None:
+        assert flags is None, "random-LTD needs uniform attention layers"
+        assert attn_fn is None, \
+            "random-LTD gathers token subsets; a custom attn_fn with a " \
+            "T-static layout cannot run on them"
+        assert not cfg.use_alibi and not cfg.sliding_window, \
+            "random-LTD subset attention does not carry alibi/window masks yet"
+        start, kidx = ltd
+        n_ltd = kidx.shape[1]
+        blocks = params["blocks"]
+        pre = jax.tree_util.tree_map(lambda l: l[:start], blocks)
+        mid = jax.tree_util.tree_map(lambda l: l[start:start + n_ltd], blocks)
+        post = jax.tree_util.tree_map(lambda l: l[start + n_ltd:], blocks)
+
+        def sub_block(sx, lp, pos):
+            return _block(sx, lp, cfg=cfg, positions=pos, attn_fn=None)
+        if cfg.remat:
+            sub_block = jax.checkpoint(
+                sub_block, policy=resolve_remat_policy(cfg.remat_policy))
+
+        def plain_body(x, layer_params):
+            return block_fn(x, layer_params), None
+
+        def mid_body(carry, inp):
+            lp, kx = inp                                  # kx: [B, K]
+            sub = jnp.take_along_axis(carry, kx[..., None], axis=1)
+            sub_out = sub_block(sub, lp, kx)
+            carry = carry.at[jnp.arange(carry.shape[0])[:, None], kx].set(
+                sub_out.astype(carry.dtype))
+            return carry, None
+
+        x, _ = jax.lax.scan(plain_body, x, pre)
+        x, _ = jax.lax.scan(mid_body, x, (mid, jnp.moveaxis(kidx, 0, 1)))
+        x, _ = jax.lax.scan(plain_body, x, post)
+    elif flags is None:
         def scan_body(x, layer_params):
             return block_fn(x, layer_params), None
         x, _ = jax.lax.scan(scan_body, x, params["blocks"])
@@ -595,10 +657,17 @@ def gpt_loss(params, batch, rng, cfg: GPTConfig, attn_fn=None):
         inputs, labels = tokens[:, :-1], tokens[:, 1:]
     else:
         inputs = tokens
+    # engine-injected routing directives (engine._inject_routing_directives):
+    # broadcast over the batch dim; counts ride in the SHAPES (static)
+    pld = ltd = None
+    if "pld_keep_idx" in batch:
+        pld = (batch["pld_keep_idx"][0], batch["pld_theta"][0])
+    if "ltd_keep_idx" in batch:
+        ltd = (batch["ltd_start"].shape[1], batch["ltd_keep_idx"])
     if cfg.loss_chunks:
         from deepspeed_tpu.ops.chunked_ce import chunked_softmax_xent
         B, T = inputs.shape
-        x = gpt_hidden(params, inputs, cfg, attn_fn=attn_fn)
+        x = gpt_hidden(params, inputs, cfg, attn_fn=attn_fn, pld=pld, ltd=ltd)
         assert "lm_head_bias" not in params, \
             "chunked CE does not support a tied LM-head bias"
         head = _head_table(params, cfg)
@@ -606,7 +675,8 @@ def gpt_loss(params, batch, rng, cfg: GPTConfig, attn_fn=None):
                                    labels.reshape(B * T), cfg.loss_chunks)
         mask = (labels.reshape(B * T) >= 0).astype(jnp.float32)
         return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
-    logits = gpt_forward(params, inputs, cfg, attn_fn=attn_fn)
+    x = gpt_hidden(params, inputs, cfg, attn_fn=attn_fn, pld=pld, ltd=ltd)
+    logits = _head_logits(params, x, cfg)
     # cross entropy WITHOUT materializing an fp32 [B,T,V] buffer (1.65G at
     # mbs16/seq512/50k vocab): logits stay in compute dtype, the exp/sum runs
     # with an fp32 accumulator fused into the reduction, and only [B,T]

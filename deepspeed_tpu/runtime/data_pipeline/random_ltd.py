@@ -31,7 +31,11 @@ class RandomLTDScheduler:
         return self.start_ratio + (self.end_ratio - self.start_ratio) * frac
 
     def keep_count(self, step, seq_len):
-        raw = int(self.keep_ratio(step) * seq_len)
+        # reference schema passes ABSOLUTE token counts as
+        # random_ltd_schedule.min_value/max_value (scheduler.py:38); values
+        # <= 1 are treated as ratios of the live sequence length
+        raw = self.keep_ratio(step)
+        raw = int(raw if raw > 1 else raw * seq_len)
         bucketed = max((raw // self.bucket) * self.bucket, self.bucket)
         return min(bucketed, seq_len)
 

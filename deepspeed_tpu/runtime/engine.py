@@ -295,6 +295,71 @@ class Engine:
             from deepspeed_tpu.runtime.data_pipeline.curriculum import CurriculumScheduler
             self.curriculum_scheduler = CurriculumScheduler(cl)
 
+        # progressive layer drop (reference engine.py:234-236 constructs
+        # ProgressiveLayerDrop from config and feeds theta every step): the
+        # kept-layer INDICES are sampled host-side per step and ride into the
+        # jitted step as a [B, n_keep] batch leaf — its shape carries the
+        # count, so XLA compiles one program per distinct kept count (<=
+        # n_layer of them) and the dropped layers' flops genuinely disappear
+        pld_cfg = self.config.progressive_layer_drop
+        rl_enabled = bool(((de.data_routing or {}).get("random_ltd", {})
+                           if de and de.enabled else {}).get("enabled"))
+        if pld_cfg.enabled or rl_enabled:
+            # fail LOUDLY at init if the model cannot consume the routing
+            # directives (only the zoo's gpt_loss reads them; a pipeline or
+            # custom-loss model would otherwise silently train at full cost
+            # while the scheduler ramps)
+            which = "progressive_layer_drop" if pld_cfg.enabled else "random_ltd"
+            assert getattr(self.model_spec, "arch_cfg", None) is not None, (
+                f"{which}: this model does not expose ModelSpec.arch_cfg, so "
+                "the routing directives would be silently ignored — only the "
+                "GPT zoo's loss path (models/gpt.gpt_loss) consumes them")
+            assert getattr(self.model_spec, "grad_fn", None) is None, (
+                f"{which}: models with a custom grad_fn (pipeline 1F1B) do "
+                "not consume routing directives yet")
+        self.progressive_layer_drop = None
+        if pld_cfg.enabled:
+            from deepspeed_tpu.runtime.progressive_layer_drop import \
+                ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pld_cfg.theta, gamma=pld_cfg.gamma)
+            self._pld_rng = np.random.default_rng(self.config.seed ^ 0x9E3779B9)
+
+        # random-LTD (reference data_routing/scheduler.py:38 + basic_layer.py):
+        # per-sample kept-TOKEN subsets for the middle layers, sampled
+        # host-side; the kept count ramps by schedule and is bucketed, so each
+        # bucket is one compiled program (the reference's reserved-length
+        # buckets)
+        rl = (de.data_routing or {}).get("random_ltd", {}) if de and de.enabled else {}
+        self.random_ltd_scheduler = None
+        if rl.get("enabled"):
+            from deepspeed_tpu.runtime.data_pipeline.random_ltd import \
+                RandomLTDScheduler
+            sched = rl.get("random_ltd_schedule", {})
+            sched_cfg = sched.get("schedule_config", {})
+            total_layers = int(rl.get("total_layer_num", 0))
+            assert total_layers > 0, \
+                "data_routing.random_ltd needs total_layer_num (reference schema)"
+            layer_ids = rl.get("random_ltd_layer_id")
+            if layer_ids:
+                layer_ids = sorted(int(i) for i in layer_ids)
+                assert layer_ids == list(range(layer_ids[0], layer_ids[-1] + 1)), \
+                    "random_ltd_layer_id must be a contiguous range (the " \
+                    "stacked-scan formulation splits layers into three slices)"
+                start_layer, end_layer = layer_ids[0], layer_ids[-1]
+            else:
+                start_layer = int(rl.get("ltd_start_layer", 1))
+                end_layer = rl.get("ltd_end_layer")
+            self.random_ltd_scheduler = RandomLTDScheduler(
+                total_layers=total_layers,
+                start_ratio=float(sched.get("min_value", 0.5)),
+                end_ratio=float(sched.get("max_value", 1.0)),
+                total_steps=int(sched_cfg.get("require_steps", 10000)),
+                ltd_start_layer=start_layer,
+                ltd_end_layer=end_layer,
+                bucket=int(sched_cfg.get("seq_per_step", 64)))
+            self._ltd_rng = np.random.default_rng(self.config.seed ^ 0x51ED270B)
+
     @staticmethod
     def _factor_zero_subgroup(config):
         """MiCS/hpZ: factor the data axis into data × zero so params shard over an
@@ -824,6 +889,9 @@ class Engine:
                 apply_seqlen_curriculum
             difficulty = self.curriculum_scheduler.update_difficulty(self.global_steps)
             batch = apply_seqlen_curriculum(batch, difficulty)
+        if (self.progressive_layer_drop is not None
+                or self.random_ltd_scheduler is not None) and isinstance(batch, dict):
+            batch = self._inject_routing_directives(batch)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         placed = None
@@ -850,6 +918,58 @@ class Engine:
         self._after_step(metrics, count_micro=True)
         self._maybe_step_moq(batch)
         return metrics["loss"]
+
+    def _inject_routing_directives(self, batch):
+        """Host-side per-step sampling for PLD / random-LTD, delivered as
+        EXTRA batch leaves broadcast over the batch dim — they split, shard
+        and scan exactly like the data, and their SHAPES carry the static
+        kept counts (one compiled program per count bucket; see __init__).
+
+        Leaves (consumed by models/gpt.gpt_loss; other models ignore them):
+          pld_keep_idx [B, n_keep] int32 — kept layer ids (same for all rows)
+          pld_theta    [B] float32       — current keep-prob for the rescale
+          ltd_keep_idx [B, n_ltd_layers, K] int32 — per-SAMPLE sorted kept
+              token positions for each routed layer
+          ltd_start    [B, start_layer] int8 zeros — the static start layer,
+              carried in the shape (values are tracers under jit)
+        """
+        tokens = batch.get("tokens", batch.get("input_ids"))
+        if tokens is None:
+            return batch
+        tokens = np.asarray(tokens)
+        B0 = tokens.shape[0]
+        out = dict(batch)
+        pld = self.progressive_layer_drop
+        if pld is not None:
+            pld.update_state(self.global_steps)
+            theta = pld.get_theta()
+            n_layer = getattr(getattr(self.model_spec, "arch_cfg", None),
+                              "n_layer", None)
+            assert n_layer, ("progressive_layer_drop needs the model's layer "
+                            "count (ModelSpec.arch_cfg.n_layer)")
+            keep = self._pld_rng.random(n_layer) < theta
+            if not keep.any():
+                keep[self._pld_rng.integers(n_layer)] = True
+            idx = np.flatnonzero(keep).astype(np.int32)
+            out["pld_keep_idx"] = np.broadcast_to(idx[None], (B0, idx.size)).copy()
+            out["pld_theta"] = np.full((B0,), theta, np.float32)
+        sched = self.random_ltd_scheduler
+        if sched is not None:
+            T_in = tokens.shape[1] - (0 if batch.get("labels") is not None else 1)
+            K = sched.keep_count(self.global_steps, T_in)
+            lo, hi = sched.start_layer, sched.end_layer
+            n_ltd = hi - lo + 1
+            if K < T_in and n_ltd > 0:
+                # vectorized sample-without-replacement: top-K of uniform keys
+                r = self._ltd_rng.random((B0, n_ltd, T_in))
+                idx = np.sort(np.argpartition(r, K - 1, axis=-1)[..., :K],
+                              axis=-1).astype(np.int32)
+                out["ltd_keep_idx"] = idx
+                # the start layer must be STATIC for the three-way layer-scan
+                # split; values are tracers under jit, so it rides in a dummy
+                # leaf's SHAPE like the counts do ([B, lo] int8 zeros)
+                out["ltd_start"] = np.zeros((B0, lo), np.int8)
+        return out
 
     def _maybe_step_moq(self, batch):
         """Advance the MoQ bit-reduction schedule once per optimizer step; at
