@@ -1,47 +1,159 @@
 """Difficulty-indexed data sampling.
 
 Reference: `DeepSpeedDataSampler` (`data_pipeline/data_sampling/data_sampler.py:36`)
-— curriculum-driven sampler that restricts each epoch's candidate pool to samples
-whose difficulty metric <= current difficulty, using a precomputed
-metric→sample index (the offline `DataAnalyzer` map-reduce).
+— curriculum-driven sampler that restricts each step's candidate pool to samples
+whose difficulty metric(s) <= the current scheduled difficulty, using the
+precomputed metric→sample index built offline by the `DataAnalyzer` map-reduce.
 
-Here: `difficulties` is an array aligned with the dataset (the analyzer output);
-sampling masks the pool per step and draws global batches deterministically.
+Two construction paths:
+  * direct: `difficulties` = one array aligned with the dataset (single
+    metric) or {metric_name: array} (multi-metric — the pool is the
+    INTERSECTION of per-metric pools, each with its own schedule, matching
+    the reference's per-metric CurriculumScheduler dict);
+  * `from_config`: the reference `curriculum_learning` JSON block with
+    `curriculum_metrics: {name: {index_to_metric_path | sample_to_metric_path,
+    difficulty_type: value|percentile, ...schedule...}}` — index files are the
+    analyzer's `sample_to_metric.npy` outputs.
 """
+
+import os
 
 import numpy as np
 
 from deepspeed_tpu.runtime.data_pipeline.curriculum import CurriculumScheduler
 
 
+def _resolve_metric_path(path, name):
+    """Accept the analyzer's save dir, the metric dir, or the .npy itself."""
+    if os.path.isdir(path):
+        for cand in (os.path.join(path, "sample_to_metric.npy"),
+                     os.path.join(path, name, "sample_to_metric.npy")):
+            if os.path.exists(cand):
+                return cand
+    return path
+
+
 class DeepSpeedDataSampler:
     def __init__(self, dataset_len, batch_size, difficulties=None,
-                 curriculum_config=None, seed=0, drop_last=True):
+                 curriculum_config=None, seed=0, drop_last=True,
+                 difficulty_types=None):
         self.dataset_len = dataset_len
         self.batch_size = batch_size
-        self.difficulties = (np.asarray(difficulties) if difficulties is not None
-                             else None)
-        self.scheduler = (CurriculumScheduler(curriculum_config)
-                          if curriculum_config else None)
         self.seed = seed
         self.global_step = 0
+        # normalize to {name: array} / {name: scheduler} / {name: type}
+        self.metrics = {}
+        if difficulties is not None and not isinstance(difficulties, dict):
+            difficulties = {"difficulty": np.asarray(difficulties)}
+        metric_cfgs = {}
+        if curriculum_config:
+            if "curriculum_metrics" in curriculum_config:
+                metric_cfgs = curriculum_config["curriculum_metrics"]
+                if difficulties and set(difficulties) == {"difficulty"} \
+                        and "difficulty" not in metric_cfgs:
+                    # a bare array paired with a single named metric config:
+                    # key the array to that metric rather than silently
+                    # attaching no scheduler at all
+                    assert len(metric_cfgs) == 1, (
+                        "a bare difficulties array cannot pair with multiple "
+                        "curriculum_metrics — pass {name: array} instead")
+                    difficulties = {next(iter(metric_cfgs)):
+                                    difficulties["difficulty"]}
+            elif difficulties:
+                metric_cfgs = {n: curriculum_config for n in difficulties}
+        if difficulties:
+            types = difficulty_types or {}
+            for name, vals in difficulties.items():
+                mc = metric_cfgs.get(name)
+                arr = np.asarray(vals)
+                self.metrics[name] = {
+                    "values": arr,
+                    # percentile thresholds read this once-sorted copy
+                    # (np.percentile would re-sort the full array per batch)
+                    "sorted": np.sort(arr),
+                    "scheduler": CurriculumScheduler(mc) if mc else None,
+                    "type": types.get(name) or (mc or {}).get(
+                        "difficulty_type", "value"),
+                }
+
+    @property
+    def scheduler(self):
+        """Single-metric convenience (legacy callers): THE scheduler, or None."""
+        scheds = [m["scheduler"] for m in self.metrics.values()
+                  if m["scheduler"] is not None]
+        return scheds[0] if len(scheds) == 1 else None
+
+    @property
+    def difficulties(self):
+        """Single-metric convenience: THE difficulty array, or None."""
+        if len(self.metrics) == 1:
+            return next(iter(self.metrics.values()))["values"]
+        return None
+
+    @classmethod
+    def from_config(cls, dataset_len, batch_size, curriculum_learning, seed=0):
+        """Build from the reference `curriculum_learning` block, loading each
+        metric's merged analyzer index (sample_to_metric.npy)."""
+        metrics_cfg = curriculum_learning.get("curriculum_metrics") or {}
+        assert metrics_cfg, ("curriculum_learning.curriculum_metrics is empty "
+                             "— run the DataAnalyzer and point each metric at "
+                             "its index (index_to_metric_path)")
+        difficulties = {}
+        for name, m in metrics_cfg.items():
+            path = (m.get("index_to_metric_path")
+                    or m.get("sample_to_metric_path") or m.get("index_path"))
+            assert path, (f"curriculum metric {name!r} needs "
+                          "index_to_metric_path (the DataAnalyzer output)")
+            vals = np.load(_resolve_metric_path(path, name))
+            assert len(vals) == dataset_len, (
+                f"metric {name!r} index covers {len(vals)} samples but the "
+                f"dataset has {dataset_len} — rebuild the analyzer index")
+            difficulties[name] = vals
+        return cls(dataset_len, batch_size, difficulties=difficulties,
+                   curriculum_config=curriculum_learning, seed=seed)
+
+    # -- scheduling ------------------------------------------------------
 
     def set_step(self, global_step):
         self.global_step = global_step
-        if self.scheduler is not None:
-            self.scheduler.update_difficulty(global_step)
+        for m in self.metrics.values():
+            if m["scheduler"] is not None:
+                m["scheduler"].update_difficulty(global_step)
+
+    def _metric_pool(self, m):
+        vals, sched = m["values"], m["scheduler"]
+        if sched is None:
+            return None
+        limit = sched.current_difficulty
+        if m["type"] == "percentile":
+            # scheduled difficulty is a percentile in [0, 100]; index the
+            # pre-sorted copy instead of re-sorting per batch
+            q = np.clip(limit, 0, 100) / 100.0
+            s = m["sorted"]
+            limit = s[min(int(q * (len(s) - 1) + 0.5), len(s) - 1)]
+        return vals <= limit
 
     def candidate_pool(self):
-        if self.scheduler is None or self.difficulties is None:
+        mask = None
+        for m in self.metrics.values():
+            mm = self._metric_pool(m)
+            if mm is None:
+                continue
+            mask = mm if mask is None else (mask & mm)
+        if mask is None:
             return np.arange(self.dataset_len)
-        limit = self.scheduler.current_difficulty
-        pool = np.nonzero(self.difficulties <= limit)[0]
+        pool = np.nonzero(mask)[0]
         if len(pool) < self.batch_size:          # never starve the batch
-            order = np.argsort(self.difficulties)
-            pool = order[:self.batch_size]
+            # fall back to the easiest samples by the (first) metric sum
+            total = sum(m["values"].astype(np.float64)
+                        for m in self.metrics.values())
+            pool = np.argsort(total)[:self.batch_size]
         return pool
 
     def next_indices(self):
+        for m in self.metrics.values():
+            if m["scheduler"] is not None:
+                m["scheduler"].update_difficulty(self.global_step)
         pool = self.candidate_pool()
         # stateless draw keyed on (seed, global_step): checkpoint resume at step N
         # continues the exact uninterrupted sequence
@@ -49,8 +161,6 @@ class DeepSpeedDataSampler:
         idx = rng.choice(pool, size=self.batch_size,
                          replace=len(pool) < self.batch_size)
         self.global_step += 1
-        if self.scheduler is not None:
-            self.scheduler.update_difficulty(self.global_step)
         return idx
 
     def __iter__(self):

@@ -44,6 +44,36 @@ def _default_collate(samples):
     return np.stack(samples)
 
 
+class CurriculumDataLoader:
+    """Difficulty-driven loader: each batch's sample indices come from a
+    `DeepSpeedDataSampler` (metric-index curriculum) instead of a shuffle —
+    the loader-level analog of the reference's sampler-in-DataLoader wiring
+    (`data_pipeline/data_sampling/data_sampler.py:36` consumed via
+    `engine.deepspeed_io`). One "epoch" yields dataset_len // batch_size
+    batches; the sampler's step advances monotonically across epochs so the
+    difficulty ramp never resets."""
+
+    def __init__(self, dataset, batch_size, sampler, collate_fn=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.sampler = sampler
+        self.collate_fn = collate_fn or _default_collate
+
+    def __len__(self):
+        return max(len(self.dataset) // self.batch_size, 1)
+
+    def __iter__(self):
+        for _ in range(len(self)):
+            idx = self.sampler.next_indices()
+            yield self.collate_fn([self.dataset[int(i)] for i in idx])
+
+    def state_dict(self):
+        return self.sampler.state_dict()
+
+    def load_state_dict(self, sd):
+        self.sampler.load_state_dict(sd)
+
+
 class TpuDataLoader:
     """Batches an indexable dataset; drops the ragged tail (matching drop_last)."""
 

@@ -291,7 +291,9 @@ class Engine:
         cl = (de.data_sampling or {}).get("curriculum_learning", {}) \
             if de and de.enabled else {}
         self.curriculum_scheduler = None
-        if cl.get("enabled"):
+        if cl.get("enabled") and not cl.get("curriculum_metrics"):
+            # legacy in-batch seqlen masking; the v2 metric-driven pipeline
+            # (curriculum_metrics) selects SAMPLES in deepspeed_io instead
             from deepspeed_tpu.runtime.data_pipeline.curriculum import CurriculumScheduler
             self.curriculum_scheduler = CurriculumScheduler(cl)
 
@@ -302,9 +304,8 @@ class Engine:
         # count, so XLA compiles one program per distinct kept count (<=
         # n_layer of them) and the dropped layers' flops genuinely disappear
         pld_cfg = self.config.progressive_layer_drop
-        rl_enabled = bool(((de.data_routing or {}).get("random_ltd", {})
-                           if de and de.enabled else {}).get("enabled"))
-        if pld_cfg.enabled or rl_enabled:
+        rl = (de.data_routing or {}).get("random_ltd", {}) if de and de.enabled else {}
+        if pld_cfg.enabled or rl.get("enabled"):
             # fail LOUDLY at init if the model cannot consume the routing
             # directives (only the zoo's gpt_loss reads them; a pipeline or
             # custom-loss model would otherwise silently train at full cost
@@ -330,7 +331,6 @@ class Engine:
         # host-side; the kept count ramps by schedule and is bucketed, so each
         # bucket is one compiled program (the reference's reserved-length
         # buckets)
-        rl = (de.data_routing or {}).get("random_ltd", {}) if de and de.enabled else {}
         self.random_ltd_scheduler = None
         if rl.get("enabled"):
             from deepspeed_tpu.runtime.data_pipeline.random_ltd import \
@@ -350,6 +350,15 @@ class Engine:
             else:
                 start_layer = int(rl.get("ltd_start_layer", 1))
                 end_layer = rl.get("ltd_end_layer")
+            model_layers = getattr(self.model_spec.arch_cfg, "n_layer", None)
+            if model_layers is not None:
+                assert total_layers == model_layers, (
+                    f"random_ltd total_layer_num={total_layers} does not match "
+                    f"the model's n_layer={model_layers}")
+                last = end_layer if end_layer is not None else model_layers - 1
+                assert 0 <= start_layer <= last < model_layers, (
+                    f"random_ltd layer range [{start_layer}, {last}] is out of "
+                    f"bounds for an {model_layers}-layer model")
             self.random_ltd_scheduler = RandomLTDScheduler(
                 total_layers=total_layers,
                 start_ratio=float(sched.get("min_value", 0.5)),
@@ -1140,9 +1149,27 @@ class Engine:
 
     def deepspeed_io(self, dataset, batch_size=None, collate_fn=None, shuffle=True):
         """Build the training dataloader (reference `engine.deepspeed_io`,
-        engine.py:1661): global batch = micro_bs × dp × gas per train_batch call."""
+        engine.py:1661): global batch = micro_bs × dp × gas per train_batch call.
+
+        When `data_efficiency.data_sampling.curriculum_learning` carries
+        `curriculum_metrics` (the v2 metric-driven pipeline), the loader is a
+        `CurriculumDataLoader` over a `DeepSpeedDataSampler` that consumes the
+        offline DataAnalyzer indexes — each batch draws from the pool of
+        samples whose metrics are within the scheduled difficulty (reference
+        `data_sampling/data_sampler.py:36`)."""
         bs = batch_size or (self.micro_batch_size * self.spec.data *
                             self.gradient_accumulation_steps_value)
+        de = self.config.data_efficiency
+        cl = (de.data_sampling or {}).get("curriculum_learning", {}) \
+            if de and de.enabled else {}
+        if cl.get("enabled") and cl.get("curriculum_metrics"):
+            from deepspeed_tpu.runtime.data_pipeline.data_sampler import \
+                DeepSpeedDataSampler
+            from deepspeed_tpu.runtime.dataloader import CurriculumDataLoader
+            sampler = DeepSpeedDataSampler.from_config(
+                len(dataset), bs, cl, seed=self.config.seed)
+            return CurriculumDataLoader(dataset, bs, sampler,
+                                        collate_fn=collate_fn)
         return TpuDataLoader(dataset, bs, collate_fn=collate_fn, shuffle=shuffle,
                              seed=self.config.seed)
 
@@ -1198,6 +1225,11 @@ class Engine:
             "skipped_steps": self.skipped_steps,
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler else None,
         })
+        if hasattr(self.training_dataloader, "state_dict"):
+            # curriculum sampler position (reference data sampler
+            # state_dict/load_state_dict): resume continues the exact
+            # difficulty ramp + stateless draw sequence
+            client_state["data_sampler"] = self.training_dataloader.state_dict()
         return _save(self, save_dir, tag=tag, client_state=client_state, save_latest=save_latest)
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
@@ -1213,6 +1245,9 @@ class Engine:
             sd = client_state.get("lr_scheduler")
             if sd and self.lr_scheduler is not None and load_lr_scheduler_states:
                 self.lr_scheduler.load_state_dict(sd)
+            dsd = client_state.get("data_sampler")
+            if dsd and hasattr(self.training_dataloader, "load_state_dict"):
+                self.training_dataloader.load_state_dict(dsd)
         return path, client_state
 
     def get_fp32_state_dict(self):

@@ -93,8 +93,12 @@ class TestPLD:
                 best = min(best, time.perf_counter() - t0)
             return best
 
-        t_full = timed(list(range(16)))
-        t_quarter = timed([0, 5, 10, 15])
+        # timing on a shared CPU runner is noisy: retry once before failing
+        for attempt in range(2):
+            t_full = timed(list(range(16)))
+            t_quarter = timed([0, 5, 10, 15])
+            if t_quarter < 0.8 * t_full:
+                break
         assert t_quarter < 0.8 * t_full, (t_quarter, t_full)
 
 
@@ -174,8 +178,11 @@ class TestRandomLTD:
                 best = min(best, time.perf_counter() - t0)
             return best
 
-        t_full = timed(T)
-        t_sub = timed(32)
+        for attempt in range(2):
+            t_full = timed(T)
+            t_sub = timed(32)
+            if t_sub < 0.92 * t_full:
+                break
         assert t_sub < 0.92 * t_full, (t_sub, t_full)
 
     def test_scheduler_buckets(self):
