@@ -59,6 +59,58 @@ def prune_magnitude(w, sparsity_ratio, method="l1", dim=None):
     return w * mask
 
 
+def quantize_activation(x, bits=8, symmetric=True):
+    """Activation fake-quant with STE (reference `basic_layer.py` QuantAct
+    role: per-tensor dynamic range calibration on each forward).
+
+    symmetric: scale by max|x|; asymmetric: affine [min, max] with a zero
+    point (better for post-gelu activations, which are skewed positive)."""
+    if not bits or bits <= 0:
+        return x
+    xf = x.astype(jnp.float32)
+    if symmetric:
+        qmax = 2.0 ** (bits - 1) - 1
+        amax = jnp.max(jnp.abs(xf))
+        scale = jnp.maximum(amax, 1e-8) / qmax
+        q = jnp.clip(jnp.round(xf / scale), -qmax - 1, qmax) * scale
+    else:
+        levels = 2.0 ** bits - 1
+        lo = jnp.min(xf)
+        hi = jnp.max(xf)
+        scale = jnp.maximum(hi - lo, 1e-8) / levels
+        q = jnp.round((xf - lo) / scale) * scale + lo
+    q = q.astype(x.dtype)
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def snip_momentum_mask(w, m, sparsity_ratio, block=(4, 1)):
+    """Structured SNIP-momentum pruning mask (reference sparse_pruning method
+    "snip_momentum" + `helper.py` block granularity): importance = |w * m|
+    (m = the optimizer's momentum-averaged gradient — Adam's exp_avg plays
+    the accumulated-|w*grad| role), scored at `block` granularity over the
+    LAST TWO dims, lowest `sparsity_ratio` fraction of blocks zeroed."""
+    if sparsity_ratio <= 0:
+        return jnp.ones_like(w)
+    br, bc = block
+    R, C = w.shape[-2], w.shape[-1]
+    assert R % br == 0 and C % bc == 0, (
+        f"snip_momentum block {block} must divide the weight dims {(R, C)}")
+    imp = jnp.abs(w.astype(jnp.float32) * m.astype(jnp.float32))
+    blocked = imp.reshape(*w.shape[:-2], R // br, br, C // bc, bc)
+    score = blocked.sum(axis=(-3, -1))                       # [..., R/br, C/bc]
+    k = int(score.size * sparsity_ratio)
+    if k == 0:
+        return jnp.ones_like(w)
+    # rank-based EXACT-k pruning: a threshold compare would zero every block
+    # tied at the threshold (e.g. all zero-importance blocks at small ratios,
+    # overshooting the scheduled ramp by an arbitrary amount)
+    order = jnp.argsort(score.reshape(-1))
+    keep_flat = jnp.ones((score.size,), w.dtype).at[order[:k]].set(0)
+    keep = keep_flat.reshape(score.shape)
+    mask = jnp.repeat(jnp.repeat(keep, br, axis=-2), bc, axis=-1)
+    return mask.reshape(w.shape)
+
+
 def head_prune(w_qkv, num_heads, ratio):
     """Head pruning for fused qkv weights [.., D, 3D]: zero lowest-norm heads."""
     if ratio <= 0:

@@ -26,7 +26,8 @@ def _extract_groups(comp_config):
     groups = []
     if hasattr(comp_config, "to_dict"):
         comp_config = comp_config.to_dict()
-    for kind in ("weight_quantization", "sparse_pruning", "row_pruning", "head_pruning"):
+    for kind in ("weight_quantization", "sparse_pruning", "row_pruning",
+                 "head_pruning", "channel_pruning", "activation_quantization"):
         block = comp_config.get(kind) or {}
         shared = block.get("shared_parameters", {})
         if not shared.get("enabled", bool(block.get("enabled", False))):
@@ -46,6 +47,12 @@ def _match(path, patterns):
     return any(p == "*" or re.search(p.replace("*", ".*"), path) for p in patterns)
 
 
+def _path_str(path):
+    """Keypath → string; ONE definition shared with pruners.py — mask keys
+    and transform lookups must stringify identically."""
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
 def _transform_leaf(kind, params, leaf, scheduler=None):
     if leaf.ndim < 2:
         return leaf
@@ -57,23 +64,35 @@ def _transform_leaf(kind, params, leaf, scheduler=None):
         bits = params.get("start_bits", params.get("target_bits", 8))
         return fake_quantize(leaf, bits=int(bits))
     if kind == "sparse_pruning":
+        if params.get("method") == "snip_momentum":
+            return leaf  # stateful: masks applied via SnipMomentumPruner
         return prune_magnitude(leaf, 1 - params.get("dense_ratio", 0.5))
     if kind == "row_pruning":
         return prune_magnitude(leaf, 1 - params.get("dense_ratio", 0.5), dim=leaf.ndim - 2)
+    if kind == "channel_pruning":
+        # output-channel pruning (reference channel_pruning on conv/linear
+        # out dims): whole columns of the 2D weight
+        return prune_magnitude(leaf, 1 - params.get("dense_ratio", 0.5), dim=leaf.ndim - 1)
     if kind == "head_pruning":
         return leaf  # needs head count; applied via model-specific hook
+    if kind == "activation_quantization":
+        return leaf  # applies to activations, wired through the model cfg
     return leaf
 
 
-def _build_param_transform(groups, scheduler=None):
+def _build_param_transform(groups, scheduler=None, pruner=None):
     def transform(params):
         def leaf_fn(path, leaf):
-            pstr = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+            pstr = _path_str(path)
             out = leaf
             for kind, gparams, patterns in groups:
                 if _match(pstr, patterns):
                     sched = scheduler if kind == "weight_quantization" else None
                     out = _transform_leaf(kind, gparams, out, scheduler=sched)
+            if pruner is not None:
+                # snip_momentum masks (trace-time constants; the engine
+                # retraces on each scheduled refresh)
+                out = pruner.apply(pstr, out)
             return out
 
         return jax.tree_util.tree_map_with_path(leaf_fn, params)
@@ -142,18 +161,63 @@ def init_compression(model, deepspeed_config, teacher_model=None, mpu=None):
         params = apply_layer_reduction(src, lr_cfg)
 
     inner_loss = model.loss_fn
+
+    # activation quantization: wired through the zoo config (the reference
+    # quantizes each compressed linear's INPUT inside LinearLayer_Compress;
+    # here models/gpt reads cfg.act_quant at trace time). Models without an
+    # arch_cfg cannot consume it -> fail loudly, not silently.
+    act_gate = None
+    aq = [g for g in groups if g[0] == "activation_quantization"]
+    if aq:
+        from deepspeed_tpu.compression.pruners import ActQuantGate
+        gp = aq[0][1]
+        act_gate = ActQuantGate(
+            bits=int(gp.get("bits", gp.get("start_bits", 8))),
+            symmetric=gp.get("quantization_type", "symmetric") == "symmetric",
+            schedule_offset=int(gp.get("schedule_offset", 0)),
+            schedule_offset_end=gp.get("schedule_offset_end"))
+        arch = getattr(model, "arch_cfg", None)
+        assert arch is not None and hasattr(arch, "act_quant"), (
+            "activation_quantization needs a model whose config consumes "
+            "cfg.act_quant (the GPT zoo); this model has no arch_cfg")
+        import dataclasses as _dc
+        new_arch = _dc.replace(arch, act_quant=act_gate)
+        import functools as _ft
+        assert isinstance(inner_loss, _ft.partial) and "cfg" in inner_loss.keywords, (
+            "activation_quantization: cannot rebind the model config on a "
+            "non-zoo loss function")
+        inner_loss = _ft.partial(inner_loss.func, *inner_loss.args,
+                                 **{**inner_loss.keywords, "cfg": new_arch})
+
+    pruner = None
+    snip = [g for g in groups if g[0] == "sparse_pruning"
+            and g[1].get("method") == "snip_momentum"]
+    if snip:
+        from deepspeed_tpu.compression.pruners import SnipMomentumPruner
+        gp, mods = snip[0][1], snip[0][2]
+        pruner = SnipMomentumPruner(
+            params, modules=mods,
+            dense_ratio=float(gp.get("dense_ratio", 0.1)),
+            block_pattern=gp.get("block_pattern", "4x1"),
+            schedule_offset=int(gp.get("schedule_offset", 0)),
+            schedule_offset_end=gp.get("schedule_offset_end"),
+            frequency=int(gp.get("frequency", 100)))
+
     scheduler = None
     if groups:
         n_layers = 1
         if isinstance(params, dict) and "blocks" in params:
             n_layers = jax.tree_util.tree_leaves(params["blocks"])[0].shape[0]
         scheduler = _build_moq_scheduler(groups, n_layers)
-        transform = _build_param_transform(groups, scheduler=scheduler)
+        transform = _build_param_transform(groups, scheduler=scheduler,
+                                           pruner=pruner)
 
         def compressed_loss(params, batch, rng=None):
             return inner_loss(transform(params), batch, rng)
     else:
         compressed_loss = inner_loss
+
+    steppers = [s for s in (act_gate, pruner) if s is not None]
 
     logger.info(f"compression enabled: {[g[0] for g in groups]}"
                 + (" + layer_reduction" if lr_cfg.get("enabled") else "")
@@ -161,7 +225,9 @@ def init_compression(model, deepspeed_config, teacher_model=None, mpu=None):
     return ModelSpec(loss_fn=compressed_loss, params=params,
                      param_specs=model.param_specs, apply_fn=model.apply_fn,
                      has_aux=model.has_aux, name=model.name + "+compress",
-                     quantize_scheduler=scheduler)
+                     arch_cfg=getattr(model, "arch_cfg", None),
+                     quantize_scheduler=scheduler,
+                     compression_steppers=steppers or None)
 
 
 def redundancy_clean(model_or_params, deepspeed_config, mpu=None):

@@ -1,0 +1,149 @@
+"""Stateful compression: SNIP-momentum structured pruning + the activation
+fake-quant schedule gate.
+
+Reference: `compression/compress.py:100` routes sparse_pruning method
+"snip_momentum" to an importance-accumulating structured pruner whose
+sparsity follows a cubic ramp (`compression/helper.py`), and activation
+quantization turns on at its `schedule_offset`. Both are TRACE-TIME state
+here: the engine calls `.step(engine)` once per optimizer step; a True
+return means the compiled step must be rebuilt (same retrace contract as
+the MoQ scheduler, `runtime/quantize.py`).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.compression.basic_layer import snip_momentum_mask
+from deepspeed_tpu.compression.scheduler import CompressionScheduler
+from deepspeed_tpu.utils.logging import logger
+
+
+def _shared():
+    # compress.py owns the path/pattern helpers (mask keys and transform
+    # lookups must stringify identically); imported lazily to avoid a cycle
+    from deepspeed_tpu.compression.compress import _match, _path_str
+    return _match, _path_str
+
+
+class SnipMomentumPruner:
+    """Block-structured pruning with |w * exp_avg| importance.
+
+    The reference accumulates |w*grad| with momentum; Adam's exp_avg IS the
+    momentum-averaged gradient, so importance reads the optimizer state the
+    engine already holds — no extra per-step compute. Masks refresh at the
+    scheduler's frequency along a cubic sparsity ramp and are baked into the
+    retraced step as constants (one retrace per refresh)."""
+
+    def __init__(self, params, modules=("*",), dense_ratio=0.1,
+                 block_pattern="4x1", schedule_offset=0,
+                 schedule_offset_end=None, frequency=100):
+        self.patterns = list(modules)
+        self.target_sparsity = 1.0 - float(dense_ratio)
+        r, c = (int(v) for v in str(block_pattern).lower().split("x"))
+        self.block = (r, c)
+        self.sched = CompressionScheduler(schedule_offset, schedule_offset_end,
+                                          frequency)
+        self.total_steps = (schedule_offset_end
+                            if schedule_offset_end is not None
+                            else schedule_offset + 10 * frequency)
+        self.masks = {}          # path str -> jnp mask (trace-time constants)
+        _match, _path_str = _shared()
+        self._matching = [
+            _path_str(path) for path, leaf in
+            jax.tree_util.tree_flatten_with_path(params)[0]
+            if leaf.ndim >= 2 and _match(_path_str(path), self.patterns)
+            and leaf.shape[-2] % self.block[0] == 0
+            and leaf.shape[-1] % self.block[1] == 0]
+
+    def current_ratio(self, step):
+        return self.sched.ratio(step, start_ratio=0.0,
+                                target_ratio=self.target_sparsity,
+                                total_steps=self.total_steps)
+
+    def step(self, engine):
+        step = engine.global_steps
+        if not self.sched.is_active(step):
+            return False
+        if self.current_ratio(step) <= 0:
+            return False
+        return self._refresh(engine, step)
+
+    def _refresh(self, engine, step):
+        ratio = self.current_ratio(step)
+        _, _path_str = _shared()
+        params = engine.state.params
+        mu = _find_momentum(engine.state.opt_state)
+        flat_p = {_path_str(path): leaf for path, leaf in
+                  jax.tree_util.tree_flatten_with_path(params)[0]}
+        flat_m = ({_path_str(path): leaf for path, leaf in
+                   jax.tree_util.tree_flatten_with_path(mu)[0]}
+                  if mu is not None else {})
+        for pstr in self._matching:
+            w = flat_p.get(pstr)
+            m = flat_m.get(pstr, w)  # no momentum (e.g. SGD): |w*w| magnitude
+            if w is None:
+                continue
+            self.masks[pstr] = snip_momentum_mask(w, m, ratio, self.block)
+        logger.info(f"snip_momentum: masks refreshed at step {step} "
+                    f"(sparsity {ratio:.3f}, {len(self.masks)} leaves)")
+        return True
+
+    def apply(self, pstr, leaf):
+        mask = self.masks.get(pstr)
+        return leaf if mask is None else leaf * mask.astype(leaf.dtype)
+
+    def on_resume(self, engine):
+        """Checkpoint load: masks are DERIVED state (params + optimizer
+        momentum + restored step counter) — rebuild them immediately instead
+        of waiting up to frequency-1 steps (during which weights would regrow
+        into pruned slots)."""
+        step = engine.global_steps
+        if step < self.sched.offset or self.current_ratio(step) <= 0:
+            return False
+        return self._refresh(engine, step)
+
+
+def _find_momentum(opt_state):
+    """Locate the Adam/momentum first-moment tree inside an optax state."""
+    found = []
+
+    def walk(s):
+        if hasattr(s, "mu"):
+            found.append(s.mu)
+        elif hasattr(s, "trace"):
+            found.append(s.trace)
+        elif isinstance(s, (tuple, list)):
+            for c in s:
+                walk(c)
+
+    walk(opt_state)
+    return found[0] if found else None
+
+
+class ActQuantGate:
+    """Activation fake-quant schedule gate (reference activation_quantization
+    shared_parameters.schedule_offset): `active`/`bits` are read at TRACE
+    time by the model (GPTConfig.act_quant); the engine retraces when the
+    gate flips on/off."""
+
+    def __init__(self, bits=8, symmetric=True, schedule_offset=0,
+                 schedule_offset_end=None):
+        self.bits = int(bits)
+        self.symmetric = bool(symmetric)
+        self.offset = schedule_offset
+        self.offset_end = schedule_offset_end
+        self.active = schedule_offset <= 0
+
+    def step(self, engine):
+        want = engine.global_steps >= self.offset and (
+            self.offset_end is None or engine.global_steps <= self.offset_end)
+        if want != self.active:
+            self.active = want
+            logger.info(f"activation quantization {'ON' if want else 'OFF'} "
+                        f"at step {engine.global_steps} ({self.bits} bits)")
+            return True
+        return False
+
+    # gate state is a pure function of the restored step counter
+    on_resume = step

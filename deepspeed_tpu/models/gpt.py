@@ -69,6 +69,10 @@ class GPTConfig:
                                      # saved ~150MB/layer from HBM; kept as an
                                      # option for bandwidth-rich parts
     use_flash_attention: bool = False  # pallas kernel (ops/pallas/flash_attention.py)
+    act_quant: Any = None            # ActQuantGate (compression/pruners.py):
+                                     # when .active, each block linear's INPUT
+                                     # is fake-quantized to .bits with STE
+                                     # (reference basic_layer QuantAct role)
     loss_chunks: int = 0             # >0: chunked-vocab CE (ops/chunked_ce.py)
                                      # — never materializes [B,T,V] logits;
                                      # frees ~1.2G peak HBM at 50k vocab for
@@ -420,9 +424,20 @@ def _attention(q, k, v, causal_mask, cfg, attn_fn=None, bias=None):
     return out.reshape(B, T, H, hd)
 
 
+def _act_quant(x, cfg):
+    """Activation fake-quant at a linear input, gated by the compression
+    schedule (trace-time read; the engine retraces when the gate flips)."""
+    gate = getattr(cfg, "act_quant", None)
+    if gate is None or not gate.active:
+        return x
+    from deepspeed_tpu.compression.basic_layer import quantize_activation
+    return quantize_activation(x, gate.bits, symmetric=gate.symmetric)
+
+
 def _mlp(h, p, cfg, constrain=True):
     """MLP half-block: gated (swiglu) or plain with configurable activation.
     `constrain=False` on the decode path ([B, 1, F] can't shard on sequence)."""
+    h = _act_quant(h, cfg)
     if cfg.use_swiglu:
         up = jax.nn.silu(h @ p["mlp_gate_w"]) * (h @ p["mlp_up_w"])
     else:
@@ -430,6 +445,7 @@ def _mlp(h, p, cfg, constrain=True):
     up = _ckpt_name(up, "mlp_up")
     if constrain:
         up = shard_constraint(up, BATCH_AXES, SEQ_AXIS, TENSOR_AXIS)
+    up = _act_quant(up, cfg)
     return _ckpt_name(up @ p["mlp_down_w"] + p["mlp_out_b"], "mlp_down")
 
 
@@ -453,6 +469,7 @@ def _attn_half(x, p, cfg: GPTConfig, positions, attn_fn=None, constrain=True,
     H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
 
     h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm, cfg.norm_eps)
+    h = _act_quant(h, cfg)
     qkv = _ckpt_name(h @ p["attn_qkv_w"] + p["attn_qkv_b"], "qkv_proj")
     q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
     q = q.reshape(B, T, H, hd)

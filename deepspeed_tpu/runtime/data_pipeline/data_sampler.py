@@ -66,14 +66,16 @@ class DeepSpeedDataSampler:
             for name, vals in difficulties.items():
                 mc = metric_cfgs.get(name)
                 arr = np.asarray(vals)
+                mtype = types.get(name) or (mc or {}).get(
+                    "difficulty_type", "value")
                 self.metrics[name] = {
                     "values": arr,
-                    # percentile thresholds read this once-sorted copy
-                    # (np.percentile would re-sort the full array per batch)
-                    "sorted": np.sort(arr),
+                    # percentile thresholds read a once-sorted copy
+                    # (np.percentile would re-sort per batch); value-type
+                    # metrics never touch it, so don't pay the memory
+                    "sorted": np.sort(arr) if mtype == "percentile" else None,
                     "scheduler": CurriculumScheduler(mc) if mc else None,
-                    "type": types.get(name) or (mc or {}).get(
-                        "difficulty_type", "value"),
+                    "type": mtype,
                 }
 
     @property

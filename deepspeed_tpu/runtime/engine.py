@@ -66,6 +66,8 @@ class ModelSpec:
     quantize_scheduler: Any = None        # MoQScheduler from init_compression —
                                           # the engine advances it per step and
                                           # retraces when bit widths change
+    compression_steppers: Any = None      # [SnipMomentumPruner/ActQuantGate]:
+                                          # .step(engine) -> retrace-needed
     has_aux: bool = False
     arch_cfg: Any = None                  # architecture config (e.g. GPTConfig)
                                           # — lets the flops profiler build a
@@ -282,6 +284,7 @@ class Engine:
         # MoQ: progressive quantization schedule + curvature cache
         # (reference engine.py:214-215 eigenvalue/block_eigenvalue)
         self.quantize_scheduler = model.quantize_scheduler
+        self.compression_steppers = model.compression_steppers or []
         self.block_eigenvalue = None
 
         # curriculum learning: legacy seqlen scheduling applied in train_batch
@@ -291,6 +294,13 @@ class Engine:
         cl = (de.data_sampling or {}).get("curriculum_learning", {}) \
             if de and de.enabled else {}
         self.curriculum_scheduler = None
+        if cl.get("enabled") and cl.get("curriculum_metrics") \
+                and training_data is None:
+            logger.warning(
+                "curriculum_learning.curriculum_metrics is configured but no "
+                "training_data was passed to initialize(): the metric-driven "
+                "sampler only applies to loaders built by engine.deepspeed_io "
+                "— batches from a user data_iter will NOT be difficulty-gated")
         if cl.get("enabled") and not cl.get("curriculum_metrics"):
             # legacy in-batch seqlen masking; the v2 metric-driven pipeline
             # (curriculum_metrics) selects SAMPLES in deepspeed_io instead
@@ -926,7 +936,32 @@ class Engine:
                 self._flops_profiler = FlopsProfiler(ds_engine=self)
         self._after_step(metrics, count_micro=True)
         self._maybe_step_moq(batch)
+        self._maybe_step_compression()
         return metrics["loss"]
+
+    def _maybe_step_compression(self):
+        """Advance stateful compression (snip_momentum masks, activation-
+        quant schedule gates); a True step() means trace-time state changed
+        and the compiled programs must be rebuilt (same contract as MoQ).
+        Stepper errors propagate — a swallowed failure would silently train
+        uncompressed (fail-loud policy)."""
+        retrace = False
+        for s in self.compression_steppers:
+            retrace = bool(s.step(self)) or retrace
+        if retrace:
+            self._rebuild_compiled_steps()
+
+    def _rebuild_compiled_steps(self):
+        """Invalidate every program that bakes trace-time compression state
+        (fake-quant bits, pruning masks, act-quant gates) in as constants —
+        including the host-optimizer path's grad program."""
+        if self._train_step is not None:
+            self._train_step = self._build_train_step()
+        if getattr(self, "_grad_program", None) is not None:
+            self._grad_program = self._build_grad_program()
+        self._eval_step = self._build_eval_step()
+        self._grad_step = None
+        self._apply_step = None
 
     def _inject_routing_directives(self, batch):
         """Host-side per-step sampling for PLD / random-LTD, delivered as
@@ -1010,11 +1045,7 @@ class Engine:
                 logger.warning(f"eigenvalue estimation unavailable for this "
                                f"model layout ({e}); MoQ advances uncurved")
         if sched.step(ev):
-            if self._train_step is not None:
-                self._train_step = self._build_train_step()
-            self._eval_step = self._build_eval_step()
-            self._grad_step = None
-            self._apply_step = None
+            self._rebuild_compiled_steps()
 
     def eval_batch(self, batch, rng=None):
         placed = self._shard_batch(batch, for_scan=False)
@@ -1162,7 +1193,11 @@ class Engine:
         de = self.config.data_efficiency
         cl = (de.data_sampling or {}).get("curriculum_learning", {}) \
             if de and de.enabled else {}
-        if cl.get("enabled") and cl.get("curriculum_metrics"):
+        # curriculum replaces the SHUFFLED training pass only; shuffle=False
+        # (sequential eval/validation) keeps the plain loader — eval must not
+        # be difficulty-gated and a differently-sized set would not match the
+        # analyzer index anyway
+        if shuffle and cl.get("enabled") and cl.get("curriculum_metrics"):
             from deepspeed_tpu.runtime.data_pipeline.data_sampler import \
                 DeepSpeedDataSampler
             from deepspeed_tpu.runtime.dataloader import CurriculumDataLoader
@@ -1248,6 +1283,16 @@ class Engine:
             dsd = client_state.get("data_sampler")
             if dsd and hasattr(self.training_dataloader, "load_state_dict"):
                 self.training_dataloader.load_state_dict(dsd)
+        if self.compression_steppers:
+            # stepper state is DERIVED (masks from params+opt_state, gates
+            # from the restored step counter) — recompute instead of
+            # serializing device arrays into the checkpoint
+            changed = False
+            for s in self.compression_steppers:
+                if hasattr(s, "on_resume"):
+                    changed = bool(s.on_resume(self)) or changed
+            if changed:
+                self._rebuild_compiled_steps()
         return path, client_state
 
     def get_fp32_state_dict(self):

@@ -53,6 +53,93 @@ class TestCompression:
 
 
 
+    def test_activation_quantization(self):
+        from deepspeed_tpu.compression.basic_layer import quantize_activation
+        x = jnp.asarray(np.random.default_rng(0).normal(0, 2, (16, 32)), jnp.float32)
+        q8 = quantize_activation(x, 8)
+        q4 = quantize_activation(x, 4)
+        e8 = np.abs(np.asarray(q8 - x)).max()
+        e4 = np.abs(np.asarray(q4 - x)).max()
+        assert 0 < e8 < e4, (e8, e4)
+        # asymmetric covers a skewed range more tightly
+        xs = jax.nn.relu(x)
+        ea = np.abs(np.asarray(quantize_activation(xs, 4, symmetric=False) - xs)).mean()
+        es = np.abs(np.asarray(quantize_activation(xs, 4, symmetric=True) - xs)).mean()
+        assert ea <= es * 1.01
+        # STE
+        g = jax.grad(lambda x: jnp.sum(quantize_activation(x, 4) * 3.0))(x)
+        np.testing.assert_allclose(np.asarray(g), 3.0)
+
+    def test_channel_pruning_kind(self):
+        from deepspeed_tpu.compression.compress import _extract_groups, \
+            _build_param_transform
+        groups = _extract_groups({"channel_pruning": {"shared_parameters": {
+            "enabled": True, "dense_ratio": 0.5}}})
+        assert groups and groups[0][0] == "channel_pruning"
+        w = jnp.asarray(np.arange(1, 65, dtype=np.float32).reshape(8, 8))
+        out = _build_param_transform(groups)({"w": w})["w"]
+        zero_cols = (np.asarray(out).sum(axis=0) == 0).sum()
+        assert zero_cols == 4  # half the OUTPUT channels zeroed
+
+    def test_snip_momentum_mask_blocks(self):
+        from deepspeed_tpu.compression.basic_layer import snip_momentum_mask
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.normal(0, 1, (8, 8)), jnp.float32)
+        m = jnp.asarray(rng.normal(0, 1, (8, 8)), jnp.float32)
+        mask = np.asarray(snip_momentum_mask(w, m, 0.5, block=(4, 1)))
+        # block structure: each 4x1 block is all-0 or all-1
+        blocks = mask.reshape(2, 4, 8)
+        assert ((blocks == blocks[:, :1, :]).all())
+        assert abs(mask.mean() - 0.5) < 0.2
+
+    def test_compression_depth_e2e(self):
+        """Verdict item: activation fake-quant (schedule-gated), channel
+        pruning and snip_momentum structured pruning drive a GPT model
+        through the engine — masks refresh on schedule, the act-quant gate
+        flips at its offset (engine retraces), and training stays finite."""
+        _reset()
+        from deepspeed_tpu.compression import init_compression
+        from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_model
+        gcfg = GPTConfig(n_layer=2, n_head=2, d_model=32, max_seq_len=16,
+                         vocab_size=64, dtype=jnp.float32, remat=False)
+        cfg = {
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 1000,
+            "mesh": {"data": 1},
+            "compression_training": {
+                "activation_quantization": {"shared_parameters": {
+                    "enabled": True, "bits": 8, "schedule_offset": 3}},
+                "channel_pruning": {"shared_parameters": {
+                    "enabled": True, "dense_ratio": 0.75},
+                    "different_groups": {"cp": {"params": {},
+                                                "modules": ["mlp_up_w"]}}},
+                "sparse_pruning": {"shared_parameters": {
+                    "enabled": True, "method": "snip_momentum",
+                    "dense_ratio": 0.5, "block_pattern": "4x1",
+                    "schedule_offset": 2, "frequency": 2},
+                    "different_groups": {"sp": {"params": {},
+                                                "modules": ["mlp_down_w"]}}},
+            },
+        }
+        spec = init_compression(make_gpt_model(cfg=gcfg), cfg)
+        assert spec.compression_steppers and len(spec.compression_steppers) == 2
+        engine, *_ = deepspeed_tpu.initialize(model=spec, config=cfg)
+        gate = [s for s in engine.compression_steppers
+                if type(s).__name__ == "ActQuantGate"][0]
+        pruner = [s for s in engine.compression_steppers
+                  if type(s).__name__ == "SnipMomentumPruner"][0]
+        assert not gate.active and not pruner.masks
+        toks = np.random.default_rng(0).integers(0, 64, (4, 16)).astype(np.int32)
+        losses = [float(engine.train_batch({"tokens": toks})) for _ in range(8)]
+        assert np.isfinite(losses).all()
+        assert gate.active, "act-quant gate never flipped on at its offset"
+        assert pruner.masks, "snip_momentum never produced masks"
+        mask = np.asarray(next(iter(pruner.masks.values())))
+        assert 0 < mask.mean() < 1, "mask is degenerate"
+        # masked leaf: scheduled ratio ramps toward 1 - dense_ratio
+        assert pruner.current_ratio(engine.global_steps) > 0
+
     def test_moq_scheduler_eigenvalue_changes_schedule(self):
         """Curvature must change the schedule: a layer with normalized ev 1.0
         gets factor 5 on its next period, a flat layer gets factor 1
