@@ -101,7 +101,7 @@ REF_MODEL_FLOPS_MFU = 204.49 * (6.0 / 8.0) / 312.0  # = 0.4916, see docstring
 
 
 def run_lane(model_name, batch, seq, gas, zero_stage, *, steps, warmup=3,
-             master=False, use_flash=False, remat=True,
+             master=False, use_flash=None, remat=True,
              policy="dots_with_no_batch_dims_saveable", sm_dtype=None,
              loss_chunks=0):
     """Build an engine for one configuration, time it, return the result dict."""
@@ -120,7 +120,8 @@ def run_lane(model_name, batch, seq, gas, zero_stage, *, steps, warmup=3,
 
     cfg = GPT2_CONFIGS[model_name]
     cfg = dataclasses.replace(
-        cfg, use_flash_attention=use_flash and seq % 128 == 0, remat=remat,
+        cfg, use_flash_attention=(use_flash if seq % 128 == 0 else False),
+        remat=remat,
         remat_policy=policy, softmax_dtype=sm_dtype or jnp.bfloat16,
         loss_chunks=loss_chunks)
     # abstract init: params materialize on-device (engine init_fn path) — the
@@ -247,7 +248,7 @@ def main():
         steps=int(env("BENCH_STEPS", str(max(8, 30 // gas)))),
         warmup=int(env("BENCH_WARMUP", "3")),
         master=env("BENCH_MASTER", "0") == "1",
-        use_flash=env("BENCH_FLASH", "0") == "1",
+        use_flash={"1": True, "0": False}.get(env("BENCH_FLASH", "auto")),
         remat=env("BENCH_REMAT", "1") == "1",
         policy=env("BENCH_REMAT_POLICY", "dots_with_no_batch_dims_saveable"),
         sm_dtype=sm, loss_chunks=int(env("BENCH_LOSS_CHUNKS", "0")))

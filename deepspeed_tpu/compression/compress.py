@@ -80,7 +80,7 @@ def _transform_leaf(kind, params, leaf, scheduler=None):
     return leaf
 
 
-def _build_param_transform(groups, scheduler=None, pruner=None):
+def _build_param_transform(groups, scheduler=None, pruners=()):
     def transform(params):
         def leaf_fn(path, leaf):
             pstr = _path_str(path)
@@ -89,7 +89,7 @@ def _build_param_transform(groups, scheduler=None, pruner=None):
                 if _match(pstr, patterns):
                     sched = scheduler if kind == "weight_quantization" else None
                     out = _transform_leaf(kind, gparams, out, scheduler=sched)
-            if pruner is not None:
+            for pruner in pruners or ():
                 # snip_momentum masks (trace-time constants; the engine
                 # retraces on each scheduled refresh)
                 out = pruner.apply(pstr, out)
@@ -170,6 +170,10 @@ def init_compression(model, deepspeed_config, teacher_model=None, mpu=None):
     aq = [g for g in groups if g[0] == "activation_quantization"]
     if aq:
         from deepspeed_tpu.compression.pruners import ActQuantGate
+        assert len(aq) == 1 and aq[0][2] == ["*"], (
+            "activation_quantization applies model-wide here (the gate rides "
+            "the model config, not per-leaf transforms) — per-module groups "
+            f"are not supported yet: {[(g[2]) for g in aq]}")
         gp = aq[0][1]
         act_gate = ActQuantGate(
             bits=int(gp.get("bits", gp.get("start_bits", 8))),
@@ -189,19 +193,17 @@ def init_compression(model, deepspeed_config, teacher_model=None, mpu=None):
         inner_loss = _ft.partial(inner_loss.func, *inner_loss.args,
                                  **{**inner_loss.keywords, "cfg": new_arch})
 
-    pruner = None
-    snip = [g for g in groups if g[0] == "sparse_pruning"
-            and g[1].get("method") == "snip_momentum"]
-    if snip:
+    pruners = []
+    for _, gp, mods in (g for g in groups if g[0] == "sparse_pruning"
+                        and g[1].get("method") == "snip_momentum"):
         from deepspeed_tpu.compression.pruners import SnipMomentumPruner
-        gp, mods = snip[0][1], snip[0][2]
-        pruner = SnipMomentumPruner(
+        pruners.append(SnipMomentumPruner(
             params, modules=mods,
             dense_ratio=float(gp.get("dense_ratio", 0.1)),
             block_pattern=gp.get("block_pattern", "4x1"),
             schedule_offset=int(gp.get("schedule_offset", 0)),
             schedule_offset_end=gp.get("schedule_offset_end"),
-            frequency=int(gp.get("frequency", 100)))
+            frequency=int(gp.get("frequency", 100))))
 
     scheduler = None
     if groups:
@@ -210,14 +212,14 @@ def init_compression(model, deepspeed_config, teacher_model=None, mpu=None):
             n_layers = jax.tree_util.tree_leaves(params["blocks"])[0].shape[0]
         scheduler = _build_moq_scheduler(groups, n_layers)
         transform = _build_param_transform(groups, scheduler=scheduler,
-                                           pruner=pruner)
+                                           pruners=pruners)
 
         def compressed_loss(params, batch, rng=None):
             return inner_loss(transform(params), batch, rng)
     else:
         compressed_loss = inner_loss
 
-    steppers = [s for s in (act_gate, pruner) if s is not None]
+    steppers = ([act_gate] if act_gate is not None else []) + pruners
 
     logger.info(f"compression enabled: {[g[0] for g in groups]}"
                 + (" + layer_reduction" if lr_cfg.get("enabled") else "")

@@ -33,16 +33,20 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale, block_m):
     pos = pos_ref[b]
     G, hd = q_ref.shape[2:]
     M = k_ref.shape[2]
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
+    # native-dtype loads + dots (fp32 accumulate via preferred_element_type):
+    # pre-casting K/V blocks to fp32 doubles the VMEM working set and VPU
+    # traffic (same fix as flash_attention.py)
+    in_dtype = q_ref.dtype
+    q = q_ref[0, 0]
 
     nblocks = pl.cdiv(pos + 1, block_m)  # only blocks intersecting [0, pos]
 
     def body(j, carry):
         acc, m_prev, l_prev = carry
-        k = k_ref[0, 0, pl.ds(j * block_m, block_m), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * block_m, block_m), :].astype(jnp.float32)
+        k = k_ref[0, 0, pl.ds(j * block_m, block_m), :]
+        v = v_ref[0, 0, pl.ds(j * block_m, block_m), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [G, bm]
+                                preferred_element_type=jnp.float32) * sm_scale
         k_pos = j * block_m + jax.lax.broadcasted_iota(jnp.int32, (G, block_m), 1)
         s = jnp.where(k_pos <= pos, s, NEG_INF)
         m_cur = jnp.max(s, axis=-1)
@@ -51,7 +55,8 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale, block_m):
         p = jnp.exp(s - m_new[:, None])
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(in_dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
     acc0 = jnp.zeros((G, hd), jnp.float32)

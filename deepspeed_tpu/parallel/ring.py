@@ -46,11 +46,78 @@ def _block_attn_partial(q, k, v, q_offset, k_offset, causal, sm_scale):
     return m, l, o
 
 
-def _ring_attention_local(q, k, v, axis_name, sp, causal, sm_scale):
-    """Runs inside shard_map. q,k,v local: [B, Tl, H, hd]."""
+def _can_use_flash(q, causal):
+    """Flash inner blocks: long-enough 128-multiple local shards on a real
+    backend (interpret-mode pallas on CPU is orders slower than einsum)."""
+    Tl = q.shape[1]
+    return (causal and Tl % 128 == 0 and Tl >= 1024
+            and jax.default_backend() in ("tpu", "axon"))
+
+
+def _ring_attention_local(q, k, v, axis_name, sp, causal, sm_scale,
+                          use_flash=False):
+    """Runs inside shard_map. q,k,v local: [B, Tl, H, hd].
+
+    `use_flash=True` routes each ring step's block attention through the
+    Pallas flash kernel (ops/pallas/flash_attention.py): ring blocks are
+    whole contiguous shards, so every (q_shard, k_shard) pair is exactly one
+    of three cases — DIAGONAL (src == mine: standard causal), PAST
+    (src < mine: no mask), FUTURE (fully masked: skip, lse = -inf) — which
+    avoids offset-aware masking inside the kernel entirely. Partials merge
+    by (o, lse): out = Σ_i o_i · exp(lse_i − lse_total)."""
     B, Tl, H, hd = q.shape
     my_idx = jax.lax.axis_index(axis_name)
     perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    # the flash branch's diagonal/past/future split is a CAUSAL identity —
+    # non-causal rings keep the einsum path
+    if use_flash and not causal:
+        use_flash = False
+    if use_flash:
+        from deepspeed_tpu.ops.pallas.flash_attention import \
+            flash_attention_with_lse
+        qt = jnp.swapaxes(q, 1, 2)                       # [B, H, Tl, hd]
+
+        def step(carry, i):
+            acc, lse_run, kv = carry
+            k_blk, v_blk = kv
+            src = (my_idx - i) % sp
+
+            def diagonal():
+                o, lse = flash_attention_with_lse(
+                    qt, jnp.swapaxes(k_blk, 1, 2), jnp.swapaxes(v_blk, 1, 2),
+                    causal=True, sm_scale=sm_scale)
+                return o.astype(jnp.float32), lse
+
+            def past():
+                o, lse = flash_attention_with_lse(
+                    qt, jnp.swapaxes(k_blk, 1, 2), jnp.swapaxes(v_blk, 1, 2),
+                    causal=False, sm_scale=sm_scale)
+                return o.astype(jnp.float32), lse
+
+            def future():
+                return (jnp.zeros((B, H, Tl, hd), jnp.float32),
+                        jnp.full((B, H, Tl), NEG_INF, jnp.float32))
+
+            o_blk, lse_blk = jax.lax.cond(
+                src == my_idx, diagonal,
+                lambda: jax.lax.cond(src < my_idx, past, future))
+            lse_new = jnp.logaddexp(lse_run, lse_blk)
+            safe = jnp.where(jnp.isfinite(lse_new), lse_new, 0.0)
+            alpha = jnp.where(jnp.isfinite(lse_run),
+                              jnp.exp(lse_run - safe), 0.0)
+            beta = jnp.where(jnp.isfinite(lse_blk),
+                             jnp.exp(lse_blk - safe), 0.0)
+            acc = acc * alpha[..., None] + o_blk * beta[..., None]
+            kv = jax.tree_util.tree_map(
+                lambda x: jax.lax.ppermute(x, axis_name, perm), kv)
+            return (acc, lse_new, kv), None
+
+        acc0 = jnp.zeros((B, H, Tl, hd), jnp.float32)
+        lse0 = jnp.full((B, H, Tl), NEG_INF, jnp.float32)
+        (acc, _, _), _ = jax.lax.scan(step, (acc0, lse0, (k, v)),
+                                      jnp.arange(sp))
+        return jnp.swapaxes(acc, 1, 2).astype(q.dtype)
 
     def step(carry, i):
         acc, m_run, l_run, kv = carry
@@ -78,9 +145,16 @@ def _ring_attention_local(q, k, v, axis_name, sp, causal, sm_scale):
     return (acc / l_safe).astype(q.dtype)
 
 
-def ring_attention(q, k, v, causal=True, sm_scale=None, axis_name=SEQ_AXIS, mesh=None):
+def ring_attention(q, k, v, causal=True, sm_scale=None, axis_name=SEQ_AXIS,
+                   mesh=None, use_flash=None):
     """Global-array entry: q,k,v [B, T, H, hd] sharded (data, sequence, tensor).
-    Returns attention output with the same layout/sharding."""
+    Returns attention output with the same layout/sharding.
+
+    use_flash: None = auto — per-step block attention runs the Pallas flash
+    kernel when the LOCAL shard is a 128-multiple >= 1024 tokens on a real
+    TPU backend (measured r4: the kernel beats materialized attention 1.6x
+    at 1k, 2.3x at 2k, 3.4x at 4k fwd+bwd; interpret mode on CPU would be
+    orders slower, so the einsum path is kept there)."""
     mesh = mesh or mesh_mod.get_mesh()
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     sp = sizes.get(axis_name, 1)
@@ -90,9 +164,14 @@ def ring_attention(q, k, v, causal=True, sm_scale=None, axis_name=SEQ_AXIS, mesh
         m, l, o = _block_attn_partial(q, k, v, 0, 0, causal, sm_scale)
         return (o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
+    local_q_shape = (q.shape[0], q.shape[1] // sp, *q.shape[2:])
+    if use_flash is None:
+        use_flash = _can_use_flash(
+            jax.ShapeDtypeStruct(local_q_shape, q.dtype), causal)
+
     spec = P(BATCH_AXES, axis_name, TENSOR_AXIS, None)
     fn = shard_map(
         partial(_ring_attention_local, axis_name=axis_name, sp=sp, causal=causal,
-                sm_scale=sm_scale),
+                sm_scale=sm_scale, use_flash=use_flash),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return fn(q, k, v)

