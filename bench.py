@@ -25,19 +25,23 @@ attention einsums) and `mfu_megatron` (their factor-8 formula applied to our
 run verbatim, for a like-for-like read against 204.49/312 = 0.655).
 
 Two lanes per run:
-  1. north star (BASELINE.json metric): gpt2-1.3b ZeRO-3, mbs 8 / gas 1 /
-     seq 512 — its JSON line prints first and a summary rides in the
+  1. north star (BASELINE.json metric): gpt2-1.3b ZeRO-3, mbs 4 / gas 32 /
+     seq 512 / bf16 grad accumulator (data_types.grad_accum_dtype — see
+     main()) — its JSON line prints first and a summary rides in the
      headline's extra.north_star. Disable with BENCH_NORTH_STAR=0 (auto-
      disabled when BENCH_MODEL is overridden, i.e. during sweeps).
   2. headline: mirrors the reference's headline benchmark shape (seq 512,
      micro-bs near capacity — their 204.49 TFLOPs number is GPT-175B at
      mbs 32/seq 512 on 80G A100s, i.e. the largest model the memory takes):
-     gpt2-760m / seq 512 / mbs 12 / gas 16 / pure-bf16 optimizer state
-     (bf16.master_weights=false) / selective remat
+     gpt2-760m / seq 512 / mbs 12 / gas 32 / pure-bf16 optimizer state
+     (bf16.master_weights=false) / bf16 grad accumulator / selective remat
      ("dots_with_no_batch_dims_saveable") — highest-MFU configuration that
      fits a single v5e (16G HBM).
-r4: zoo head counts moved to head_dim=128 (MXU lane width): 760m 16→12
-heads (+3.5% MFU), 1.3b 32→16 (+14%) — see GPT2_CONFIGS comment.
+r4 wins: zoo head counts moved to head_dim=128 (MXU lane width): 760m 16→12
+heads (+3.5% MFU), 1.3b 32→16 (+14%) — see GPT2_CONFIGS comment. bf16 grad
+accumulators (data_types.grad_accum_dtype, the reference's own knob) cut
+the accumulator RMW traffic and unlock gas on the 1.3b lane: 760m
+0.593→0.607 (gas 32), 1.3b 0.557→0.610 (mbs 4 / gas 32).
 Override with BENCH_MODEL / BENCH_SEQ / BENCH_BATCH / BENCH_GAS /
 BENCH_ZERO / BENCH_REMAT / BENCH_REMAT_POLICY / BENCH_FLASH /
 BENCH_SOFTMAX / BENCH_MASTER / BENCH_LOSS_CHUNKS / BENCH_NS_*.
@@ -103,7 +107,7 @@ REF_MODEL_FLOPS_MFU = 204.49 * (6.0 / 8.0) / 312.0  # = 0.4916, see docstring
 def run_lane(model_name, batch, seq, gas, zero_stage, *, steps, warmup=3,
              master=False, use_flash=None, remat=True,
              policy="dots_with_no_batch_dims_saveable", sm_dtype=None,
-             loss_chunks=0):
+             loss_chunks=0, grad_accum_dtype=None):
     """Build an engine for one configuration, time it, return the result dict."""
     import dataclasses
 
@@ -128,7 +132,7 @@ def run_lane(model_name, batch, seq, gas, zero_stage, *, steps, warmup=3,
     # tunneled host->device link (~27 MB/s) makes host-side init impractical
     model = make_gpt_model(cfg=cfg, name=model_name, abstract=True)
     n_chips = jax.device_count()
-    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+    ds_cfg = {
         "train_micro_batch_size_per_gpu": batch,
         "gradient_accumulation_steps": gas,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
@@ -136,7 +140,10 @@ def run_lane(model_name, batch, seq, gas, zero_stage, *, steps, warmup=3,
         "gradient_clipping": 1.0,
         "zero_optimization": {"stage": zero_stage},
         "steps_per_print": 10**9,
-    })
+    }
+    if grad_accum_dtype:
+        ds_cfg["data_types"] = {"grad_accum_dtype": grad_accum_dtype}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_cfg)
 
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size,
@@ -202,12 +209,17 @@ def main():
     model_name = env("BENCH_MODEL", "gpt2-760m")
     import jax.numpy as jnp
     sm = {"fp32": jnp.float32, "bf16": jnp.bfloat16}[env("BENCH_SOFTMAX", "bf16")]
-    gas = int(env("BENCH_GAS", "16"))
+    gas = int(env("BENCH_GAS", "32"))
 
     # North-star lane first (BASELINE.json metric: GPT-2 1.3B ZeRO-3): largest
     # bench model that fits the chip, through the stage-3 sharding path.
     # Best measured single-chip config: mbs 8, gas 1 (the fp32 gas accumulator
     # does not fit next to 7.9G of bf16 state), head_dim-128 zoo config.
+    # Best measured 1.3b single-chip config (r4): mbs 4 / gas 32 / bf16 grad
+    # accumulator (data_types.grad_accum_dtype — the reference's own knob;
+    # fp32 accumulators do not fit next to 7.9G of bf16 state, and gas
+    # amortizes the 22ms optimizer update): MFU 0.5685 (gas 4) -> 0.6013
+    # (gas 16) -> 0.6097 (gas 32), vs 0.557 at mbs 8 / gas 1 / fp32 path.
     north = None
     if env("BENCH_NORTH_STAR", "1") == "1" and "BENCH_MODEL" not in os.environ:
         # subprocess: the lane's 8G of 1.3b engine state must be fully gone
@@ -222,9 +234,10 @@ def main():
                      if not k.startswith("BENCH_")}
         child_env.update(
             BENCH_NORTH_STAR="0", BENCH_MODEL="gpt2-1.3b", BENCH_ZERO="3",
-            BENCH_BATCH=env("BENCH_NS_BATCH", "8"),
-            BENCH_GAS=env("BENCH_NS_GAS", "1"),
-            BENCH_STEPS=env("BENCH_NS_STEPS", "6"))
+            BENCH_BATCH=env("BENCH_NS_BATCH", "4"),
+            BENCH_GAS=env("BENCH_NS_GAS", "32"),
+            BENCH_ACCUM_DTYPE=env("BENCH_NS_ACCUM_DTYPE", "bf16"),
+            BENCH_STEPS=env("BENCH_NS_STEPS", "3"))
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                               env=child_env, capture_output=True, text=True)
         for line in reversed(proc.stdout.strip().splitlines()):
@@ -251,7 +264,8 @@ def main():
         use_flash={"1": True, "0": False}.get(env("BENCH_FLASH", "auto")),
         remat=env("BENCH_REMAT", "1") == "1",
         policy=env("BENCH_REMAT_POLICY", "dots_with_no_batch_dims_saveable"),
-        sm_dtype=sm, loss_chunks=int(env("BENCH_LOSS_CHUNKS", "0")))
+        sm_dtype=sm, loss_chunks=int(env("BENCH_LOSS_CHUNKS", "0")),
+        grad_accum_dtype=env("BENCH_ACCUM_DTYPE", "bf16") or None)
     if north is not None:
         # both lanes land in the driver-recorded artifact (it parses the last
         # line; the north-star rides along in extra)

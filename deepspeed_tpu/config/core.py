@@ -341,6 +341,16 @@ class DataEfficiencyConfig(ConfigModel):
 
 
 @dataclass
+class DataTypesConfig(ConfigModel):
+    """Reference: `runtime/config.py:876` data_types block — the gradient
+    ACCUMULATOR dtype for gas > 1. Default fp32 (exact accumulation across
+    micro-batches); "bf16" halves the accumulator's HBM footprint and RMW
+    traffic at ~3-decimal-digit accumulation precision — the knob that makes
+    gas viable when fp32 accumulators do not fit next to the model state."""
+    grad_accum_dtype: Optional[str] = None   # None/"fp32" | "bf16" | "fp16"
+
+
+@dataclass
 class ProgressiveLayerDropConfig(ConfigModel):
     """Reference: `runtime/config.py` progressive_layer_drop block +
     `runtime/progressive_layer_drop.py` (theta schedule)."""
@@ -419,6 +429,7 @@ class TpuTrainConfig(ConfigModel):
     data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
     progressive_layer_drop: ProgressiveLayerDropConfig = field(
         default_factory=ProgressiveLayerDropConfig)
+    data_types: DataTypesConfig = field(default_factory=DataTypesConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     moe: MoEConfig = field(default_factory=MoEConfig)
 

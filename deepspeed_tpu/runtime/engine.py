@@ -712,6 +712,16 @@ class Engine:
 
         return qmicro
 
+    def _grad_accum_dtype(self):
+        """Gas accumulator dtype (reference data_types.grad_accum_dtype,
+        `runtime/config.py:876`): fp32 default; bf16/fp16 opt-in."""
+        name = (self.config.data_types.grad_accum_dtype or "fp32").lower()
+        table = {"fp32": jnp.float32, "float32": jnp.float32,
+                 "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                 "fp16": jnp.float16, "float16": jnp.float16}
+        assert name in table, f"unknown grad_accum_dtype {name!r}"
+        return table[name]
+
     def _build_train_step(self):
         gas = self.gradient_accumulation_steps_value
         zcfg = self.config.zero_optimization
@@ -735,16 +745,20 @@ class Engine:
             rng = jax.random.fold_in(state.rng, state.step)
 
             if gas > 1:
+                acc_dtype = self._grad_accum_dtype()
+
                 def body(carry, micro_batch):
                     g_acc, loss_acc, i = carry
                     g, l = micro_grad(params, micro_batch, jax.random.fold_in(rng, i),
                                       state.scaler)
                     g_acc = jax.tree_util.tree_map(
-                        lambda a, b: a + b.astype(jnp.float32) / predivide, g_acc, g)
+                        lambda a, b: a + (b.astype(acc_dtype)
+                                          / jnp.asarray(predivide, acc_dtype)),
+                        g_acc, g)
                     return (g_acc, loss_acc + l.astype(jnp.float32), i + 1), None
 
                 zeros = jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                    lambda p: jnp.zeros(p.shape, acc_dtype), params)
                 zeros = jax.lax.with_sharding_constraint(zeros, grad_shardings)
                 (grads, loss_sum, _), _ = jax.lax.scan(
                     body, (zeros, jnp.asarray(0.0, jnp.float32), 0), batch)
@@ -771,17 +785,19 @@ class Engine:
         micro_grad = self._micro_grad_fn()
         grad_shardings = self.param_shardings
 
+        acc_dtype = self._grad_accum_dtype()
+
         def grad_program(params, batch, rng, scaler_state):
             if gas > 1:
                 def body(carry, mb):
                     g_acc, loss_acc, i = carry
                     g, l = micro_grad(params, mb, jax.random.fold_in(rng, i), scaler_state)
                     g_acc = jax.tree_util.tree_map(
-                        lambda a, b: a + b.astype(jnp.float32), g_acc, g)
+                        lambda a, b: a + b.astype(acc_dtype), g_acc, g)
                     return (g_acc, loss_acc + l.astype(jnp.float32), i + 1), None
 
                 zeros = jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                    lambda p: jnp.zeros(p.shape, acc_dtype), params)
                 (grads, loss_sum, _), _ = jax.lax.scan(
                     body, (zeros, jnp.asarray(0.0, jnp.float32), 0), batch)
                 grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
@@ -1350,8 +1366,6 @@ def initialize(args=None,
             "(optimizer/scheduler blocks); passing objects is not supported"
         # refuse config the streaming trainer does not honor rather than
         # silently diverging from the reference semantics
-        assert training_data is None, \
-            "Infinity tier: feed batches to train_batch directly (no dataloader)"
         assert model_parameters is None, \
             "Infinity tier: the LayeredModelSpec carries its own params " \
             "(resident + blocks); model_parameters is not honored"
@@ -1359,9 +1373,6 @@ def initialize(args=None,
         assert not cfg.fp16_enabled, \
             "Infinity tier: use bf16 compute (no dynamic loss scaling on " \
             "the layer-streaming path)"
-        assert not cfg.gradient_clipping, \
-            "Infinity tier: gradient_clipping is not supported yet (a global " \
-            "norm needs all layer grads, which never coexist)"
         from deepspeed_tpu.runtime.infinity import InfinityEngine
         opt_off = cfg.zero_optimization.offload_optimizer
         opt_type = (cfg.optimizer.type.lower() if cfg.optimizer else "adamw")
@@ -1389,8 +1400,12 @@ def initialize(args=None,
             adamw_mode=(opt_type != "adam"),  # Adam = coupled L2 decay
             lr_schedule=schedule_fn,
             micro_batch_size=inf_mbs,
-            gradient_accumulation_steps=gas)
-        return inf, None, None, None
+            gradient_accumulation_steps=gas,
+            gradient_clipping=cfg.gradient_clipping,
+            training_data=training_data,
+            collate_fn=collate_fn,
+            seed=cfg.seed)
+        return inf, None, inf.training_dataloader, None
     if not isinstance(model, ModelSpec):
         assert callable(model), "model must be a ModelSpec or a loss callable"
         assert model_parameters is not None, \

@@ -48,7 +48,9 @@ class InfinityEngine:
                  weight_decay=0.0, dtype=jnp.bfloat16, offload_device="cpu",
                  nvme_path=None, optimizer_nvme_path=None, lookahead=1,
                  optimizer="adam", adamw_mode=True, lr_schedule=None,
-                 micro_batch_size=None, gradient_accumulation_steps=1):
+                 micro_batch_size=None, gradient_accumulation_steps=1,
+                 gradient_clipping=0.0, training_data=None, collate_fn=None,
+                 seed=1234):
         assert spec.layer_train_fn is not None and spec.train_loss_fn is not None, \
             "InfinityEngine needs a LayeredModelSpec with train fns " \
             "(models.gpt.make_gpt_layered_model provides them)"
@@ -127,6 +129,18 @@ class InfinityEngine:
         self._flatten = jax.jit(lambda tree: jnp.concatenate(
             [jnp.ravel(l).astype(jnp.float32)
              for l in jax.tree_util.tree_leaves(tree)]))
+        self.gradient_clipping = float(gradient_clipping or 0.0)
+        self.last_grad_norm = None
+        # dataloader (reference engine training_data contract): batches of
+        # micro_batch x gas rows per train_batch() call
+        self.training_dataloader = None
+        self._data_iterator = None
+        if training_data is not None:
+            from deepspeed_tpu.runtime.dataloader import TpuDataLoader
+            bs = (micro_batch_size or 1) * self.gas
+            self.training_dataloader = TpuDataLoader(
+                training_data, bs, collate_fn=collate_fn, shuffle=True,
+                seed=seed)
         self.step_count = 0
         log_dist(f"infinity engine: {spec.name} L={self.L} "
                  f"layer_mb={self.store.layer_bytes/1e6:.1f} "
@@ -217,11 +231,31 @@ class InfinityEngine:
         else:
             acc[i] += flat
 
-    def train_batch(self, batch):
+    def train_batch(self, batch=None, data_iter=None):
         """One full step over the GLOBAL batch (micro_batch x gas rows, like
         the main engine): streamed forward/backward per micro-batch, host
         optimizer steps on the mean gradient at the gas boundary, bit16
-        write-back, resident update last. Returns the mean loss."""
+        write-back, resident update last. Returns the mean loss.
+
+        With `gradient_clipping` set, the step runs in two phases: grads
+        accumulate on host through every micro-pass; once the backward
+        completes, the per-layer norms² are summed into the GLOBAL norm and
+        the host Adam steps apply the clip scale layer by layer. The cost: the
+        optimizer work no longer overlaps the device backward (the scale
+        depends on every layer's grad) — correctness over overlap when
+        clipping is requested (reference stage-3 + offload clips the same
+        global norm)."""
+        if batch is None:
+            it = data_iter
+            if it is None and self.training_dataloader is not None:
+                if self._data_iterator is None:
+                    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+                    self._data_iterator = iter(
+                        RepeatingLoader(self.training_dataloader))
+                it = self._data_iterator
+            assert it is not None, \
+                "train_batch needs a batch or data_iter/training_data"
+            batch = next(it)
         tokens = np.asarray(batch.get("tokens", batch.get("input_ids")))
         labels = batch.get("labels")
         if labels is None:
@@ -240,12 +274,16 @@ class InfinityEngine:
                 f"global batch of {B} with gas={self.gas} implies micro "
                 f"batch {mbs}, engine configured for {self.micro_batch_size}")
 
+        clip = self.gradient_clipping
         acc = [None] * self.L
         res_acc = None
         losses = []
         for m in range(self.gas):
             sl = slice(m * mbs, (m + 1) * mbs)
-            if self.gas == 1:
+            if clip > 0:
+                # clipping needs the global norm before ANY update can run
+                mode = "accumulate"
+            elif self.gas == 1:
                 mode = "apply"
             else:
                 mode = "finalize" if m == self.gas - 1 else "accumulate"
@@ -254,6 +292,21 @@ class InfinityEngine:
             losses.append(loss)
         loss = float(np.mean(losses))
         g_res_flat = res_acc / self.gas
+
+        scale = 1.0
+        if clip > 0:
+            sq = float(np.dot(g_res_flat, g_res_flat))
+            for i in range(self.L):
+                mean_i = acc[i] / self.gas
+                sq += float(np.dot(mean_i, mean_i))
+            total_norm = float(np.sqrt(sq))
+            self.last_grad_norm = total_norm
+            scale = min(1.0, clip / max(total_norm, 1e-12))
+            for i in range(self.L):
+                self._layer_step_host(i, acc[i] * (scale / self.gas))
+                acc[i] = None
+            g_res_flat = g_res_flat * scale
+
         self.streamer.reset()  # device copies are stale after write-back
         self.store.flush_writes()  # one barrier per step, not per layer
 

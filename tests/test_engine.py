@@ -415,3 +415,47 @@ def test_zero_namespace_parity():
     np.testing.assert_array_equal(np.asarray(params["w"]), np.arange(16.0) * 2)
     assert params["w"].sharding == sharding      # re-partitioned, not replicated
     np.testing.assert_array_equal(np.asarray(params["b"]), np.ones(4))
+
+
+def test_grad_accum_dtype_bf16_close_to_fp32():
+    """data_types.grad_accum_dtype (reference runtime/config.py:876): bf16
+    accumulators walk close to the fp32-accumulator trajectory at small gas
+    (the knob exists for HBM-bound configs where fp32 accumulators OOM)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from tests.simple_model import make_simple_model, random_batches
+
+    def mk(accum):
+        mesh_mod._CURRENT_MESH = None
+        mesh_mod._CURRENT_SPEC = None
+        cfg = {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "mesh": {"data": 1},
+            "steps_per_print": 10**9,
+        }
+        if accum:
+            cfg["data_types"] = {"grad_accum_dtype": accum}
+        e, *_ = deepspeed_tpu.initialize(model=make_simple_model(), config=cfg)
+        return e
+
+    e32, e16 = mk(None), mk("bf16")
+    batches = random_batches(4, e32.train_batch_size(), seed=3)
+    for b in batches:
+        l32 = float(e32.train_batch(b))
+        l16 = float(e16.train_batch(b))
+        np.testing.assert_allclose(l16, l32, rtol=5e-3, atol=5e-3)
+
+    import pytest as _pytest
+    with _pytest.raises(AssertionError, match="grad_accum_dtype"):
+        mesh_mod._CURRENT_MESH = None
+        mesh_mod._CURRENT_SPEC = None
+        bad, *_ = deepspeed_tpu.initialize(model=make_simple_model(), config={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "data_types": {"grad_accum_dtype": "int8"},
+            "mesh": {"data": 1}, "steps_per_print": 10**9})
+        bad.train_batch(random_batches(1, bad.train_batch_size())[0])
