@@ -42,9 +42,16 @@ heads (+3.5% MFU), 1.3b 32→16 (+14%) — see GPT2_CONFIGS comment. bf16 grad
 accumulators (data_types.grad_accum_dtype, the reference's own knob) cut
 the accumulator RMW traffic and unlock gas on the 1.3b lane: 760m
 0.593→0.607 (gas 32), 1.3b 0.557→0.610 (mbs 4 / gas 32).
+remat prevent_cse=False (the documented-efficient form inside lax.scan —
+the scan boundary already blocks the guarded-against CSE; now the
+GPTConfig default): +6.4%/+6.7% at gas 8 A/B, official lanes 760m
+0.607→0.646 (vs_baseline 1.314), 1.3b 0.610→0.665 (vs_baseline 1.352).
+Rejected: scan unroll=2 (0.543 at the bench shape — bigger program, no
+slice saved).
 Override with BENCH_MODEL / BENCH_SEQ / BENCH_BATCH / BENCH_GAS /
 BENCH_ZERO / BENCH_REMAT / BENCH_REMAT_POLICY / BENCH_FLASH /
-BENCH_SOFTMAX / BENCH_MASTER / BENCH_LOSS_CHUNKS / BENCH_NS_*.
+BENCH_SOFTMAX / BENCH_MASTER / BENCH_LOSS_CHUNKS / BENCH_UNROLL /
+BENCH_PREVENT_CSE / BENCH_NS_*.
 
 Perf decomposition (r3 xprof, per micro-step of the 760m config):
   forward block scan   ~61 ms  (~153 TF/s on its matmul flops = 78% MXU)
@@ -127,7 +134,9 @@ def run_lane(model_name, batch, seq, gas, zero_stage, *, steps, warmup=3,
         cfg, use_flash_attention=(use_flash if seq % 128 == 0 else False),
         remat=remat,
         remat_policy=policy, softmax_dtype=sm_dtype or jnp.bfloat16,
-        loss_chunks=loss_chunks)
+        loss_chunks=loss_chunks,
+        scan_unroll=int(os.environ.get("BENCH_UNROLL", "1")),
+        remat_prevent_cse=os.environ.get("BENCH_PREVENT_CSE", "0") == "1")
     # abstract init: params materialize on-device (engine init_fn path) — the
     # tunneled host->device link (~27 MB/s) makes host-side init impractical
     model = make_gpt_model(cfg=cfg, name=model_name, abstract=True)

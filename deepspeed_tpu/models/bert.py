@@ -39,6 +39,8 @@ class BertConfig:
     norm_eps: float = 1e-12
     pre_layer_norm: bool = False     # reference DeepSpeedTransformerConfig knob
     remat: bool = True
+    remat_prevent_cse: bool = False  # safe+faster inside the layer scan; see
+                                     # GPTConfig.remat_prevent_cse
     dtype: Any = jnp.bfloat16
 
     def __post_init__(self):
@@ -198,7 +200,7 @@ def bert_encode(params, input_ids, cfg: BertConfig, token_type_ids=None,
 
     block_fn = partial(_bert_block, mask_bias=mask_bias, cfg=cfg)
     if cfg.remat:
-        block_fn = jax.checkpoint(block_fn)
+        block_fn = jax.checkpoint(block_fn, prevent_cse=cfg.remat_prevent_cse)
 
     def body(x, layer_params):
         return block_fn(x, layer_params), None

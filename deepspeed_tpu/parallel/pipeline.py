@@ -313,7 +313,8 @@ def _make_stage_apply(block_fn, blocks):
 
 
 def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
-                     num_microbatches, remat_blocks=True, block_tp_specs=None):
+                     num_microbatches, remat_blocks=True, block_tp_specs=None,
+                     remat_prevent_cse=False):
     """Builds loss_fn(params, batch, rng) running the pipelined schedule.
 
     params = {"embed": <replicated>, "blocks": <stacked [PP*Lp, ...] leaves,
@@ -330,7 +331,9 @@ def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
     PP = num_stages
     M = num_microbatches
     if remat_blocks:
-        block_fn = jax.checkpoint(block_fn)
+        # default False: block_fn runs inside the schedule scan, the
+        # safe+faster placement (see GPTConfig.remat_prevent_cse)
+        block_fn = jax.checkpoint(block_fn, prevent_cse=remat_prevent_cse)
 
     def local(params, batch, rng):
         # inside shard_map over ('pipe',): blocks leaf leading dim = layers/stage
@@ -402,7 +405,8 @@ def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
 
 
 def pipeline_grad_fn(embed_fn, block_fn, head_loss_fn, num_stages,
-                     num_microbatches, remat_blocks=True, block_tp_specs=None):
+                     num_microbatches, remat_blocks=True, block_tp_specs=None,
+                     remat_prevent_cse=False):
     """1F1B-structured pipelined (loss, grads) — reference `TrainSchedule`
     (`runtime/pipe/schedule.py:189`).
 
@@ -431,7 +435,9 @@ def pipeline_grad_fn(embed_fn, block_fn, head_loss_fn, num_stages,
     M = num_microbatches
     R = 2 * PP  # ring slots; a stash entry lives 2*(PP-s)-1 < R ticks
     if remat_blocks:
-        block_fn = jax.checkpoint(block_fn)
+        # default False: block_fn runs inside the schedule scan, the
+        # safe+faster placement (see GPTConfig.remat_prevent_cse)
+        block_fn = jax.checkpoint(block_fn, prevent_cse=remat_prevent_cse)
 
     def local(params, batch, rng):
         p_idx = jax.lax.axis_index(PIPE_AXIS)

@@ -118,3 +118,31 @@ def test_rotary_swiglu_rmsnorm_variant():
     batch = {"tokens": _tokens(8, 32, cfg.vocab_size)}
     losses = [float(engine.train_batch(batch)) for _ in range(8)]
     assert losses[-1] < losses[0], losses
+
+
+def test_scan_unroll_and_cse_knobs_numerics():
+    """scan_unroll and remat_prevent_cse are scheduling knobs only — the loss
+    (and its gradient) must match the default formulation bitwise-closely."""
+    import dataclasses
+    from deepspeed_tpu.models.gpt import gpt_loss
+
+    base = dataclasses.replace(TINY, remat=True, n_layer=4)
+    params = init_gpt_params(base, seed=3)
+    toks = jnp.asarray(_tokens(2, 32, base.vocab_size, seed=4))
+    batch = {"tokens": toks[:, :-1], "labels": toks[:, 1:]}
+
+    def loss_and_grad(cfg):
+        fn = jax.jit(jax.value_and_grad(
+            lambda p, b: gpt_loss(p, b, None, cfg=cfg)))
+        return fn(params, batch)
+
+    ref_loss, ref_grad = loss_and_grad(base)
+    for variant in (dataclasses.replace(base, scan_unroll=2),
+                    dataclasses.replace(base, remat_prevent_cse=True),
+                    dataclasses.replace(base, scan_unroll=4,
+                                        remat_prevent_cse=True)):
+        loss, grad = loss_and_grad(variant)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+            ref_grad, grad)
