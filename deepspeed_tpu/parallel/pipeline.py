@@ -769,7 +769,8 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
                                num_stages=num_stages,
                                num_microbatches=num_microbatches,
                                remat_blocks=cfg.remat,
-                               block_tp_specs=block_tp_specs)
+                               block_tp_specs=block_tp_specs,
+                               remat_prevent_cse=cfg.remat_prevent_cse)
     # training backward: 1F1B schedule (O(PP) live activations); the
     # fill-drain loss_fn above stays as the cheaper eval/forward-only path
     schedule = schedule.lower()
@@ -780,7 +781,8 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
                                 num_stages=num_stages,
                                 num_microbatches=num_microbatches,
                                 remat_blocks=cfg.remat,
-                                block_tp_specs=block_tp_specs)
+                                block_tp_specs=block_tp_specs,
+                                remat_prevent_cse=cfg.remat_prevent_cse)
                if schedule == "1f1b" else None)
 
     # pipelined inference forward (reference InferenceSchedule): full-sequence

@@ -41,14 +41,15 @@ class PipelineModule:
     def __init__(self, embed_fn, block_fn, head_loss_fn, params,
                  num_stages=2, num_microbatches=4, partition_method="uniform",
                  schedule="1f1b", remat_blocks=True, param_specs=None,
-                 name="pipeline"):
+                 name="pipeline", remat_prevent_cse=False):
         self.num_stages = num_stages
         self.num_microbatches = num_microbatches
         self.partition_method = partition_method
         loss_fn = pipeline_loss_fn(embed_fn, block_fn, head_loss_fn,
                                    num_stages=num_stages,
                                    num_microbatches=num_microbatches,
-                                   remat_blocks=remat_blocks)
+                                   remat_blocks=remat_blocks,
+                                   remat_prevent_cse=remat_prevent_cse)
         schedule = schedule.lower()
         if schedule not in ("1f1b", "gpipe"):
             raise ValueError(f"unknown schedule {schedule!r}")
@@ -57,7 +58,8 @@ class PipelineModule:
             grad_fn = pipeline_grad_fn(embed_fn, block_fn, head_loss_fn,
                                        num_stages=num_stages,
                                        num_microbatches=num_microbatches,
-                                       remat_blocks=remat_blocks)
+                                       remat_blocks=remat_blocks,
+                                       remat_prevent_cse=remat_prevent_cse)
         self._spec = ModelSpec(loss_fn=loss_fn, params=params,
                                param_specs=param_specs, grad_fn=grad_fn,
                                name=name)

@@ -102,6 +102,36 @@ def safe_get_full_optimizer_state(engine, path, optim_state_key):
     return _gather(_resolve(sub, path)).astype(np.float32)
 
 
+def safe_set_full_optimizer_state(engine, path, value, optim_state_key):
+    """Overwrite one param's fp32 optimizer-state tensor (reference same name,
+    `tensor_fragment.py:150`). Accepts the reference's exp_avg/exp_avg_sq
+    spellings for optax's mu/nu; the value is cast + resharded to the
+    existing leaf's dtype/sharding."""
+    alias = {"exp_avg": "mu", "exp_avg_sq": "nu"}
+    key = alias.get(optim_state_key, optim_state_key)
+
+    found = [False]
+
+    def rebuild(node):
+        if hasattr(node, "_fields"):
+            if key in node._fields and not found[0]:
+                found[0] = True
+                sub = getattr(node, key)
+                leaf = _resolve(sub, path)
+                new_leaf = jax.device_put(jnp.asarray(value, leaf.dtype),
+                                          leaf.sharding)
+                return node._replace(**{key: _set(sub, path, new_leaf)})
+            return type(node)(*[rebuild(c) for c in node])
+        if isinstance(node, (tuple, list)):
+            return type(node)(rebuild(c) for c in node)
+        return node
+
+    new_opt_state = rebuild(engine.state.opt_state)
+    if not found[0]:
+        raise KeyError(f"optimizer state '{optim_state_key}' not found")
+    engine.state = engine.state._replace(opt_state=new_opt_state)
+
+
 def safe_get_full_grad(engine, path):
     """Last accumulated full gradient (only available between backward() and step()
     on the parity API — the fused train_batch consumes grads inside one program)."""

@@ -291,6 +291,17 @@ def test_tensor_fragment_api():
     w2 = safe_get_full_fp32_param(e, ("blocks", "attn_qkv_w"))
     np.testing.assert_array_equal(w2, new)
 
+    from deepspeed_tpu.utils.tensor_fragment import safe_set_full_optimizer_state
+    new_mu = np.full_like(mu, 0.5)
+    safe_set_full_optimizer_state(e, ("blocks", "attn_qkv_w"), new_mu, "exp_avg")
+    mu2 = safe_get_full_optimizer_state(e, ("blocks", "attn_qkv_w"), "exp_avg")
+    np.testing.assert_allclose(mu2, new_mu, rtol=1e-6)
+    # the sibling state (nu) must be untouched by the rebuild
+    nu = safe_get_full_optimizer_state(e, ("blocks", "attn_qkv_w"), "exp_avg_sq")
+    assert not np.allclose(nu, 0.5)
+    with pytest.raises(KeyError):
+        safe_set_full_optimizer_state(e, ("blocks", "attn_qkv_w"), new_mu, "nope")
+
 
 def test_csv_monitor(tmp_path):
     from deepspeed_tpu.monitor.monitor import CsvMonitor
