@@ -670,19 +670,33 @@ class Engine:
 
         param_specs = jax.tree_util.tree_map(lambda s: s.spec, self.param_shardings)
 
-        def gather_dim(spec):
+        def gather_site(spec):
+            # (dim, axes-to-gather-over) for the dim whose spec entry names a
+            # gather axis. Entries can be composite tuples like
+            # ('data','zero','sequence') and other dims may carry size-1
+            # 'tensor' entries BEFORE it — first-non-None picked the wrong dim
+            # for the zoo's TP-annotated leaves. Gather over exactly the axes
+            # in the entry: under hpZ, weight leaves are secondary-sharded over
+            # 'zero' only while axes=('data','zero') — gathering over both
+            # would blow the leaf up 'data'-fold.
             for i, e in enumerate(spec):
-                if e is not None:
-                    return i
-            return None
+                names = e if isinstance(e, tuple) else (e,)
+                ax = tuple(a for a in axes if a in names)
+                if ax:
+                    return i, ax
+            return None, ()
 
         def body(params, micro_batch, rng, scale_state):
-            if qw:
+            if self.zero_stage == 3:
+                # stage-3 shards must be gathered before use: int8 wire under
+                # qwZ, plain bf16 all-gather otherwise (qgZ-only config)
                 def gather(p, spec):
-                    d = gather_dim(spec)
+                    d, ax = gather_site(spec)
                     if d is None:
                         return p
-                    return qc.quantized_all_gather_dim(p, axes, d, group_size)
+                    if qw:
+                        return qc.quantized_all_gather_dim(p, ax, d, group_size)
+                    return jax.lax.all_gather(p, ax, axis=d, tiled=True)
                 params = jax.tree_util.tree_map(gather, params, param_specs)
             with mesh_mod.constraints_disabled():
                 grads, loss = micro_grad(params, micro_batch, rng, scale_state)
