@@ -452,6 +452,13 @@ class Engine:
         opt_state = jax.jit(self.optimizer.init, out_shardings=self.opt_shardings)(opt_target)
         if self.offload_optimizer_states:
             opt_state = self._to_host(opt_state)
+        # single device: the step streams states through HBM with IN-JIT
+        # device_puts (XLA overlaps them). Multi-device: the SPMD partitioner
+        # rejects in-jit memory-kind transfers of sharded leaves (RET_CHECK
+        # "Side-effect HLO must have sharding"), so the engine streams the
+        # opt tree EAGERLY around the compiled step instead.
+        self._offload_in_jit = (self.offload_optimizer_states
+                                and self.mesh.devices.size == 1)
 
         rep = NamedSharding(self.mesh, P())
         scaler_state = jax.device_put(self.scaler.init(), rep)
@@ -459,9 +466,10 @@ class Engine:
         rng = jax.device_put(jax.random.PRNGKey(self.config.seed), rep)
 
         # the step program's in/out shardings must carry the ACTUAL placement —
-        # pinned host memory when the "cpu" offload tier is active
+        # pinned host memory when the "cpu" offload tier streams in-jit; the
+        # eager-streaming variant calls the step with device-placed states
         opt_state_shardings = (self._host_opt_shardings()
-                               if self.offload_optimizer_states
+                               if self._offload_in_jit
                                else self.opt_shardings)
         self.state_shardings = TrainState(
             params=self.param_shardings,
@@ -525,6 +533,26 @@ class Engine:
             self.offload_optimizer_states = False
             return tree
 
+    def _stream_opt_to_device(self, state):
+        """Eager half of the multi-device offload tier: states → HBM."""
+        return state._replace(opt_state=jax.device_put(state.opt_state,
+                                                       self.opt_shardings))
+
+    def _stream_opt_to_host(self, state):
+        """Eager half of the multi-device offload tier: states → pinned host."""
+        return state._replace(opt_state=jax.device_put(
+            state.opt_state, self._host_opt_shardings()))
+
+    def _run_stateful_step(self, step_fn, *args):
+        """Invoke a (state, ...) -> (state, metrics) program, eagerly streaming
+        offloaded optimizer states through HBM when the in-jit streaming path
+        is unavailable (multi-device meshes)."""
+        if self.offload_optimizer_states and not self._offload_in_jit:
+            new_state, metrics = step_fn(self._stream_opt_to_device(self.state),
+                                         *args)
+            return self._stream_opt_to_host(new_state), metrics
+        return step_fn(self.state, *args)
+
     # ------------------------------------------------------------------
     # compiled step programs
     # ------------------------------------------------------------------
@@ -578,7 +606,7 @@ class Engine:
         param_shardings = self.param_shardings
         schedule_fn = self.schedule_fn
 
-        offload_opt = bool(getattr(self, "offload_optimizer_states", False))
+        offload_opt = bool(getattr(self, "_offload_in_jit", False))
         opt_dev_shardings = self.opt_shardings
         opt_host_shardings = self._host_opt_shardings() if offload_opt else None
 
@@ -948,7 +976,7 @@ class Engine:
             metrics = self._host_train_batch(batch)
         else:
             placed = self._maybe_split_gas(batch)
-            self.state, metrics = self._train_step(self.state, placed)
+            self.state, metrics = self._run_stateful_step(self._train_step, placed)
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
         # auto-profile at profile_step (reference engine.forward:1782 /
@@ -1114,8 +1142,8 @@ class Engine:
     def step(self):
         assert self._pending, "step() must follow backward()"
         n = float(len(self._pending))
-        self.state, metrics = self._apply_step(self.state, self._grad_acc,
-                                               self._loss_acc, n)
+        self.state, metrics = self._run_stateful_step(
+            self._apply_step, self._grad_acc, self._loss_acc, n)
         self._pending = []
         self._grad_acc = None
         self._after_step(metrics)
@@ -1244,7 +1272,12 @@ class Engine:
                                                             cost_analysis)
         prof = FlopsProfiler(ds_engine=self)
         try:
-            prof.analysis = cost_analysis(self._train_step, self.state, placed_batch)
+            # mirror _run_stateful_step: the eager-streaming offload tier
+            # calls the step with device-placed optimizer states
+            state = (self._stream_opt_to_device(self.state)
+                     if self.offload_optimizer_states and not self._offload_in_jit
+                     else self.state)
+            prof.analysis = cost_analysis(self._train_step, state, placed_batch)
             fp = self.config.flops_profiler
             arch = getattr(self.model_spec, "arch_cfg", None)
             if arch is not None and hasattr(arch, "n_layer"):
