@@ -21,6 +21,8 @@ from deepspeed_tpu.platform import get_accelerator
 from deepspeed_tpu.runtime.arguments import add_config_arguments
 
 # reference-name aliases + parity surface (deepspeed/__init__.py:21-45)
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                           DeepSpeedTransformerLayer)
 from deepspeed_tpu.runtime.hybrid_engine import HybridEngine
 from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments
 from deepspeed_tpu.runtime import activation_checkpointing as checkpointing
@@ -63,6 +65,8 @@ __all__ = [
     "TpuInferenceConfig",
     "DeepSpeedInferenceConfig",
     "checkpointing",
+    "DeepSpeedTransformerLayer",
+    "DeepSpeedTransformerConfig",
     "OnDevice",
     "comm",
     "zero",

@@ -3,3 +3,5 @@ from deepspeed_tpu.ops.lamb import FusedLamb
 from deepspeed_tpu.ops.lion import FusedLion, DeepSpeedCPULion
 from deepspeed_tpu.ops.adagrad import DeepSpeedCPUAdagrad
 from deepspeed_tpu.ops.optim import build_optimizer, OPTIMIZER_REGISTRY
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                           DeepSpeedTransformerLayer)

@@ -129,3 +129,66 @@ def test_hf_bert_adapter_logits_parity():
     ours = np.asarray(bert_mlm_logits(params, seq, cfg))
     # padded positions attend freely; compare unpadded region
     np.testing.assert_allclose(ours[:, :12], ref[:, :12], atol=2e-3, rtol=1e-3)
+
+
+def test_deepspeed_transformer_layer_frontend():
+    """Reference-name frontend (`ops/transformer/transformer.py:296`): the
+    layer applies one encoder block; grads flow; masks in both accepted
+    forms agree; post-LN vs pre-LN differ."""
+    import deepspeed_tpu
+    from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                               DeepSpeedTransformerLayer)
+    assert deepspeed_tpu.DeepSpeedTransformerLayer is DeepSpeedTransformerLayer
+
+    cfg = DeepSpeedTransformerConfig(hidden_size=64, heads=4,
+                                     intermediate_size=256,
+                                     num_hidden_layers=2, bf16=False,
+                                     pre_layer_norm=False)
+    layer = DeepSpeedTransformerLayer(cfg)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (2, 8, 64)).astype(np.float32)
+    out = layer(x)
+    assert out.shape == (2, 8, 64)
+    assert np.isfinite(np.asarray(out)).all()
+
+    # [B,T] 0/1 mask and its additive [B,1,1,T] form must agree
+    mask = np.ones((2, 8), np.int32)
+    mask[:, 6:] = 0
+    bias = np.where(mask[:, None, None, :] != 0, 0.0, -1e30).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(layer(x, mask)),
+                               np.asarray(layer(x, bias)), rtol=1e-5)
+
+    # params are a real pytree: grads flow through a jitted loss
+    import jax
+    g = jax.grad(lambda p: jnp.sum(layer(x, params=p) ** 2))(layer.params)
+    assert all(np.isfinite(l).all() and np.abs(l).sum() > 0
+               for l in jax.tree_util.tree_leaves(g))
+
+    pre = DeepSpeedTransformerLayer(
+        DeepSpeedTransformerConfig(hidden_size=64, heads=4,
+                                   intermediate_size=256, num_hidden_layers=2,
+                                   bf16=False, seed=0))   # reference default: pre-LN
+    assert pre.config.pre_layer_norm is True
+    pre.params = layer.params
+    assert not np.allclose(np.asarray(pre(x)), np.asarray(out))
+
+    # reference 8-entry initial_weights/biases layout round-trips: torch-style
+    # [out,in] matrices land transposed, LN entries land in ln1/ln2
+    rng2 = np.random.default_rng(1)
+    D, F = 64, 256
+    ws = [rng2.normal(0, 0.02, s).astype(np.float32) for s in
+          [(D, D)] * 3 + [(D, D)] + [(D,)] + [(F, D), (D, F)] + [(D,)]]
+    bs = [np.zeros(D, np.float32)] * 3 +          [rng2.normal(0, 0.02, s).astype(np.float32) for s in
+          [(D,), (D,), (F,), (D,), (D,)]]
+    loaded = DeepSpeedTransformerLayer(cfg, initial_weights=ws, initial_biases=bs)
+    np.testing.assert_allclose(np.asarray(loaded.params["attn_qkv_w"]),
+                               np.concatenate(ws[0:3], axis=0).T)
+    np.testing.assert_allclose(np.asarray(loaded.params["mlp_up_w"]), ws[5].T)
+    np.testing.assert_allclose(np.asarray(loaded.params["ln1_scale"]), ws[4])
+    np.testing.assert_allclose(np.asarray(loaded.params["ln2_bias"]), bs[7])
+    out2 = loaded(x)
+    assert np.isfinite(np.asarray(out2)).all()
+
+    # from_dict re-derives intermediate_size from an overridden hidden_size
+    c2 = DeepSpeedTransformerConfig.from_dict({"hidden_size": 128})
+    assert c2.intermediate_size == 512
