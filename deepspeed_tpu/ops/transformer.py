@@ -66,6 +66,10 @@ class DeepSpeedTransformerConfig:
             setattr(cfg, key, value)
         if "hidden_size" in json_object and "intermediate_size" not in json_object:
             cfg.intermediate_size = 4 * cfg.hidden_size  # re-derive, don't keep stale
+        if cfg.attn_dropout_ratio or cfg.hidden_dropout_ratio:
+            # setattr bypassed __init__'s check — re-warn here
+            logger.warning("DeepSpeedTransformerConfig: dropout ratios are "
+                           "accepted for parity but not applied on this path")
         return cfg
 
 
@@ -78,12 +82,19 @@ class DeepSpeedTransformerLayer:
     """
 
     def __init__(self, config: DeepSpeedTransformerConfig, initial_weights=None,
-                 initial_biases=None):
+                 initial_biases=None, layer_id=None):
+        """`layer_id`: explicit layer index. Default None auto-increments a
+        process-global counter (the reference's static layer_id behavior) —
+        note that makes the seeded init depend on how many layers were EVER
+        constructed in the process; pass layer_id explicitly for
+        reproducible seeded initialization."""
         from deepspeed_tpu.models.bert import BertConfig
 
         self.config = config
-        self.config.layer_id = getattr(DeepSpeedTransformerLayer, "_layer_id", 0)
-        DeepSpeedTransformerLayer._layer_id = self.config.layer_id + 1
+        if layer_id is None:
+            layer_id = getattr(DeepSpeedTransformerLayer, "_layer_id", 0)
+            DeepSpeedTransformerLayer._layer_id = layer_id + 1
+        self.config.layer_id = layer_id
 
         dtype = (jnp.float16 if config.fp16
                  else jnp.bfloat16 if config.bf16 else jnp.float32)
@@ -121,8 +132,11 @@ class DeepSpeedTransformerLayer:
             # biases  [-, -, -, attn_ob, attn_nb, inter_b, output_b, norm_b]
             # (qkv biases are ZEROED by the reference). torch Linear weights
             # are [out, in] → transposed into this file's [in, out] layout;
-            # LN entries are 1-D and copied directly. Post-LN mapping:
-            # attn_n* = LN after attention (ln1), norm_* = final LN (ln2).
+            # LN entries are 1-D and copied directly. attn_n* is the
+            # attention-ADJACENT LN and norm_* the MLP/final-adjacent LN in
+            # both residual placements (post-LN: after the attention add /
+            # after the MLP add; pre-LN: before attention / before MLP), so
+            # the ln1/ln2 mapping below holds for either pre_layer_norm.
             assert initial_weights is not None and initial_biases is not None \
                 and len(initial_weights) == 8 and len(initial_biases) == 8, \
                 "initial_weights/initial_biases must be the reference's " \

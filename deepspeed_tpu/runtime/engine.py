@@ -127,6 +127,10 @@ class Engine:
                  collate_fn=None,
                  mesh=None,
                  dont_change_device=False):
+        if not isinstance(config, TpuTrainConfig):
+            # accept a dict / JSON path like initialize() does — direct
+            # Engine/HybridEngine construction is a public surface
+            config = TpuTrainConfig.load(config)
         self.config = config
         self.model_spec = model
 

@@ -181,6 +181,20 @@ def test_deepspeed_transformer_layer_frontend():
     bs = [np.zeros(D, np.float32)] * 3 +          [rng2.normal(0, 0.02, s).astype(np.float32) for s in
           [(D,), (D,), (F,), (D,), (D,)]]
     loaded = DeepSpeedTransformerLayer(cfg, initial_weights=ws, initial_biases=bs)
+    # explicit layer_id keeps seeded init reproducible (the default counter
+    # matches the reference's process-global static)
+    a = DeepSpeedTransformerLayer(cfg, layer_id=0)
+    bb = DeepSpeedTransformerLayer(cfg, layer_id=0)
+    np.testing.assert_array_equal(np.asarray(a.params["attn_qkv_w"]),
+                                  np.asarray(bb.params["attn_qkv_w"]))
+    # the 8-entry loader also lands in a pre-LN layer (LN adjacency mapping)
+    pre_loaded = DeepSpeedTransformerLayer(
+        DeepSpeedTransformerConfig(hidden_size=64, heads=4,
+                                   intermediate_size=256, num_hidden_layers=2,
+                                   bf16=False),
+        initial_weights=ws, initial_biases=bs, layer_id=0)
+    np.testing.assert_allclose(np.asarray(pre_loaded.params["ln1_scale"]), ws[4])
+    assert np.isfinite(np.asarray(pre_loaded(x))).all()
     np.testing.assert_allclose(np.asarray(loaded.params["attn_qkv_w"]),
                                np.concatenate(ws[0:3], axis=0).T)
     np.testing.assert_allclose(np.asarray(loaded.params["mlp_up_w"]), ws[5].T)

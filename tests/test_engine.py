@@ -459,3 +459,23 @@ def test_grad_accum_dtype_bf16_close_to_fp32():
             "data_types": {"grad_accum_dtype": "int8"},
             "mesh": {"data": 1}, "steps_per_print": 10**9})
         bad.train_batch(random_batches(1, bad.train_batch_size())[0])
+
+
+def test_engine_accepts_dict_config_directly():
+    """Direct Engine/HybridEngine construction is public surface: a raw dict
+    (or JSON path) must be accepted like initialize() does — previously only
+    a pre-parsed TpuTrainConfig worked."""
+    from deepspeed_tpu.runtime.engine import Engine, ModelSpec
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    rng = np.random.default_rng(0)
+    eng = Engine(
+        ModelSpec(loss_fn=lambda p, b: jnp.mean((b["x"] @ p["w"]) ** 2),
+                  params={"w": jnp.asarray(rng.normal(0, 0.1, (16, 16)),
+                                           jnp.float32)}),
+        {"train_micro_batch_size_per_gpu": 4,
+         "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    b = {"x": rng.normal(0, 1, (eng.train_batch_size(), 16)).astype(np.float32)}
+    losses = [float(eng.train_batch(b)) for _ in range(3)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
