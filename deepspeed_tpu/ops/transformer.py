@@ -93,7 +93,11 @@ class DeepSpeedTransformerLayer:
         self.config = config
         if layer_id is None:
             layer_id = getattr(DeepSpeedTransformerLayer, "_layer_id", 0)
-            DeepSpeedTransformerLayer._layer_id = layer_id + 1
+        # explicit ids also advance the counter past themselves so a later
+        # default-constructed layer can't duplicate an explicit id (and its
+        # seeded init)
+        DeepSpeedTransformerLayer._layer_id = max(
+            getattr(DeepSpeedTransformerLayer, "_layer_id", 0), layer_id + 1)
         self.config.layer_id = layer_id
 
         dtype = (jnp.float16 if config.fp16

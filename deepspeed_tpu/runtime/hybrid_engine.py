@@ -103,8 +103,7 @@ def make_gpt_hybrid_engine(cfg, ds_config, name="gpt-hybrid", seed=0, mesh=None)
     """Convenience: GPT model wired for RLHF-style train+generate."""
     from deepspeed_tpu.models.gpt import make_gpt_model, make_gpt_decode_model
     model = make_gpt_model(cfg=cfg, name=name, seed=seed)
-    from deepspeed_tpu.config.core import TpuTrainConfig
-    engine = HybridEngine(model, TpuTrainConfig.load(ds_config), mesh=mesh)
+    engine = HybridEngine(model, ds_config, mesh=mesh)
     decode = make_gpt_decode_model(cfg=cfg, name=name, params=model.params)
     engine.set_decode_spec(decode)
     return engine

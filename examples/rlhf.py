@@ -17,6 +17,10 @@ Run:  python examples/rlhf.py        (CPU mesh or a real chip)
 """
 
 import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
