@@ -802,9 +802,13 @@ def _decode_attn_half(x, p, cache_k, cache_v, pos, cfg: GPTConfig,
     # elementwise ops beats a batched scatter inside the decode scan on TPU
     # (3.2 vs 3.9 ms/token, gpt2-125m bs8 M=576 — scatter breaks the carry's
     # in-place update); revisit if XLA's scatter lowering improves
-    onehot = jax.nn.one_hot(pos, M, dtype=k.dtype)            # [B, M]
-    k_new = jnp.moveaxis(k, 1, 2)                             # [B, Hkv, 1, hd]
-    v_new = jnp.moveaxis(v, 1, 2)
+    # cache dtype wins (mirrors prefill's .astype(ck.dtype)): without the
+    # casts, a model whose compute dtype is wider than kv_cache_dtype (e.g.
+    # fp32-adapted HF weights + bf16 cache) promotes the rewrite to fp32 and
+    # the decode scan carry dtype flips
+    onehot = jax.nn.one_hot(pos, M, dtype=cache_k.dtype)      # [B, M]
+    k_new = jnp.moveaxis(k, 1, 2).astype(cache_k.dtype)       # [B, Hkv, 1, hd]
+    v_new = jnp.moveaxis(v, 1, 2).astype(cache_v.dtype)
     cache_k = cache_k * (1 - onehot)[:, None, :, None] + onehot[:, None, :, None] * k_new
     cache_v = cache_v * (1 - onehot)[:, None, :, None] + onehot[:, None, :, None] * v_new
 

@@ -33,8 +33,20 @@ def main():
     import deepspeed_tpu
     from deepspeed_tpu.inference.adapters import hf_decode_model
 
-    tok = AutoTokenizer.from_pretrained(args.model)
-    hf_model = AutoModelForCausalLM.from_pretrained(args.model)
+    try:
+        tok = AutoTokenizer.from_pretrained(args.model)
+        hf_model = AutoModelForCausalLM.from_pretrained(args.model)
+    except OSError as e:
+        # zero-egress / uncached environment: demonstrate the identical
+        # adapter path on a randomly-initialized HF config instead
+        print(f"[generate_hf] '{args.model}' not downloadable/cached ({e});\n"
+              "falling back to a RANDOM-weight tiny GPT-2 config — the "
+              "adapter/engine path is identical, the text is gibberish.")
+        from transformers import AutoConfig
+        cfg = AutoConfig.for_model("gpt2", n_layer=2, n_head=4, n_embd=128,
+                                   n_positions=256)
+        hf_model = AutoModelForCausalLM.from_config(cfg)
+        tok = None
     spec = hf_decode_model(hf_model)
 
     engine = deepspeed_tpu.init_inference(
@@ -44,9 +56,13 @@ def main():
                 "quant": {"enabled": args.int8, "bits": 8},
                 "greedy": args.greedy})
 
-    ids = np.asarray(tok(args.prompt)["input_ids"], np.int32)[None, :]
+    if tok is not None:
+        ids = np.asarray(tok(args.prompt)["input_ids"], np.int32)[None, :]
+    else:
+        ids = np.asarray([[1, 2, 3, 4]], np.int32)
     out = engine.generate(ids, max_new_tokens=args.max_new_tokens)
-    print(tok.decode(np.concatenate([ids[0], np.asarray(out[0])])))
+    full = np.concatenate([ids[0], np.asarray(out[0])])
+    print(tok.decode(full) if tok is not None else f"token ids: {full.tolist()}")
 
 
 if __name__ == "__main__":

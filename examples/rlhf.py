@@ -17,10 +17,12 @@ Run:  python examples/rlhf.py        (CPU mesh or a real chip)
 """
 
 import dataclasses
+import importlib.util
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if importlib.util.find_spec("deepspeed_tpu") is None:  # running from a checkout
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

@@ -230,3 +230,20 @@ def test_inference_config_legacy_kwargs():
     cfg3 = TpuInferenceConfig.from_dict({"mp_size": 4,
                                          "tensor_parallel": {"tp_size": 2}})
     assert cfg3.tensor_parallel.tp_size == 2
+
+
+def test_decode_cache_dtype_narrower_than_compute():
+    """fp32-adapted weights + bf16 KV cache (the documented hf_decode_model →
+    init_inference dtype:bfloat16 flow). Regression: the decode step's
+    one-hot cache rewrite promoted the carry to fp32 and the scan carry
+    dtype flipped ("carry input and carry output must have equal types")."""
+    _mk_mesh(data=1)
+    # TINY computes in fp32; the engine/cache below run bf16
+    spec = make_gpt_decode_model(cfg=TINY, name="f32")
+    engine = init_inference(model=spec, config={"dtype": "bfloat16",
+                                                "kv_cache_dtype": "bfloat16",
+                                                "greedy": True})
+    toks = np.random.default_rng(0).integers(0, TINY.vocab_size, (2, 6)).astype(np.int32)
+    out = np.asarray(engine.generate(toks, max_new_tokens=4))
+    assert out.shape == (2, 4)
+    assert np.isfinite(out).all()
