@@ -7,12 +7,13 @@ layouts. The config classes are ported semantically (same layout math).
 Compute path: a real Pallas block-sparse flash kernel
 (`ops/pallas/block_sparse_attention.py` — per-row visit lists over the block
 layout, analog of the reference's Triton SDD/DSD kernels
-`ops/sparse_attention/matmul.py:17`) whenever T is a 128-multiple and no
-extra bias/mask arguments are passed; measured on v5e at T=8k / 26% density:
-3.9 ms vs 8.8 ms for the dense masked path (2.3x), scaling with density.
-Calls with `rpe` / `attn_mask` / `key_padding_mask` (or odd T) fall back to
-the dense masked fp32 einsum below — those reference features add per-score
-bias tensors the kernel does not stream yet.
+`ops/sparse_attention/matmul.py:17`) whenever T is a 128-multiple; measured
+on v5e at T=8k / 26% density: 3.9 ms vs 8.8 ms for the dense masked path
+(2.3x), scaling with density. `rpe` / batch-shared `attn_mask` /
+`key_padding_mask` stream IN-KERNEL (additive bias slabs + key-padding row,
+like the reference's Triton softmax `ops/sparse_attention/softmax.py`); only
+a batched [B, T, T] attn_mask or an odd T still falls back to the dense
+masked fp32 einsum, with a loud warning.
 """
 
 import math
@@ -218,17 +219,68 @@ class SparseSelfAttention:
                  attn_mask=None):
         B, H, T, hd = query.shape
         scale = self.softmax_scale or 1.0 / math.sqrt(hd)
-        if (rpe is None and key_padding_mask is None and attn_mask is None
-                and T % 128 == 0):
+        # kernel path: rpe and a batch-shared attn_mask stream in-kernel as an
+        # additive [Hb, T, T] bias, key_padding_mask as a [B, T] additive row
+        # (reference streams the same operands through its Triton softmax,
+        # `ops/sparse_attention/softmax.py`). Only a BATCHED [B, T, T]
+        # attn_mask (or a non-128-multiple T) still takes the dense path.
+        kernel_ok = T % 128 == 0
+        bias = None
+        if kernel_ok and rpe is not None:
+            r = jnp.asarray(rpe)
+            if r.ndim == 2:
+                r = r[None]
+            if r.ndim == 3 and r.shape[-2:] == (T, T) and r.shape[0] in (1, H):
+                bias = r.astype(jnp.float32)
+            else:
+                kernel_ok = False
+        if kernel_ok and attn_mask is not None:
+            m = jnp.asarray(attn_mask)
+            if m.ndim == 2 and m.shape == (T, T):
+                mb = (jnp.where(m != 0, 0.0, -1e30)
+                      if self.attn_mask_mode == "mul"
+                      else m.astype(jnp.float32))[None]
+                bias = mb if bias is None else bias + mb
+            else:
+                kernel_ok = False
+        kpm = None
+        if kernel_ok and key_padding_mask is not None:
+            p = jnp.asarray(key_padding_mask)
+            if p.shape == (B, T):
+                kpm = p if p.dtype == jnp.bool_ else p != 0
+            else:
+                kernel_ok = False
+        if kernel_ok:
             from deepspeed_tpu.ops.pallas.block_sparse_attention import \
                 block_sparse_attention
             key_ = ("layout", T)
             if key_ not in self._layouts:
                 self._layouts[key_] = self.config.make_layout(T)
-            return block_sparse_attention(query, key, value,
-                                          self._layouts[key_],
-                                          block=self.config.block,
-                                          sm_scale=scale)
+            try:
+                return block_sparse_attention(
+                    query, key, value, self._layouts[key_],
+                    block=self.config.block, sm_scale=scale, bias=bias,
+                    key_padding_mask=kpm,
+                    # the (dense-T^2) dbias output is emitted exactly where
+                    # the dense path was differentiable: rpe, and ADDITIVE
+                    # attn_masks (a mul-mode mask only feeds a where()
+                    # condition — zero gradient there too)
+                    bias_needs_grad=(rpe is not None
+                                     or (attn_mask is not None and
+                                         self.attn_mask_mode == "add")))
+            except ValueError as e:
+                # e.g. the bias-streaming VMEM budget at very long T: serve
+                # the call on the dense path (as pre-r5 releases did) rather
+                # than crash mid-training
+                from deepspeed_tpu.utils.logging import logger
+                logger.warning("SparseSelfAttention: kernel path unavailable "
+                               "(%s)", e)
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(
+            "SparseSelfAttention: dense O(T^2) fallback engaged (T=%d; "
+            "kernel needs T %% 128 == 0 and batch-shared masks) — at long "
+            "sequences this defeats the sparse kernel's memory/compute "
+            "savings", T)
         mask = self._mask(T)                                # [H, T, T]
         s = jnp.einsum("bhtd,bhsd->bhts", query.astype(jnp.float32),
                        key.astype(jnp.float32)) * scale

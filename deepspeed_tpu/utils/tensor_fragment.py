@@ -94,6 +94,11 @@ def safe_get_full_optimizer_state(engine, path, optim_state_key):
                 r = find(child)
                 if r is not None:
                     return r
+        if isinstance(node, dict):
+            for child in node.values():
+                r = find(child)
+                if r is not None:
+                    return r
         return None
 
     sub = find(engine.state.opt_state)
@@ -124,6 +129,8 @@ def safe_set_full_optimizer_state(engine, path, value, optim_state_key):
             return type(node)(*[rebuild(c) for c in node])
         if isinstance(node, (tuple, list)):
             return type(node)(rebuild(c) for c in node)
+        if isinstance(node, dict):
+            return type(node)((k, rebuild(v)) for k, v in node.items())
         return node
 
     new_opt_state = rebuild(engine.state.opt_state)

@@ -105,6 +105,47 @@ def test_initialize_routes_layered_spec_to_infinity(tmp_path):
             "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
 
 
+def test_infinity_fp16_dynamic_loss_scaling():
+    """fp16 through the Infinity tier (VERDICT r4 item 6; reference stage-3 +
+    offload supports dynamic loss scaling, `zero/stage3.py:1999`): training
+    converges, the scale grows after the window, and an overflow (forced via
+    an fp16-range-exceeding scale) skips the step and halves the scale
+    without touching weights."""
+    import deepspeed_tpu
+    params = init_gpt_params(DEEP, seed=3)
+    spec = make_gpt_layered_model(cfg=DEEP, name="inf-fp16", params=params)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=spec, config={
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "fp16": {"enabled": True, "initial_scale_power": 8,
+                 "loss_scale_window": 2, "hysteresis": 1},
+        "zero_optimization": {"stage": 3,
+                              "offload_param": {"device": "cpu"}}})
+    assert isinstance(eng, InfinityEngine)
+    assert eng.fp16 and eng.cur_scale == 256.0
+    assert eng.dtype == jnp.float16
+    batch = _batches(1, seed=4)[0]
+    losses = [eng.train_batch(batch) for _ in range(6)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+    assert eng.cur_scale > 256.0, "dynamic scale never grew (window=2)"
+
+    # force an overflow: a scale beyond fp16 range makes the scaled grads inf
+    store_before = [a.copy() for a in eng.store.get(0)]
+    steps_before = eng.step_count
+    eng.cur_scale = 2.0 ** 40
+    eng.train_batch(batch)
+    assert eng.skipped_steps >= 1, "overflow did not skip the step"
+    assert eng.cur_scale == 2.0 ** 39, "overflow did not halve the scale"
+    assert eng.step_count == steps_before, "skipped step must not count"
+    for a, b in zip(store_before, eng.store.get(0)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg="weights changed on a skipped step")
+    # recovery: training continues at the halved scale chain
+    l2 = [eng.train_batch(batch) for _ in range(2)]
+    assert np.isfinite(l2).all()
+    eng.release()
+
+
 def test_infinity_gradient_accumulation_matches_big_batch():
     """gas=2 over two micro-batches must walk the same trajectory as gas=1
     on the concatenated batch (mean-loss semantics make the mean of
