@@ -46,6 +46,14 @@ FINE = 16                      # internal mask granularity
 FPK_K = BLOCK_K // FINE        # fine cells per k block (8 — tiling-legal)
 
 
+class BiasVmemBudgetError(ValueError):
+    """The bias-streaming path cannot fit its VMEM slabs at this shape.
+
+    A dedicated type so callers (SparseSelfAttention) can fall back to the
+    dense path on exactly this condition without swallowing unrelated
+    ValueErrors from inside the kernel."""
+
+
 def _use_interpret():
     return jax.default_backend() not in ("tpu", "axon")
 
@@ -395,7 +403,7 @@ def block_sparse_attention(q, k, v, layout, block=16, sm_scale=None,
         est = (T * block_q * 4 * (2 if bias_needs_grad else 1)
                + 4 * T * D * itemsize)
         if est > 12 * 2**20:
-            raise ValueError(
+            raise BiasVmemBudgetError(
                 f"block-sparse bias streaming at T={T}, block_q={block_q}, "
                 f"D={D} needs ~{est / 2**20:.0f} MiB of VMEM-resident slabs "
                 "(>12 MiB budget): pass a smaller block_q, drop the bias "

@@ -206,6 +206,15 @@ class SparseSelfAttention:
         self.softmax_scale = softmax_scale
         self.attn_mask_mode = attn_mask_mode
         self._layouts = {}
+        self._warned = set()
+
+    def _warn_once(self, key, msg):
+        """Dense-fallback warnings dedup per (reason, shape): an eager
+        per-token loop would otherwise emit one warning per call."""
+        if key not in self._warned:
+            self._warned.add(key)
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(msg)
 
     def _mask(self, seq_len):
         if seq_len not in self._layouts:
@@ -268,19 +277,21 @@ class SparseSelfAttention:
                     bias_needs_grad=(rpe is not None
                                      or (attn_mask is not None and
                                          self.attn_mask_mode == "add")))
-            except ValueError as e:
-                # e.g. the bias-streaming VMEM budget at very long T: serve
-                # the call on the dense path (as pre-r5 releases did) rather
-                # than crash mid-training
-                from deepspeed_tpu.utils.logging import logger
-                logger.warning("SparseSelfAttention: kernel path unavailable "
-                               "(%s)", e)
-        from deepspeed_tpu.utils.logging import logger
-        logger.warning(
-            "SparseSelfAttention: dense O(T^2) fallback engaged (T=%d; "
-            "kernel needs T %% 128 == 0 and batch-shared masks) — at long "
+            except Exception as e:
+                from deepspeed_tpu.ops.pallas.block_sparse_attention import \
+                    BiasVmemBudgetError
+                if not isinstance(e, BiasVmemBudgetError):
+                    raise  # only the VMEM budget downgrades to dense —
+                           # anything else is a real bug and must surface
+                self._warn_once(
+                    ("vmem", T),
+                    f"SparseSelfAttention: kernel path unavailable ({e})")
+        self._warn_once(
+            ("dense", T),
+            f"SparseSelfAttention: dense O(T^2) fallback engaged (T={T}; "
+            "kernel needs T % 128 == 0 and batch-shared masks) — at long "
             "sequences this defeats the sparse kernel's memory/compute "
-            "savings", T)
+            "savings")
         mask = self._mask(T)                                # [H, T, T]
         s = jnp.einsum("bhtd,bhsd->bhts", query.astype(jnp.float32),
                        key.astype(jnp.float32)) * scale

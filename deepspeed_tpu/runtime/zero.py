@@ -168,9 +168,31 @@ class ZeroShardingPolicy:
 
     def state_shardings(self, state_shapes, base_specs=None):
         """Shardings for a pytree of ShapeDtypeStructs (from jax.eval_shape)."""
+        # TP-annotation-loss guard (r4 advisor): _get_path returns None for
+        # paths it cannot resolve, which is CORRECT for scalar bookkeeping
+        # leaves (count, step) but silently drops tensor-parallel layouts on
+        # matrix-shaped moments if an optimizer nests its state in a
+        # container shape the suffix-retry does not recognize — warn loudly
+        # on exactly that signature instead of quietly replicating
+        any_nontrivial = base_specs is not None and any(
+            isinstance(sp, P) and any(e is not None for e in sp)
+            for sp in jax.tree_util.tree_leaves(base_specs))
+
+        # per-POLICY dedup (not module-global): a later engine in the same
+        # process must still get its own warning for the same state path
+        warned = self.__dict__.setdefault("_unresolved_state_paths", set())
 
         def leaf(path, s):
             base = _get_path(base_specs, path) if base_specs is not None else None
+            if base is None and any_nontrivial and len(s.shape) >= 2:
+                key = jax.tree_util.keystr(path)
+                if key not in warned:
+                    warned.add(key)
+                    logger.warning(
+                        "optimizer-state leaf %s (shape %s) resolved no base "
+                        "PartitionSpec: its shard will not carry the model's "
+                        "TP annotations (unrecognized state-tree nesting — "
+                        "see zero.py _get_path)", key, tuple(s.shape))
             return NamedSharding(self.mesh, self.state_spec(s.shape, base))
 
         return _tree_map_with_path(leaf, state_shapes)
