@@ -261,7 +261,7 @@ class SparseSelfAttention:
                 kernel_ok = False
         if kernel_ok:
             from deepspeed_tpu.ops.pallas.block_sparse_attention import \
-                block_sparse_attention
+                BiasVmemBudgetError, block_sparse_attention
             key_ = ("layout", T)
             if key_ not in self._layouts:
                 self._layouts[key_] = self.config.make_layout(T)
@@ -277,12 +277,9 @@ class SparseSelfAttention:
                     bias_needs_grad=(rpe is not None
                                      or (attn_mask is not None and
                                          self.attn_mask_mode == "add")))
-            except Exception as e:
-                from deepspeed_tpu.ops.pallas.block_sparse_attention import \
-                    BiasVmemBudgetError
-                if not isinstance(e, BiasVmemBudgetError):
-                    raise  # only the VMEM budget downgrades to dense —
-                           # anything else is a real bug and must surface
+            except BiasVmemBudgetError as e:
+                # only the VMEM budget downgrades to dense — any other
+                # kernel error is a real bug and surfaces normally
                 self._warn_once(
                     ("vmem", T),
                     f"SparseSelfAttention: kernel path unavailable ({e})")

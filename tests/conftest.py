@@ -128,7 +128,7 @@ def pytest_collection_modifyitems(config, items):
         elif RUN_TPU_LANE and not is_tpu:
             item.add_marker(pytest.mark.skip(
                 reason="CPU-mesh test skipped in the TPU kernel lane"))
-        base = item.nodeid.rsplit("/", 1)[-1].split("[", 1)[0]
+        base = item.nodeid.split("[", 1)[0].rsplit("/", 1)[-1]
         if base in _SLOW:
             item.add_marker(pytest.mark.slow)
             _SLOW_MATCHED.add(base)
