@@ -76,9 +76,12 @@ class GPTConfig:
                                      # 1k, 2.3x at 2k, 3.4x at 4k fwd+bwd).
                                      # True/False force the choice. The
                                      # DECODE kernel engages only on
-                                     # explicit True: XLA wins KV-cache
-                                     # decode at 2k/4k (1161 vs 1024,
-                                     # 607 vs 518 tokens/s)
+                                     # explicit True: decode is HBM-
+                                     # bandwidth-bound and XLA's einsum
+                                     # already sits at the floor (r5:
+                                     # 174-204us vs kernel 189us vs floor
+                                     # 164us at ctx 8k) — the kernel TIES,
+                                     # never wins; see docs/kernels.md
     act_quant: Any = None            # ActQuantGate (compression/pruners.py):
                                      # when .active, each block linear's INPUT
                                      # is fake-quantized to .bits with STE
