@@ -61,6 +61,28 @@ def test_flash_attention_compiled_numerics(causal, dtype):
                                atol=tol, rtol=tol)
 
 
+def test_longctx_generate_on_chip():
+    """Long-context SERVING capability pin: a 4096-token prompt through the
+    compiled prefill + decode programs on the real chip (the r5 measured
+    datum: ~0.8 s for generate(64) at B=4; here a smaller/faster shape —
+    the pin is that the path compiles and produces sane tokens, not the
+    latency)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_decode_model
+    cfg = GPTConfig(n_layer=4, n_head=4, d_model=256, max_seq_len=4096 + 16,
+                    vocab_size=50304, dtype=jnp.bfloat16)
+    model = make_gpt_decode_model(cfg=cfg, name="longserve-pin")
+    eng = deepspeed_tpu.init_inference(model, config={"dtype": "bf16"})
+    prompt = np.random.default_rng(0).integers(0, 50000, (2, 4096)).astype(np.int32)
+    out = np.asarray(eng.generate(prompt, max_new_tokens=16))
+    assert out.shape == (2, 16)
+    # greedy decode is deterministic — a NaN/garbage-logits regression breaks
+    # this reproducibility pin even though argmax indices stay in-range
+    out2 = np.asarray(eng.generate(prompt, max_new_tokens=16))
+    np.testing.assert_array_equal(out, out2)
+    assert len(np.unique(out)) > 1, "degenerate constant output"
+
+
 def test_flash_attention_compiled_grads():
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
     B, T, H, D = 1, 256, 2, 128
