@@ -200,8 +200,20 @@ def test_flash_beats_xla_at_long_seq():
         q, k, v, causal=True, interpret=False))
     xla = jax.jit(lambda q, k, v: _xla_attention(q, k, v, True, sm)
                   .astype(jnp.bfloat16))
-    t_flash = _bench(flash, q, k, v)
-    t_xla = _bench(xla, q, k, v)
+    # INTERLEAVE the two variants' timing batches: sequential A-then-B once
+    # flaked this test inside a contention window (the same hazard the decode
+    # test documents — tunnel timing swings are correlated in time)
+    for f in (flash, xla):
+        float(jnp.sum(f(q, k, v).astype(jnp.float32)))
+    best = {"flash": float("inf"), "xla": float("inf")}
+    for _ in range(5):
+        for name, f in (("flash", flash), ("xla", xla)):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                out = f(q, k, v)
+            float(jnp.sum(out.astype(jnp.float32)))
+            best[name] = min(best[name], (time.perf_counter() - t0) / 10)
+    t_flash, t_xla = best["flash"], best["xla"]
     print(f"\nseq {T}: flash {t_flash*1e3:.2f}ms vs XLA {t_xla*1e3:.2f}ms "
           f"({t_xla/t_flash:.2f}x)")
     assert t_flash < t_xla, \
