@@ -418,8 +418,22 @@ def _attention(q, k, v, causal_mask, cfg, attn_fn=None, bias=None):
     if attn_fn is None and want_flash and bias is None \
             and not cfg.sliding_window and cfg.scale_attn \
             and q.shape[1] == k.shape[1] and q.shape[1] % 128 == 0:
-        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
-        attn_fn = partial(flash_attention, causal=True)
+        from deepspeed_tpu.ops.pallas.flash_attention import (flash_attention,
+                                                              flash_max_seq)
+        if q.shape[1] > flash_max_seq(q.shape[-1],
+                                      jnp.dtype(q.dtype).itemsize):
+            # beyond the kernel's single-device VMEM domain (~14k tokens at
+            # head_dim 128): q-chunked rematerialized XLA attention — O(T)
+            # live memory; sequence-parallel shards never land here
+            from deepspeed_tpu.ops.chunked_attention import chunked_attention
+
+            def attn_fn(q, k, v):
+                out = chunked_attention(
+                    jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                    jnp.swapaxes(v, 1, 2), causal=True)
+                return jnp.swapaxes(out, 1, 2)
+        else:
+            attn_fn = partial(flash_attention, causal=True)
     if attn_fn is not None:
         if k.shape[2] != q.shape[2]:  # external kernels expect matched heads
             rep = q.shape[2] // k.shape[2]

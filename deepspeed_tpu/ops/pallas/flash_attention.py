@@ -280,6 +280,28 @@ def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def flash_max_seq(d_head, itemsize=2):
+    """Largest single-device T the kernel can serve: it holds WHOLE [T, D]
+    k/v slabs in VMEM and Pallas double-buffers them, so 4 x T*D*itemsize
+    must fit ~14 MiB of the 16 MiB scoped budget (measured: T=16384 at
+    D=128 bf16 overflows by ~0.7 MiB; T=8192 fits). Longer sequences belong
+    to sequence parallelism (ring/Ulysses shards stay under this cap) or to
+    `ops.chunked_attention` on one device."""
+    return (14 * 2**20) // (4 * d_head * itemsize)
+
+
+def _check_vmem_domain(T, D, dtype, interpret):
+    if interpret:
+        return
+    cap = flash_max_seq(D, jnp.dtype(dtype).itemsize)
+    if T > cap:
+        raise ValueError(
+            f"flash kernel: T={T} exceeds the ~{cap}-token single-device "
+            f"VMEM domain at head_dim={D} (whole double-buffered [T, D] k/v "
+            "slabs). Shard the sequence (parallel/ring.py, parallel/"
+            "ulysses.py) or use ops.chunked_attention.chunked_attention")
+
+
 def _default_blocks(T, block_q, block_k):
     """Measured-crossover default tiles (512/512 from T >= 1024 — see
     flash_attention docstring), shrunk to the largest power-of-two divisor
@@ -334,6 +356,7 @@ def flash_attention_with_lse(q, k, v, causal=True, sm_scale=None, block_q=None,
     if interpret is None:
         interpret = _use_interpret()
     B, H, T, D = q.shape
+    _check_vmem_domain(T, D, q.dtype, interpret)
     block_q, block_k = _default_blocks(T, block_q, block_k)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
@@ -358,6 +381,7 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, block_q=None,
     if layout == "BTHD":
         q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     B, H, T, D = q.shape
+    _check_vmem_domain(T, D, q.dtype, interpret)
     block_q, block_k = _default_blocks(T, block_q, block_k)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
