@@ -40,6 +40,14 @@ class TpuInferenceConfig(ConfigModel):
     moe: Dict[str, Any] = field(default_factory=dict)
     # kv cache
     kv_cache_dtype: str = "bfloat16"
+    # blocked KV-cache layout: cache length is rounded up to a whole number
+    # of kv_block_size-token blocks, the unit the streaming decode kernel
+    # (`ops/pallas/decode_attention.py`) DMAs from HBM — per decode step it
+    # touches only the blocks covering each row's live prefix, so serving
+    # contexts are bounded by HBM, not VMEM. 512 is the measured
+    # bandwidth-floor block on v5e; 0 disables the rounding (legacy exact-
+    # length caches; the kernel then pays a runtime pad-to-block copy).
+    kv_block_size: int = 512
     # ZeRO-Inference parameter spill (reference ds_config "zero_optimization"
     # with stage-3 param offload): {"offload_param": {"device": "cpu"|"nvme",
     # "nvme_path": ..., "lookahead": 1, "staging": 3}}

@@ -100,13 +100,23 @@ class InferenceEngine:
                  f"quant={'int%d' % config.quant.bits if config.quant.enabled else 'off'}",
                  ranks=[0])
 
+    def _cache_len(self, min_len):
+        """Blocked KV-cache sizing: round up to whole kv_block_size blocks
+        (the streaming decode kernel's DMA unit — see init_kv_cache). The
+        over-allocation is free at decode time: the kernel walks only the
+        blocks covering each row's live prefix."""
+        bs = int(getattr(self.config, "kv_block_size", 0) or 0)
+        return -(-min_len // bs) * bs if bs else min_len
+
     def forward(self, tokens, cache=None, pad_mask=None):
         """Prefill forward (logits for a full sequence)."""
         tokens = jnp.asarray(tokens)
         if cache is None:
-            cache = self.model_spec.init_cache(tokens.shape[0],
-                                               self.config.max_out_tokens,
-                                               jnp.dtype(self.config.kv_cache_dtype))
+            cache = self.model_spec.init_cache(
+                tokens.shape[0],
+                self._cache_len(max(self.config.max_out_tokens,
+                                    tokens.shape[1])),
+                jnp.dtype(self.config.kv_cache_dtype))
         return self._prefill(self.params, tokens, cache, pad_mask)
 
     __call__ = forward
@@ -192,7 +202,7 @@ class InferenceEngine:
             tokens, prompt_lens = self._pad_ragged(tokens)
         tokens = jnp.asarray(tokens)
         B, T = tokens.shape
-        max_len = T + max_new_tokens
+        max_len = self._cache_len(T + max_new_tokens)
         cache = self.model_spec.init_cache(B, max_len, jnp.dtype(self.config.kv_cache_dtype))
         if prompt_lens is None:
             prompt_len = jnp.full((B,), T, jnp.int32)

@@ -73,15 +73,30 @@ class GPTConfig:
                                      # T >= FLASH_MIN_SEQ (measured r4, bf16
                                      # dots + 512-blocks: XLA wins <=512
                                      # (0.78 vs 1.22ms), flash wins 1.6x at
-                                     # 1k, 2.3x at 2k, 3.4x at 4k fwd+bwd).
+                                     # 1k, 2.3x at 2k, 3.4x at 4k fwd+bwd)
+                                     # and, since it streams K/V from HBM,
+                                     # carries EVERY longer T (no VMEM cap).
                                      # True/False force the choice. The
-                                     # DECODE kernel engages only on
-                                     # explicit True: decode is HBM-
-                                     # bandwidth-bound and XLA's einsum
-                                     # already sits at the floor (r5:
-                                     # 174-204us vs kernel 189us vs floor
-                                     # 164us at ctx 8k) — the kernel TIES,
-                                     # never wins; see docs/kernels.md
+                                     # DECODE kernel auto-engages from
+                                     # M >= DECODE_KERNEL_MIN_CTX: at short
+                                     # contexts XLA's einsum sits at the
+                                     # bandwidth floor (r5: 174-204us vs
+                                     # kernel 189us vs floor 164us at ctx
+                                     # 8k), but the blocked kernel reads
+                                     # only the live prefix of the cache
+                                     # while XLA always reads all M — at
+                                     # serving-scale caches that asymmetry,
+                                     # not the matmul, decides; see
+                                     # docs/kernels.md
+    chunked_attn_min_seq: Optional[int] = None  # remat/memory escape hatch:
+                                     # T >= this routes to the q-chunked
+                                     # rematerialized XLA path
+                                     # (ops/chunked_attention.py) instead of
+                                     # the flash kernel. None (default) =
+                                     # never — the streaming kernel has no
+                                     # sequence cap, so only an HBM squeeze
+                                     # (activation residuals at extreme T)
+                                     # justifies the ~2.8x-slower fallback
     act_quant: Any = None            # ActQuantGate (compression/pruners.py):
                                      # when .active, each block linear's INPUT
                                      # is fake-quantized to .bits with STE
@@ -404,6 +419,12 @@ def resolve_remat_policy(name):
 
 
 FLASH_MIN_SEQ = 1024  # auto-dispatch crossover (see GPTConfig.use_flash_attention)
+# decode auto-dispatch: the blocked streaming kernel reads only the live
+# cache prefix (clamped block index map) while the XLA einsum reads the whole
+# allocated M every step; at serving-scale caches the allocation/prefix gap
+# dominates, below it XLA already sits at the bandwidth floor (see
+# GPTConfig.use_flash_attention and docs/kernels.md)
+DECODE_KERNEL_MIN_CTX = 8192
 
 
 def _attention(q, k, v, causal_mask, cfg, attn_fn=None, bias=None):
@@ -418,13 +439,12 @@ def _attention(q, k, v, causal_mask, cfg, attn_fn=None, bias=None):
     if attn_fn is None and want_flash and bias is None \
             and not cfg.sliding_window and cfg.scale_attn \
             and q.shape[1] == k.shape[1] and q.shape[1] % 128 == 0:
-        from deepspeed_tpu.ops.pallas.flash_attention import (flash_attention,
-                                                              flash_max_seq)
-        if q.shape[1] > flash_max_seq(q.shape[-1],
-                                      jnp.dtype(q.dtype).itemsize):
-            # beyond the kernel's single-device VMEM domain (~14k tokens at
-            # head_dim 128): q-chunked rematerialized XLA attention — O(T)
-            # live memory; sequence-parallel shards never land here
+        chunk_min = getattr(cfg, "chunked_attn_min_seq", None)
+        if chunk_min is not None and q.shape[1] >= chunk_min:
+            # explicit remat/memory escape hatch (chunked_attn_min_seq): the
+            # streaming kernel itself has no sequence cap — this trades its
+            # speed for jax.checkpoint'd [block_q, T] strips when activation
+            # residuals at extreme T squeeze HBM
             from deepspeed_tpu.ops.chunked_attention import chunked_attention
 
             def attn_fn(q, k, v):
@@ -433,6 +453,10 @@ def _attention(q, k, v, causal_mask, cfg, attn_fn=None, bias=None):
                     jnp.swapaxes(v, 1, 2), causal=True)
                 return jnp.swapaxes(out, 1, 2)
         else:
+            # HBM-streaming flash: one kernel for every T >= FLASH_MIN_SEQ —
+            # K/V tiles DMA from HBM, so 16k+ runs in-kernel instead of on
+            # the ~2.8x-slower rematerialized XLA fallback
+            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
             attn_fn = partial(flash_attention, causal=True)
     if attn_fn is not None:
         if k.shape[2] != q.shape[2]:  # external kernels expect matched heads
@@ -787,7 +811,14 @@ def make_gpt_model(cfg: GPTConfig = None, name="gpt2-125m", seed=0, attn_fn=None
 def init_kv_cache(cfg: GPTConfig, batch_size, max_len, dtype=jnp.bfloat16):
     """[L, B, Hkv, max_len, hd] stacked cache (reference: InferenceContext
     workspace, `csrc/transformer/inference/includes/inference_context.h:49`).
-    Head-major layout so the decode kernel streams one head's K/V contiguously."""
+    Head-major layout so the decode kernel streams one head's K/V contiguously.
+
+    Blocked layout: when max_len is a whole number of KV blocks the
+    streaming decode kernel addresses the contiguous M axis as
+    [num_blocks, block, hd] tiles (a free reshape). The inference engine
+    rounds max_len up via `TpuInferenceConfig.kv_block_size`
+    (`InferenceEngine._cache_len`) so decode steps never pay a runtime
+    pad-to-block copy of the whole cache."""
     shape = (cfg.n_layer, batch_size, cfg.n_kv_head, max_len, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
             "length": jnp.zeros((batch_size,), jnp.int32)}
@@ -830,11 +861,25 @@ def _decode_attn_half(x, p, cache_k, cache_v, pos, cfg: GPTConfig,
     cache_v = cache_v * (1 - onehot)[:, None, :, None] + onehot[:, None, :, None] * v_new
 
     use_plain_path = cfg.use_alibi or cfg.sliding_window
-    # decode kernel on EXPLICIT opt-in only — measured slower than the XLA
-    # KV-cache einsum at 2k/4k context on v5e (see use_flash_attention doc)
-    if cfg.use_flash_attention is True and not use_plain_path:
+    # decode kernel: explicit True forces it; auto engages from
+    # DECODE_KERNEL_MIN_CTX — the blocked streaming kernel reads only the
+    # live prefix of the cache while the XLA einsum reads the whole
+    # allocated M every step (at short contexts XLA already sits at the
+    # bandwidth floor: r5 174-204us vs kernel 189us at ctx 8k)
+    # auto additionally requires a block-tileable M (128-multiple): an
+    # unrounded cache would otherwise pay a whole-cache pad-to-block copy
+    # INSIDE every jitted decode step (the engine's kv_block_size rounding
+    # guarantees this; direct callers with odd M stay on XLA)
+    want_kernel = (cfg.use_flash_attention is True
+                   or (cfg.use_flash_attention is None
+                       and M >= DECODE_KERNEL_MIN_CTX and M % 128 == 0))
+    if want_kernel and not use_plain_path:
         from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
-        attn = decode_attention(q[:, 0], cache_k, cache_v, pos).reshape(B, 1, D)
+        attn = decode_attention(
+            q[:, 0], cache_k, cache_v, pos,
+            # honor scale_attn=False (GPT-Neo): the kernel defaults to
+            # 1/sqrt(hd) when sm_scale is None
+            sm_scale=None if cfg.scale_attn else 1.0).reshape(B, 1, D)
     else:
         scale = 1.0 / math.sqrt(hd) if cfg.scale_attn else 1.0
         m_pos = jnp.arange(M)

@@ -1,18 +1,15 @@
 """Query-chunked causal attention: O(T) live memory on pure XLA.
 
-The tier ABOVE the flash kernel's single-device VMEM domain
-(`ops/pallas/flash_attention.py::flash_max_seq`, ~14k tokens at head_dim
-128): the kernel holds whole [T, D] k/v slabs in VMEM, and a materialized
-[T, T] score tensor is already infeasible long before that. This path scans
-over query blocks — each step computes a full [block_q, T] attention row
-strip and is `jax.checkpoint`-rematerialized, so the live footprint is one
-strip forward AND backward (the scan recomputes strips instead of saving
-B*H*T*T probabilities).
-
-Sequence-parallel deployments don't need this (ring/Ulysses shards stay
-inside the kernel's domain — reference capability analog
-`blogs/deepspeed-ulysses`); it serves very long single-device sequences,
-e.g. gpt2-760m at seq 16384 on one v5e.
+An EXPLICIT remat/memory escape hatch (`GPTConfig.chunked_attn_min_seq`):
+since the flash kernel streams K/V from HBM it has no sequence cap anymore
+(`ops/pallas/flash_attention.py` — the old ~14k whole-slab VMEM domain is
+gone) and is the fast path at every long T; this path remains for shapes
+where activation residuals at extreme T squeeze HBM. It scans over query
+blocks — each step computes a full [block_q, T] attention row strip and is
+`jax.checkpoint`-rematerialized, so the live footprint is one strip forward
+AND backward (the scan recomputes strips instead of saving B*H*T*T
+probabilities). Historical datum: it carried gpt2-760m at seq 16384
+(~0.24 attn-incl MFU) before the streaming kernel took that shape in-kernel.
 """
 
 import math
@@ -30,14 +27,23 @@ def chunked_attention(q, k, v, causal=True, sm_scale=None, block_q=1024):
     exactly the long-sequence probabilities this module exists to serve.
     Dots run on the input dtype (MXU-native) with fp32 accumulation."""
     B, H, T, D = q.shape
+    assert k.shape[2] == T and v.shape[2] == T, (
+        "chunked_attention is self-attention: q/k/v must share T "
+        f"(got q T={T}, k T={k.shape[2]}, v T={v.shape[2]})")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
     block_q = min(block_q, T)
-    while T % block_q != 0:
-        block_q //= 2
-    nq = T // block_q
+    # pad the QUERY axis up to a whole number of blocks instead of shrinking
+    # block_q to a divisor of T (the old `block_q //= 2` search degraded to
+    # block_q=1 strips on odd T — pathologically slow, ADVICE r5 #4). Padded
+    # rows attend real keys only (k/v are NOT padded), compute garbage that
+    # the final slice drops, and contribute zero cotangent in backward.
+    pad = -T % block_q
     in_dtype = q.dtype
     qs = (q.astype(jnp.float32) * sm_scale).astype(in_dtype)
+    if pad:
+        qs = jnp.pad(qs, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    nq = (T + pad) // block_q
     q_blocks = qs.reshape(B, H, nq, block_q, D)
 
     @partial(jax.checkpoint, prevent_cse=False)
@@ -63,5 +69,6 @@ def chunked_attention(q, k, v, causal=True, sm_scale=None, block_q=1024):
     _, out = jax.lax.scan(
         body, None,
         (jnp.moveaxis(q_blocks, 2, 0), jnp.arange(nq, dtype=jnp.int32)))
-    # out: [nq, B, H, block_q, D] -> [B, H, T, D]
-    return jnp.moveaxis(out, 0, 2).reshape(B, H, T, D)
+    # out: [nq, B, H, block_q, D] -> [B, H, T(+pad), D]
+    out = jnp.moveaxis(out, 0, 2).reshape(B, H, T + pad, D)
+    return out[:, :, :T] if pad else out

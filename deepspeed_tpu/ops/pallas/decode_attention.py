@@ -3,10 +3,21 @@
 Analog of the reference's `softmax_context` CUDA kernel
 (`csrc/transformer/inference/csrc/pt_binding.cpp`, softmax.cu — fused
 KV-cache attention with alibi/rope handled upstream). Decode attention is
-HBM-bandwidth bound: each step streams the whole K/V cache once. This kernel
-keeps the online-softmax accumulator in VMEM, reads K/V in blocks, masks by the
-current sequence position, and supports GQA by attending one kv head's group of
-query heads per grid cell.
+HBM-bandwidth bound: each step streams the live K/V prefix once.
+
+The cache is BLOCKED: [B, Hkv, M, hd] with M a multiple of `block_m` (the
+inference engine rounds `max_len` up — `TpuInferenceConfig.kv_block_size`),
+addressed by the kernel in [num_blocks, block_m, hd] units. The grid walks
+the block axis; Pallas's pipeline DMAs one double-buffered [block_m, hd]
+K/V tile from HBM per step while the online-softmax accumulator lives in
+VMEM scratch — the VMEM working set is O(block_m), so context length is
+bounded by HBM, not the old whole-[M, hd]-slab VMEM cap (~14k tokens at
+head_dim 128 bf16). Blocks past each row's live prefix are neither fetched
+(the scalar-prefetched `pos` clamps the block index map, and Pallas elides
+the DMA when consecutive block indices repeat) nor computed (`pl.when`),
+so a step's HBM traffic is ceil((pos+1)/block_m) tiles — the valid prefix
+only, PagedAttention-style, regardless of the cache's allocated M. GQA is
+supported by attending one kv head's group of query heads per grid cell.
 
 Layout: q [B, H, hd]; k/v cache [B, Hkv, M, hd]; pos [B] (current position,
 inclusive — the new token's k/v must already be scattered at pos).
@@ -21,49 +32,64 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+_LANES = 128
 
 
 def _use_interpret():
     return jax.default_backend() not in ("tpu", "axon")
 
 
-def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale, block_m):
-    # q_ref: [1, 1, G, hd]; k_ref/v_ref: [1, 1, M, hd]; pos_ref: SMEM [B]
-    b = pl.program_id(0)
-    pos = pos_ref[b]
-    G, hd = q_ref.shape[2:]
-    M = k_ref.shape[2]
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                   *, sm_scale, block_m):
+    # q_ref: [1, 1, G, hd]; k_ref/v_ref: [1, 1, block_m, hd] (one streamed
+    # cache tile); pos_ref: SMEM [B]; scratch acc [G, hd] fp32, m/l
+    # [G, _LANES] fp32. Grid (B, Hkv, num_blocks): the block axis is
+    # innermost and sequential, scratch carries the online softmax across it.
+    #
     # native-dtype loads + dots (fp32 accumulate via preferred_element_type):
     # pre-casting K/V blocks to fp32 doubles the VMEM working set and VPU
     # traffic (same fix as flash_attention.py)
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nm = pl.num_programs(2)
+    pos = pos_ref[b]
+    G, hd = q_ref.shape[2:]
     in_dtype = q_ref.dtype
-    q = q_ref[0, 0]
 
-    nblocks = pl.cdiv(pos + 1, block_m)  # only blocks intersecting [0, pos]
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    def body(j, carry):
-        acc, m_prev, l_prev = carry
-        k = k_ref[0, 0, pl.ds(j * block_m, block_m), :]
-        v = v_ref[0, 0, pl.ds(j * block_m, block_m), :]
+    # only blocks intersecting [0, pos]; beyond them the clamped index map
+    # re-serves the frontier tile and this predicate keeps it out of the math
+    @pl.when(j * block_m <= pos)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         k_pos = j * block_m + jax.lax.broadcasted_iota(jnp.int32, (G, block_m), 1)
         s = jnp.where(k_pos <= pos, s, NEG_INF)
-        m_cur = jnp.max(s, axis=-1)
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p.astype(in_dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    acc0 = jnp.zeros((G, hd), jnp.float32)
-    m0 = jnp.full((G,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((G,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, nblocks, body, (acc0, m0, l0))
-    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    @pl.when(j == nm - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
 
 
 def decode_attention(q, k, v, pos, sm_scale=None, block_m=None, interpret=None):
@@ -71,10 +97,13 @@ def decode_attention(q, k, v, pos, sm_scale=None, block_m=None, interpret=None):
 
     Attends each query head to cache positions 0..pos inclusive. GQA-aware:
     H must be a multiple of Hkv; the group of G=H//Hkv query heads rides one
-    grid cell with its kv head.
+    grid cell with its kv head. Streams the cache one [block_m, hd] tile at
+    a time and touches only the live prefix — M is bounded by HBM, and a
+    mostly-empty long cache costs what its prefix costs, not what its
+    allocation costs (the XLA einsum path always reads all M).
 
     `block_m=None` auto-selects: decode is HBM-bandwidth-bound (each step
-    must read the whole live KV cache), and the inner-loop fixed overhead
+    must read the whole live KV prefix), and the inner-loop fixed overhead
     dominates at small blocks — measured on v5e at ctx 8192 / GQA 4 kv heads
     (median-of-6 interleaved marginal timings): 644 us/step at block 128 vs
     189 us at block 512, against a 164 us bandwidth floor and XLA's 174-204
@@ -89,30 +118,49 @@ def decode_attention(q, k, v, pos, sm_scale=None, block_m=None, interpret=None):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(hd)
     if block_m is None:
+        # largest measured-good block that tiles M exactly — a non-divisor
+        # would force the whole-cache pad below
         block_m = 512 if M >= 1024 else 128
+        while block_m > 128 and M % block_m != 0:
+            block_m //= 2
     block_m = min(block_m, M)
-    if M % block_m != 0:  # pad cache length to block multiple (masked anyway)
+    if M % block_m != 0:  # pad cache length to block multiple (masked anyway;
+        # the engine's kv_block_size rounding keeps serving caches
+        # block-tileable, so only direct odd-M callers pay this copy)
         pad = block_m - M % block_m
         k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
         M += pad
 
+    pos = pos.astype(jnp.int32)
     qg = q.reshape(B, Hkv, G, hd)
+
+    def kv_index(b, h, j, pos_ref):
+        # clamp past-prefix steps to the frontier block: consecutive equal
+        # indices elide the DMA, so dead blocks cost no HBM traffic
+        return (b, h, jnp.minimum(j, pos_ref[b] // block_m), 0)
+
     out = pl.pallas_call(
         functools.partial(_decode_kernel, sm_scale=sm_scale, block_m=block_m),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(B, Hkv),
+            grid=(B, Hkv, M // block_m),
             in_specs=[
-                pl.BlockSpec((1, 1, G, hd), lambda b, h, pos_ref: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, M, hd), lambda b, h, pos_ref: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, M, hd), lambda b, h, pos_ref: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, G, hd), lambda b, h, j, pos_ref: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_m, hd), kv_index),
+                pl.BlockSpec((1, 1, block_m, hd), kv_index),
             ],
-            out_specs=pl.BlockSpec((1, 1, G, hd), lambda b, h, pos_ref: (b, h, 0, 0)),
+            out_specs=pl.BlockSpec((1, 1, G, hd),
+                                   lambda b, h, j, pos_ref: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((G, hd), jnp.float32),
+                pltpu.VMEM((G, _LANES), jnp.float32),
+                pltpu.VMEM((G, _LANES), jnp.float32),
+            ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype),
         interpret=interpret,
-    )(pos.astype(jnp.int32), qg, k, v)
+    )(pos, qg, k, v)
     return out.reshape(B, H, hd)
 
 

@@ -1,4 +1,4 @@
-"""Flash attention (Pallas, TPU).
+"""Flash attention (Pallas, TPU) — HBM-streaming K/V.
 
 The training-attention hot op — replaces the reference's fused softmax CUDA
 kernels (`csrc/transformer/softmax_kernels.cu`, sparse/triton attention
@@ -7,9 +7,19 @@ online softmax over KV blocks, O(T) memory, fp32 accumulation, causal masking,
 custom VJP with the standard recomputation backward.
 
 Layout: [B, H, T, D] (wrapper transposes from the zoo's [B, T, H, D]).
-K/V live whole per (batch, head) in VMEM — right up to ~8k sequence on v5e;
-longer sequences go through ring attention (parallel/ring.py) on top of this
-kernel per step.
+
+K/V STREAM from HBM: the grid carries a KV-block dimension and Pallas's
+pipeline DMAs one double-buffered [block_k, D] (resp. [block_q, D] in the
+dk/dv pass) tile into VMEM per grid step while the previous tile computes.
+The online-softmax state (acc/m/l) lives in VMEM scratch that persists
+across the sequential KV grid steps, so the kernel's VMEM working set is
+O(block), not O(T) — sequence length is bounded by HBM capacity
+(`flash_max_seq`), not the old ~14k-token whole-slab VMEM cap. Causal
+grids skip fully-masked tiles entirely: compute and output writes are
+predicated off (`pl.when`), and the block index maps clamp to the diagonal
+frontier so the dead steps' DMAs are elided too (repeated consecutive
+block indices fetch nothing — same trick as the decode kernel's prefix
+clamp).
 """
 
 import functools
@@ -23,6 +33,9 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+# VPU lane width: m/l scratch rows are replicated across one lane tile so the
+# scratch stays 2D and tile-aligned regardless of block_q
+_LANES = 128
 
 
 def _use_interpret():
@@ -34,10 +47,13 @@ def _use_interpret():
 # ----------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_k):
-    # q_ref: [block_q, D]; k_ref/v_ref: [T, D]; o_ref: [block_q, D];
-    # lse_ref: [T//block_q, block_q] (whole-array block; row qi written per program —
-    # TPU grid iterations run sequentially, so disjoint row writes are safe)
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, sm_scale, causal, block_k):
+    # q_ref/o_ref: [block_q, D]; k_ref/v_ref: [block_k, D] (one streamed KV
+    # tile); lse_ref: [1, block_q]; scratch acc [block_q, D] fp32, m/l
+    # [block_q, _LANES] fp32 (row stats replicated across lanes — TPU scratch
+    # wants a 128-lane trailing dim). Grid: (BH, nq, nk), nk innermost and
+    # sequential, so scratch carries the online-softmax state across KV tiles.
     #
     # Dots run on NATIVE-dtype operands (bf16 in, fp32 out via
     # preferred_element_type): casting inputs to fp32 first forces the MXU's
@@ -45,46 +61,73 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
     # to XLA attention at seq 512. `p` narrows back to the input dtype for
     # the p@v dot — standard TPU flash practice; softmax stats stay fp32.
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
     block_q, D = q_ref.shape
-    T = k_ref.shape[0]
     in_dtype = q_ref.dtype
-    q = q_ref[:, :]
 
-    nblocks = T // block_k
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
     if causal:
-        # only kv blocks whose start <= q block end
-        nblocks_dyn = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, nblocks)
+        # any (q_pos >= k_pos) pair in this tile? max q_pos = (qi+1)*bq - 1
+        run = ki * block_k < (qi + 1) * block_q
+        last_ki = jnp.minimum(nk - 1, ((qi + 1) * block_q - 1) // block_k)
     else:
-        nblocks_dyn = nblocks
+        run = ki >= 0          # traced always-true (Mosaic-friendly pl.when)
+        last_ki = nk - 1
 
-    def body(j, carry):
-        acc, m_prev, l_prev = carry
-        k = k_ref[pl.ds(j * block_k, block_k), :]
-        v = v_ref[pl.ds(j * block_k, block_k), :]
+    @pl.when(run)
+    def _step():
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_cur = jnp.max(s, axis=-1)
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p.astype(in_dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    acc0 = jnp.zeros((block_q, D), jnp.float32)
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, nblocks_dyn, body, (acc0, m0, l0))
+    @pl.when(ki == last_ki)
+    def _finish():
+        m = m_ref[:, 0]
+        l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[...] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, :] = (m + jnp.log(l_safe)).astype(jnp.float32)
 
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[:, :] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[qi, :] = (m + jnp.log(l_safe)).astype(jnp.float32)
+
+def _kv_index_map(causal, block_q, block_k):
+    """KV-tile index for the (BH, nq, nk) grids. Causal grids clamp ki to the
+    q row's diagonal frontier: fully-masked tiles re-serve the frontier block,
+    and Pallas elides the DMA when consecutive block indices repeat — dead
+    grid steps cost neither MXU (pl.when) nor HBM traffic (same trick as the
+    decode kernel's prefix clamp)."""
+    if not causal:
+        return lambda bh, qi, ki: (bh, ki, 0)
+
+    def index(bh, qi, ki):
+        frontier = ((qi + 1) * block_q - 1) // block_k
+        return (bh, jnp.minimum(ki, frontier), 0)
+
+    return index
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
@@ -93,24 +136,32 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     q2 = q.reshape(BH, T, D)
     k2 = k.reshape(BH, T, D)
     v2 = v.reshape(BH, T, D)
-    grid = (BH, T // block_q)
+    Tb = T // block_q
+    grid = (BH, Tb, T // block_k)
+    kv_index = _kv_index_map(causal, block_q, block_k)
 
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k),
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          block_k=block_k),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((None, block_k, D), kv_index),
+            pl.BlockSpec((None, block_k, D), kv_index),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            # blocked [Tb, bq] layout satisfies TPU (8,128) tiling via whole-array blocks
-            pl.BlockSpec((None, T // block_q, block_q), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            # blocked [Tb, bq] lse layout (rows per q block; lane-dim = bq)
+            pl.BlockSpec((None, 1, block_q), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, T // block_q, block_q), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Tb, block_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=interpret,
     )(q2, k2, v2)
@@ -123,79 +174,105 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, sm_scale, causal, block_k):
+                   dq_acc_ref, *, sm_scale, causal, block_k):
+    # streamed tiles: k/v [block_k, D] walk the KV grid dim; q/do/lse/delta
+    # ride the q block; dq accumulates in scratch across the KV walk
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
     block_q, D = q_ref.shape
-    T = k_ref.shape[0]
     in_dtype = q_ref.dtype
-    q = q_ref[:, :]
-    do = do_ref[:, :]
-    lse = lse_ref[qi, :]
-    delta = delta_ref[qi, :]
 
-    nblocks = T // block_k
-    nblocks_dyn = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, nblocks) \
-        if causal else nblocks
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
-    def body(j, dq):
-        k = k_ref[pl.ds(j * block_k, block_k), :]
-        v = v_ref[pl.ds(j * block_k, block_k), :]
+    if causal:
+        run = ki * block_k < (qi + 1) * block_q
+        last_ki = jnp.minimum(nk - 1, ((qi + 1) * block_q - 1) // block_k)
+    else:
+        run = ki >= 0          # traced always-true (Mosaic-friendly pl.when)
+        last_ki = nk - 1
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[0, :]
+        delta = delta_ref[0, :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta[:, None])).astype(in_dtype)
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        dq_acc_ref[...] = dq_acc_ref[...] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, nblocks_dyn, body, jnp.zeros((block_q, D), jnp.float32))
-    dq_ref[:, :] = (dq * sm_scale).astype(dq_ref.dtype)
+    @pl.when(ki == last_ki)
+    def _finish():
+        dq_ref[...] = (dq_acc_ref[...] * sm_scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
                     *, sm_scale, causal, block_q):
+    # grid (BH, nk, nq), nq innermost: q/do/lse/delta tiles stream past a
+    # resident [block_k, D] k/v tile; dk/dv accumulate in scratch
     ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
     block_k, D = k_ref.shape
-    T = q_ref.shape[0]
     in_dtype = k_ref.dtype
-    k = k_ref[:, :]
-    v = v_ref[:, :]
 
-    nblocks = T // block_q
-    start = (ki * block_k) // block_q if causal else 0
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[pl.ds(i * block_q, block_q), :]
-        do = do_ref[pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[i, :]
-        delta = delta_ref[i, :]
+    # causal: q blocks strictly before the diagonal see no (q_pos >= k_pos)
+    run = (qi + 1) * block_q > ki * block_k if causal else qi >= 0
+
+    @pl.when(run)
+    def _step():
+        k = k_ref[...]
+        v = v_ref[...]
+        q = q_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[0, :]
+        delta = delta_ref[0, :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                                 # [bq, bk]
-        dv = dv + jax.lax.dot_general(p.astype(in_dtype), do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        dv_acc_ref[...] = dv_acc_ref[...] + jax.lax.dot_general(
+            p.astype(in_dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta[:, None])).astype(in_dtype)
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_acc_ref[...] = dk_acc_ref[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    dk0 = jnp.zeros((block_k, D), jnp.float32)
-    dv0 = jnp.zeros((block_k, D), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, nblocks, body, (dk0, dv0))
-    dk_ref[:, :] = (dk * sm_scale).astype(dk_ref.dtype)
-    dv_ref[:, :] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[...] = (dk_acc_ref[...] * sm_scale).astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret,
@@ -216,40 +293,56 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret,
     lse2 = lse                                   # [BH, Tb, block_q] (blocked)
     delta2 = delta.reshape(BH, Tb, block_q)
 
+    kv_index = _kv_index_map(causal, block_q, block_k)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k),
-        grid=(BH, T // block_q),
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_k=block_k),
+        grid=(BH, Tb, T // block_k),
         in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, Tb, block_q), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, Tb, block_q), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((None, block_k, D), kv_index),
+            pl.BlockSpec((None, block_k, D), kv_index),
+            pl.BlockSpec((None, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda bh, qi, ki: (bh, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((None, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
     )(q2, k2, v2, do2, lse2, delta2)
 
+    if causal:
+        # mirror of _kv_index_map for the transposed (BH, nk, nq) grid:
+        # pre-diagonal q tiles re-serve the diagonal block (DMA elided)
+        def q_index(bh, ki, qi):
+            first = (ki * block_k) // block_q
+            return (bh, jnp.maximum(qi, first), 0)
+    else:
+        q_index = lambda bh, ki, qi: (bh, qi, 0)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q),
-        grid=(BH, T // block_k),
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q),
+        grid=(BH, T // block_k, Tb),
         in_specs=[
-            pl.BlockSpec((None, T, D), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((None, block_k, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((None, block_k, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((None, T, D), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((None, Tb, block_q), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((None, Tb, block_q), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((None, block_q, D), q_index),
+            pl.BlockSpec((None, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((None, block_q, D), q_index),
+            pl.BlockSpec((None, 1, block_q), q_index),
+            pl.BlockSpec((None, 1, block_q), q_index),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_k, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((None, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
     )(q2, k2, v2, do2, lse2, delta2)
@@ -280,26 +373,17 @@ def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_max_seq(d_head, itemsize=2):
-    """Largest single-device T the kernel can serve: it holds WHOLE [T, D]
-    k/v slabs in VMEM and Pallas double-buffers them, so 4 x T*D*itemsize
-    must fit ~14 MiB of the 16 MiB scoped budget (measured: T=16384 at
-    D=128 bf16 overflows by ~0.7 MiB; T=8192 fits). Longer sequences belong
-    to sequence parallelism (ring/Ulysses shards stay under this cap) or to
-    `ops.chunked_attention` on one device."""
-    return (14 * 2**20) // (4 * d_head * itemsize)
-
-
-def _check_vmem_domain(T, D, dtype, interpret):
-    if interpret:
-        return
-    cap = flash_max_seq(D, jnp.dtype(dtype).itemsize)
-    if T > cap:
-        raise ValueError(
-            f"flash kernel: T={T} exceeds the ~{cap}-token single-device "
-            f"VMEM domain at head_dim={D} (whole double-buffered [T, D] k/v "
-            "slabs). Shard the sequence (parallel/ring.py, parallel/"
-            "ulysses.py) or use ops.chunked_attention.chunked_attention")
+def flash_max_seq(d_head, itemsize=2, hbm_budget=12 * 2**30):
+    """Largest single-device T the STREAMING kernel can serve. K/V tiles are
+    DMA'd from HBM per grid step, so VMEM no longer bounds the sequence —
+    the bound is HBM holding the op's own operands through fwd+bwd: per
+    (batch x head), ~8 [T, D] slabs (q/k/v/o + do/dq/dk/dv) plus two fp32
+    [T] rows (lse, delta). The historical whole-slab VMEM cap this replaces
+    was (14 MiB)/(4*D*itemsize) ~ 14k tokens at head_dim 128 bf16; the
+    streaming bound at the same shape is ~6M tokens on a 16 GiB chip
+    (12 GiB budgeted — activations elsewhere claim HBM first, so treat
+    this as advisory, not a hard wall)."""
+    return int(hbm_budget) // (8 * d_head * itemsize + 8)
 
 
 def _default_blocks(T, block_q, block_k):
@@ -356,7 +440,6 @@ def flash_attention_with_lse(q, k, v, causal=True, sm_scale=None, block_q=None,
     if interpret is None:
         interpret = _use_interpret()
     B, H, T, D = q.shape
-    _check_vmem_domain(T, D, q.dtype, interpret)
     block_q, block_k = _default_blocks(T, block_q, block_k)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
@@ -371,17 +454,18 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, block_q=None,
     """Flash attention. q,k,v: [B,T,H,D] ("BTHD", zoo layout) or [B,H,T,D].
 
     Sequence length must be a multiple of the block size (the zoo pads to 128
-    multiples; MXU-friendly anyway). Default blocks scale with T: 512/512
-    tiles from T >= 1024 (measured r4 with native-dtype dots, fwd+bwd vs
-    materialized XLA attention: 1.6x at 1k, 2.3x at 2k, 3.4x at 4k; 512/512
-    edged out 512/1024 at both 2k and 4k); short sequences keep 128/128.
+    multiples; MXU-friendly anyway) and is otherwise bounded only by HBM
+    (`flash_max_seq`) — K/V stream through VMEM one [block_k, D] tile at a
+    time. Default blocks scale with T: 512/512 tiles from T >= 1024
+    (measured r4 with native-dtype dots, fwd+bwd vs materialized XLA
+    attention: 1.6x at 1k, 2.3x at 2k, 3.4x at 4k; 512/512 edged out
+    512/1024 at both 2k and 4k); short sequences keep 128/128.
     """
     if interpret is None:
         interpret = _use_interpret()
     if layout == "BTHD":
         q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     B, H, T, D = q.shape
-    _check_vmem_domain(T, D, q.dtype, interpret)
     block_q, block_k = _default_blocks(T, block_q, block_k)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)

@@ -201,10 +201,17 @@ class SparseSelfAttention:
     """Reference `sparse_self_attention.py` API: __call__(q, k, v) with layout
     masking. q,k,v: [B, H, T, hd] (reference layout)."""
 
-    def __init__(self, sparsity_config=None, softmax_scale=None, attn_mask_mode="mul"):
+    def __init__(self, sparsity_config=None, softmax_scale=None, attn_mask_mode="mul",
+                 rpe_requires_grad=True):
         self.config = sparsity_config or FixedSparsityConfig(num_heads=4)
         self.softmax_scale = softmax_scale
         self.attn_mask_mode = attn_mask_mode
+        # rpe_requires_grad=False marks the rpe as a frozen/constant table:
+        # the kernel then skips the dense [B,Hb,nbq,nbk,bq,bk] fp32 dbias
+        # output in backward (full-T^2 HBM — ~256MB x B x Hb at T=8k), which
+        # is exactly the memory regime the sparse kernel exists to avoid
+        # (ADVICE r5 #1). Leave True for learned rpe tables.
+        self.rpe_requires_grad = rpe_requires_grad
         self._layouts = {}
         self._warned = set()
 
@@ -245,6 +252,12 @@ class SparseSelfAttention:
                 kernel_ok = False
         if kernel_ok and attn_mask is not None:
             m = jnp.asarray(attn_mask)
+            # batch-shared masks arrive as [1, T, T] / [1, 1, T, T] as often
+            # as [T, T]; squeeze leading size-1 dims before the gate so they
+            # take the kernel instead of silently falling dense (ADVICE r5
+            # #2 — mirrors the rpe handling, which accepts a leading 1)
+            while m.ndim > 2 and m.shape[0] == 1:
+                m = m[0]
             if m.ndim == 2 and m.shape == (T, T):
                 mb = (jnp.where(m != 0, 0.0, -1e30)
                       if self.attn_mask_mode == "mul"
@@ -270,11 +283,14 @@ class SparseSelfAttention:
                     query, key, value, self._layouts[key_],
                     block=self.config.block, sm_scale=scale, bias=bias,
                     key_padding_mask=kpm,
+
                     # the (dense-T^2) dbias output is emitted exactly where
-                    # the dense path was differentiable: rpe, and ADDITIVE
-                    # attn_masks (a mul-mode mask only feeds a where()
-                    # condition — zero gradient there too)
-                    bias_needs_grad=(rpe is not None
+                    # the dense path was differentiable: a LEARNED rpe
+                    # (rpe_requires_grad), and ADDITIVE attn_masks (a
+                    # mul-mode mask only feeds a where() condition — zero
+                    # gradient there too)
+                    bias_needs_grad=((rpe is not None
+                                      and self.rpe_requires_grad)
                                      or (attn_mask is not None and
                                          self.attn_mask_mode == "add")))
             except BiasVmemBudgetError as e:

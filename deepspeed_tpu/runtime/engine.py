@@ -700,7 +700,7 @@ class Engine:
         Supported on pure data-parallel meshes (tensor/sequence/pipe/expert = 1),
         matching the reference's DP-only scope for these features.
         """
-        from jax import shard_map
+        from deepspeed_tpu.utils.jax_compat import shard_map
         from deepspeed_tpu.runtime import quantized_collectives as qc
 
         zcfg = self.config.zero_optimization
@@ -875,7 +875,12 @@ class Engine:
         def apply_prog(state, grads, loss):
             return apply_grads(state, grads, loss)
 
-        self._off_grads_step = jax.jit(grads_prog)
+        # pin the grads' output sharding to what _off_apply_step consumes:
+        # on sharded gas==1 meshes (no in-fn sharding constraint on grads)
+        # propagation could otherwise pick a layout that forces a cross-
+        # boundary reshard between the two programs (ADVICE r5 #3)
+        self._off_grads_step = jax.jit(
+            grads_prog, out_shardings=(self._grad_shardings(), None))
         self._off_apply_step = jax.jit(apply_prog, donate_argnums=(0,),
                                        out_shardings=(self.state_shardings, None))
 

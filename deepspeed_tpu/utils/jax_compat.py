@@ -1,0 +1,20 @@
+"""Cross-version JAX API shims.
+
+The framework targets the current `jax.shard_map` spelling; older jaxlibs
+(<0.7) ship it as `jax.experimental.shard_map.shard_map` with the
+replication check named `check_rep` instead of `check_vma`. Import
+`shard_map` from here everywhere so one shim owns the difference.
+"""
+
+try:                                      # jax >= 0.7
+    from jax import shard_map as _native_shard_map
+    shard_map = _native_shard_map
+except ImportError:                       # jax < 0.7
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs.setdefault("check_rep", bool(check_vma))
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kwargs)

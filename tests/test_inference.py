@@ -193,6 +193,65 @@ def test_generate_ragged_prompts_match_per_sample():
         np.testing.assert_array_equal(out[i], ref[0])
 
 
+def test_blocked_decode_kernel_matches_xla_on_ragged_batch():
+    """The blocked streaming decode kernel (use_flash_attention=True) and the
+    XLA einsum decode path must emit IDENTICAL greedy tokens on a ragged
+    batch — each row's live prefix starts at its own prompt length, so this
+    exercises the clamped per-row block walk against the dense oracle path."""
+    import dataclasses
+    _mk_mesh(data=1)
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, TINY.vocab_size, (L,)).astype(np.int32)
+               for L in (5, 11, 3, 8)]
+    outs = {}
+    for name, flag in (("xla", False), ("kernel", True)):
+        cfg = dataclasses.replace(TINY, use_flash_attention=flag)
+        spec = make_gpt_decode_model(cfg=cfg, name="tiny")
+        engine = init_inference(model=spec, config={
+            "dtype": "float32", "kv_cache_dtype": "float32", "greedy": True,
+            "kv_block_size": 64})
+        outs[name] = engine.generate(list(prompts), max_new_tokens=6)
+    np.testing.assert_array_equal(outs["kernel"], outs["xla"])
+
+
+def test_decode_kernel_honors_scale_attn_false():
+    """GPT-Neo contract (scale_attn=False: logits are NOT scaled by
+    1/sqrt(hd)): the decode kernel must match the XLA path's unscaled math
+    (r5-review regression pin — the kernel's default sm_scale would silently
+    rescale a model trained without it)."""
+    import dataclasses
+    _mk_mesh(data=1)
+    rng = np.random.default_rng(21)
+    toks = rng.integers(0, TINY.vocab_size, (2, 6)).astype(np.int32)
+    outs = {}
+    for flag in (False, True):
+        cfg = dataclasses.replace(TINY, scale_attn=False,
+                                  use_flash_attention=flag)
+        spec = make_gpt_decode_model(cfg=cfg, name="tiny")
+        engine = init_inference(model=spec, config={
+            "dtype": "float32", "kv_cache_dtype": "float32", "greedy": True})
+        outs[flag] = engine.generate(toks, max_new_tokens=5)
+    np.testing.assert_array_equal(outs[True], outs[False])
+
+
+def test_kv_block_size_rounds_cache_and_preserves_tokens():
+    """Blocked KV-cache layout: kv_block_size rounds the cache length up to
+    whole blocks (so the streaming kernel never pays a runtime pad), and the
+    over-allocation must not change a single emitted token."""
+    _mk_mesh(data=1)
+    spec = make_gpt_decode_model(cfg=TINY, name="tiny")
+    toks = np.random.default_rng(3).integers(
+        0, TINY.vocab_size, (2, 7)).astype(np.int32)
+    outs = {}
+    for bs in (0, 64):
+        engine = init_inference(model=spec, config={
+            "dtype": "float32", "kv_cache_dtype": "float32", "greedy": True,
+            "kv_block_size": bs})
+        assert engine._cache_len(7 + 5) == (12 if bs == 0 else 64)
+        outs[bs] = engine.generate(toks, max_new_tokens=5)
+    np.testing.assert_array_equal(outs[0], outs[64])
+
+
 def test_generate_eos_stop_mask():
     """Per-sample eos early stop: the eos token is kept, every later slot is
     pad_token_id, and other rows keep generating."""

@@ -103,10 +103,42 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-3, atol=5e-3, err_msg=f"d{name}")
 
+    def test_streaming_parity_beyond_legacy_cap(self):
+        """Numerics + grads at a T strictly past the retired whole-slab VMEM
+        cap ((14 MiB)/(4*D*itemsize) — 1792 tokens at head_dim 512 fp32):
+        the KV-grid streaming kernel must match dense attention where the
+        old kernel refused to run."""
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        D, T = 512, 2048
+        legacy_cap = (14 * 2**20) // (4 * D * 4)
+        assert T > legacy_cap, (T, legacy_cap)
+        rng = np.random.default_rng(5)
+        q, k, v = (jnp.asarray(rng.normal(0, 1, (1, 1, T, D)), jnp.float32)
+                   for _ in range(3))
+        out = flash_attention(q, k, v, causal=True, layout="BHTD",
+                              block_q=256, block_k=256)
+        ref = _ref_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+        def f_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True, layout="BHTD",
+                                           block_q=256, block_k=256) ** 2)
+
+        def f_ref(q, k, v):
+            return jnp.sum(_ref_attention(q, k, v, causal=True) ** 2)
+
+        g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g_flash, g_ref, "qkv"):
+            scale = float(jnp.abs(b).max())
+            assert float(jnp.abs(a - b).max()) < 1e-4 * scale, \
+                f"d{name} diverges beyond the legacy cap"
+
     def test_auto_dispatch_by_seq_len(self):
         """use_flash_attention=None auto-dispatches: XLA below FLASH_MIN_SEQ,
         the Pallas kernel at/above it (measured crossover ~1k on v5e); the
-        decode path stays XLA unless forced True."""
+        decode path's own auto-dispatch is pinned in TestDecodeStreaming."""
         import dataclasses
         from deepspeed_tpu.models.gpt import (FLASH_MIN_SEQ, GPTConfig,
                                               gpt_forward, init_gpt_params)
@@ -136,6 +168,77 @@ class TestFlashAttention:
         out = flash_attention(q, k, v, causal=True, layout="BTHD", block_q=64, block_k=64)
         ref = jnp.swapaxes(_ref_attention(*(jnp.swapaxes(x, 1, 2) for x in (q, k, v))), 1, 2)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+class TestDecodeStreaming:
+    """Blocked HBM-streaming decode attention (`ops/pallas/decode_attention`):
+    the cache is walked one [block_m, hd] tile per grid step with the block
+    index clamped to each row's live prefix — context length is HBM-bound."""
+
+    def test_blocked_decode_parity_ragged(self):
+        """Parity vs the jnp oracle on a ragged batch whose live prefixes
+        span <1 block, mid-cache, and the last slot — the clamped index map
+        must not skip or double-count frontier blocks. GQA layout."""
+        from deepspeed_tpu.ops.pallas.decode_attention import (
+            decode_attention, decode_attention_reference)
+        B, H, Hkv, M, hd = 4, 8, 2, 1024, 32
+        rng = np.random.default_rng(9)
+        q = jnp.asarray(rng.normal(0, 1, (B, H, hd)), jnp.float32)
+        k = jnp.asarray(rng.normal(0, 1, (B, Hkv, M, hd)), jnp.float32)
+        v = jnp.asarray(rng.normal(0, 1, (B, Hkv, M, hd)), jnp.float32)
+        pos = jnp.asarray([3, 127, 600, M - 1], jnp.int32)
+        out = decode_attention(q, k, v, pos, block_m=128)
+        ref = decode_attention_reference(q, k, v, pos)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_blocked_decode_beyond_legacy_cap_length(self):
+        """A cache LONGER than the old whole-slab VMEM domain (~14k at
+        head_dim 128 bf16; scaled here via head_dim 512 fp32 → 1792) streams
+        correctly — the shape the old kernel could not serve at all."""
+        from deepspeed_tpu.ops.pallas.decode_attention import (
+            decode_attention, decode_attention_reference)
+        B, H, M, hd = 2, 1, 2048, 512
+        assert M > (14 * 2**20) // (4 * hd * 4)
+        rng = np.random.default_rng(10)
+        q = jnp.asarray(rng.normal(0, 1, (B, H, hd)), jnp.float32)
+        k = jnp.asarray(rng.normal(0, 1, (B, H, M, hd)), jnp.float32)
+        v = jnp.asarray(rng.normal(0, 1, (B, H, M, hd)), jnp.float32)
+        pos = jnp.asarray([M - 1, 42], jnp.int32)
+        out = decode_attention(q, k, v, pos, block_m=512)
+        ref = decode_attention_reference(q, k, v, pos)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_decode_auto_dispatch_by_context(self):
+        """The decode kernel auto-engages from DECODE_KERNEL_MIN_CTX (the
+        blocked kernel reads only the live prefix; XLA reads the whole
+        allocated cache); short caches stay XLA; True/False still force."""
+        import dataclasses
+
+        from deepspeed_tpu.models.gpt import (DECODE_KERNEL_MIN_CTX,
+                                              GPTConfig,
+                                              make_gpt_decode_model)
+        cfg = GPTConfig(n_layer=1, n_head=2, d_model=64, max_seq_len=256,
+                        vocab_size=128, dtype=jnp.float32, remat=False)
+
+        def uses_pallas(cfg, M):
+            spec = make_gpt_decode_model(cfg=cfg)
+            cache = spec.init_cache(1, M, jnp.float32)
+            tok = jnp.zeros((1,), jnp.int32)
+            pos = jnp.zeros((1,), jnp.int32)
+            jaxpr = jax.make_jaxpr(
+                lambda p, t, s, c: spec.decode_fn(p, t, s, c))(
+                    spec.params, tok, pos, cache)
+            return "pallas_call" in str(jaxpr)
+
+        assert cfg.use_flash_attention is None
+        assert not uses_pallas(cfg, 1024)                        # short: XLA
+        assert uses_pallas(cfg, DECODE_KERNEL_MIN_CTX)           # long: kernel
+        forced_off = dataclasses.replace(cfg, use_flash_attention=False)
+        assert not uses_pallas(forced_off, DECODE_KERNEL_MIN_CTX)
+        forced_on = dataclasses.replace(cfg, use_flash_attention=True)
+        assert uses_pallas(forced_on, 1024)
 
 
 class TestNorms:

@@ -83,6 +83,52 @@ def test_longctx_generate_on_chip():
     assert len(np.unique(out)) > 1, "degenerate constant output"
 
 
+def test_flash_streaming_16k_compiled():
+    """The tentpole pin: seq 16384 at head_dim 128 bf16 — PAST the retired
+    whole-slab VMEM cap — compiles and matches a blockwise fp32 oracle
+    IN-KERNEL on the chip (the old kernel raised 'VMEM domain' here and the
+    shape fell to the ~2.8x-slower chunked XLA fallback)."""
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+    B, T, H, D = 1, 16384, 1, 128
+    assert T > (14 * 2**20) // (4 * D * 2)      # strictly beyond the old cap
+    rng = np.random.default_rng(11)
+    q, k, v = (jnp.asarray(rng.normal(0, 1, (B, T, H, D)), jnp.bfloat16)
+               for _ in range(3))
+    out = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, interpret=False))(q, k, v)
+    assert out.shape == (B, T, H, D)
+    o = np.asarray(out, np.float32)
+    assert np.isfinite(o).all()
+    # spot-check rows against an exact fp32 oracle (full-T reference would
+    # materialize 16k x 16k scores; rows are enough to catch streaming bugs)
+    qf, kf, vf = (np.asarray(x, np.float32)[0, :, 0] for x in (q, k, v))
+    for t in (0, 511, 512, 8191, T - 1):        # block edges + extremes
+        s = (qf[t] @ kf[: t + 1].T) / np.sqrt(D)
+        p = np.exp(s - s.max()); p /= p.sum()
+        np.testing.assert_allclose(o[0, t, 0], p @ vf[: t + 1],
+                                   atol=3e-2, rtol=3e-2)
+
+
+def test_decode_streaming_long_cache_compiled():
+    """Blocked decode at a 32k cache (past the old whole-[M, hd]-slab cap):
+    compiles on-chip, matches the jnp oracle, with ragged live prefixes."""
+    from deepspeed_tpu.ops.pallas.decode_attention import (
+        decode_attention, decode_attention_reference)
+    B, H, Hkv, M, D = 4, 16, 4, 32768, 128
+    assert M > (14 * 2**20) // (4 * D * 2)
+    rng = np.random.default_rng(12)
+    q = jnp.asarray(rng.normal(0, 1, (B, H, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(0, 1, (B, Hkv, M, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(0, 1, (B, Hkv, M, D)), jnp.bfloat16)
+    pos = jnp.asarray([100, 8191, 20000, M - 1], jnp.int32)
+    out = jax.jit(lambda q, k, v, p: decode_attention(
+        q, k, v, p, interpret=False))(q, k, v, pos)
+    ref = decode_attention_reference(q, k, v, pos)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=4e-2, rtol=4e-2)
+
+
 def test_flash_attention_compiled_grads():
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
     B, T, H, D = 1, 256, 2, 128
