@@ -1,1 +1,4 @@
-from deepspeed_tpu.checkpoint.saver import save_checkpoint, load_checkpoint, get_latest_tag
+from deepspeed_tpu.checkpoint.saver import (save_checkpoint, load_checkpoint,
+                                            get_latest_tag, wait_pending_save)
+from deepspeed_tpu.checkpoint.manifest import (CheckpointCorruptionError,
+                                               read_manifest, verify_manifest)

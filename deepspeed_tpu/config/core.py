@@ -369,6 +369,32 @@ class CheckpointConfig(ConfigModel):
     # TPU extension: engine = "orbax" (async, default) or "numpy" (simple .npz files)
     engine: str = "orbax"
     async_save: bool = False
+    # crash-safety knobs (docs/fault_tolerance.md):
+    # keep_last_n: retention — committed tags beyond the newest N are GC'd
+    # after each successful commit (0 = keep everything); uncommitted/legacy
+    # dirs are never retention-deleted
+    keep_last_n: int = 0
+    # verify_checksums: load-time deep (crc32) verification of every file the
+    # manifest records; False checks existence+size only (large checkpoints)
+    verify_checksums: bool = True
+
+
+@dataclass
+class FaultToleranceConfig(ConfigModel):
+    """Training-loop bad-state sentinels + in-process rollback
+    (`runtime/sentinel.py`, docs/fault_tolerance.md). Opt-in: the sentinel
+    reads the loss on the host every step, which costs a device sync."""
+    enabled: bool = False
+    nonfinite_budget: int = 3        # consecutive non-finite losses tolerated
+    overflow_budget: int = 50        # consecutive fp16 overflow skip-steps
+    loss_spike_window: int = 0       # rolling-median window (0 = disabled)
+    loss_spike_factor: float = 10.0
+    loss_spike_patience: int = 3
+    # rollback to the last good checkpoint in-process instead of raising
+    # BadStateError (requires a prior save_checkpoint/load_checkpoint so the
+    # engine knows the checkpoint root)
+    auto_rollback: bool = True
+    max_rollbacks: int = 3           # per-process budget before raising anyway
 
 
 @dataclass
@@ -431,6 +457,7 @@ class TpuTrainConfig(ConfigModel):
         default_factory=ProgressiveLayerDropConfig)
     data_types: DataTypesConfig = field(default_factory=DataTypesConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    fault_tolerance: FaultToleranceConfig = field(default_factory=FaultToleranceConfig)
     moe: MoEConfig = field(default_factory=MoEConfig)
 
     gradient_clipping: float = 0.0

@@ -94,6 +94,21 @@ class CsvMonitor(Monitor):
                 w.writerow([step, value])
 
 
+def write_recovery_events(monitor, event_list):
+    """Best-effort emission of checkpoint/recovery observability events
+    (Checkpoint/save_ms, Checkpoint/bytes, Recovery/restarts_total by cause,
+    Recovery/last_good_step, ...). Recovery paths must never die on a
+    monitoring failure — and they run from contexts where no monitor may
+    exist (async save finalizer threads, the elastic agent supervisor) — so
+    this guards both, unlike MonitorMaster.write_events."""
+    if monitor is None or not getattr(monitor, "enabled", False):
+        return
+    try:
+        monitor.write_events(list(event_list))
+    except Exception as e:
+        logger.warning(f"recovery event emission failed: {e}")
+
+
 class MonitorMaster(Monitor):
     """Fans events out to every enabled monitor (reference same name)."""
 

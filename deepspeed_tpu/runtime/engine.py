@@ -34,6 +34,7 @@ from deepspeed_tpu.ops.optim import build_optimizer
 from deepspeed_tpu.runtime import lr_schedules
 from deepspeed_tpu.runtime.dataloader import TpuDataLoader, RepeatingLoader
 from deepspeed_tpu.runtime.precision import LossScaler, LossScaleState, masked_update
+from deepspeed_tpu.runtime.sentinel import BadStateError, BadStateSentinel
 from deepspeed_tpu.runtime.zero import ZeroShardingPolicy
 from deepspeed_tpu.utils.logging import logger, log_dist
 from deepspeed_tpu.utils.timer import (SynchronizedWallClockTimer, ThroughputTimer,
@@ -281,6 +282,15 @@ class Engine:
         self.monitor = self._build_monitor()
         self.losses = None
         self._last_metrics = {}
+
+        # ---- fault tolerance: bad-state sentinel + rollback bookkeeping
+        # (docs/fault_tolerance.md; opt-in via the fault_tolerance block —
+        # observing the loss costs a host sync per step)
+        self._sentinel = BadStateSentinel(config.fault_tolerance)
+        self._last_ckpt_dir = None     # newest save/load root = rollback target
+        self._ckpt_pending = None      # async-save finalizer (checkpoint/saver.py)
+        self._ckpt_pending_error = None
+        self.rollbacks = 0
 
         # flops profiler (lazy)
         self._flops_profiler = None
@@ -1243,6 +1253,64 @@ class Engine:
         if self.config.wall_clock_breakdown and \
                 self.global_steps % self.config.steps_per_print == 0:
             self.timers.log([TRAIN_BATCH_TIMER])
+        if self._sentinel.enabled:
+            overflow = self.fp16_enabled and bool(metrics.get("overflow", False))
+            cause = self._sentinel.observe(float(metrics["loss"]), overflow)
+            if cause is not None:
+                self._recover_bad_state(cause)
+
+    def _recover_bad_state(self, cause):
+        """Persistent bad state past the masked skip-step: roll back to the
+        last good checkpoint in-process when configured (and possible), else
+        raise BadStateError for the supervisor (elasticity/elastic_agent.py)
+        to classify and restart on."""
+        ft = self.config.fault_tolerance
+        detail = self._sentinel.describe(cause)
+        target = self._last_ckpt_dir
+        if ft.auto_rollback and target is not None \
+                and self.rollbacks < ft.max_rollbacks:
+            logger.warning(f"bad state at step {self.global_steps} ({detail}); "
+                           f"rolling back to the last good checkpoint in "
+                           f"{target}")
+            path, _client = self.load_checkpoint(target)
+            if path is not None:
+                self.rollbacks += 1
+                self._sentinel.reset()
+                self._fast_forward_data()
+                if self.monitor is not None and self.monitor.enabled:
+                    from deepspeed_tpu.monitor.monitor import write_recovery_events
+                    write_recovery_events(self.monitor, [
+                        ("Recovery/rollbacks_total", float(self.rollbacks),
+                         self.global_steps),
+                        ("Recovery/last_good_step", float(self.global_steps),
+                         self.global_steps),
+                    ])
+                log_dist(f"rollback #{self.rollbacks} complete: resumed at "
+                         f"step {self.global_steps} (cause: {cause})", ranks=[0])
+                return
+            logger.error(f"rollback target {target} had no loadable checkpoint")
+        raise BadStateError(cause, f"unrecoverable training state: {detail} "
+                                   f"(rollbacks used: {self.rollbacks})")
+
+    def _fast_forward_data(self):
+        """Re-align the data pipeline with the restored step after an
+        in-process rollback. Stateful loaders (curriculum sampler) restore
+        exactly via client_state; the plain loader shuffles per-epoch from
+        (seed + epoch), so rewinding its epoch counter to the restored
+        step's epoch and skipping `restored_step % len` batches replays the
+        exact permutation position the restored state last saw."""
+        if self.training_dataloader is None:
+            return
+        if hasattr(self.training_dataloader, "load_state_dict"):
+            return  # position restored from client_state by load_checkpoint
+        n = len(self.training_dataloader)
+        if n > 0 and hasattr(self.training_dataloader, "epoch"):
+            # must be set BEFORE iter(): __iter__ consumes-then-increments it
+            self.training_dataloader.epoch = self.global_steps // n
+        self._data_iterator = iter(RepeatingLoader(self.training_dataloader))
+        if n > 0:
+            for _ in range(self.global_steps % n):
+                next(self._data_iterator)
 
     # ------------------------------------------------------------------
     # properties / getters (reference engine surface)
@@ -1421,6 +1489,8 @@ class Engine:
                     changed = bool(s.on_resume(self)) or changed
             if changed:
                 self._rebuild_compiled_steps()
+        if path is not None:
+            self._sentinel.reset()  # restored state gets fresh budgets
         return path, client_state
 
     def get_fp32_state_dict(self):

@@ -40,6 +40,11 @@ def pytest_configure(config):
         "markers", "slow: long-running CPU-harness test (excluded from the "
                    "smoke tier: pytest -m 'not slow'; the full suite and the "
                    "driver run everything)")
+    config.addinivalue_line(
+        "markers", "fault: fault-injection / crash-recovery suite "
+                   "(tests/test_fault_tolerance.py) — fast and "
+                   "JAX_PLATFORMS=cpu-safe, so it rides in tier-1; run it "
+                   "alone with pytest -m fault)")
 
 
 # The slow tier, by measured duration (r5 full-suite run with --durations,

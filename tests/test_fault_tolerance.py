@@ -1,0 +1,577 @@
+"""Fault-tolerance suite: atomic checkpoint commits, integrity manifests,
+rollback-on-corruption, bad-state sentinels, elastic restart, retention GC
+and the offline doctor — every path driven by the fault-injection harness
+(`deepspeed_tpu/testing/faults.py`).
+
+Marked `fault` (fast, CPU-safe) and wired into the tier-1 smoke tier.
+"""
+
+import json
+import pathlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint import manifest as manifest_mod
+from deepspeed_tpu.checkpoint import saver as saver_mod
+from deepspeed_tpu.checkpoint.manifest import CheckpointCorruptionError
+from deepspeed_tpu.checkpoint.saver import get_latest_tag, wait_pending_save
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.config.core import MeshConfig
+from deepspeed_tpu.runtime.sentinel import (BadStateError, BadStateSentinel,
+                                            CAUSE_NONFINITE, CAUSE_OVERFLOW,
+                                            CAUSE_LOSS_SPIKE)
+from deepspeed_tpu.testing import faults
+
+pytestmark = pytest.mark.fault
+
+
+def _make_engine(engine_kind="orbax", fault_tolerance=None, checkpoint=None,
+                 mesh=None, seed=0):
+    rng = np.random.default_rng(seed)
+    params = {"w": jnp.asarray(rng.normal(0, 0.1, (32, 32)), jnp.float32),
+              "b": jnp.zeros((32,), jnp.float32)}
+
+    def loss_fn(p, batch):
+        return jnp.mean((batch["x"] @ p["w"] + p["b"] - batch["y"]) ** 2)
+
+    cfg = {"train_micro_batch_size_per_gpu": 4,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "bf16": {"enabled": True},
+           "zero_optimization": {"stage": 2},
+           "steps_per_print": 10**9,
+           "checkpoint": dict({"engine": engine_kind}, **(checkpoint or {}))}
+    if fault_tolerance is not None:
+        cfg["fault_tolerance"] = fault_tolerance
+    if mesh is not None:
+        cfg["mesh"] = mesh
+    eng, *_ = deepspeed_tpu.initialize(model=loss_fn, model_parameters=params,
+                                       config=cfg)
+    return eng
+
+
+def _batch(rng, rows=32):
+    return {"x": rng.normal(0, 1, (rows, 32)).astype(np.float32),
+            "y": rng.normal(0, 1, (rows, 32)).astype(np.float32)}
+
+
+def _w(eng):
+    return np.asarray(jax.device_get(eng.state.params["w"]))
+
+
+# ----------------------------------------------------------------------
+# atomic commit + manifest
+# ----------------------------------------------------------------------
+
+
+class TestAtomicCommit:
+    def test_commit_layout_and_manifest(self, tmp_path):
+        eng = _make_engine()
+        rng = np.random.default_rng(0)
+        eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path), tag="t1")
+
+        ckpt = tmp_path / "t1"
+        m = manifest_mod.read_manifest(ckpt)
+        assert m is not None and m["step"] == 1 and m["tag"] == "t1"
+        assert m["total_bytes"] > 0 and m["files"]
+        # per-leaf tree entries carry global shapes/dtypes
+        keys = {e["key"]: e for e in m["tree"]}
+        assert keys["params/w"]["shape"] == [32, 32]
+        assert keys["params/w"]["dtype"] == "bfloat16"
+        assert keys["master/w"]["dtype"] == "float32"
+        assert m["world"]["device_count"] == jax.device_count()
+        ok, errors = manifest_mod.verify_manifest(ckpt, deep=True)
+        assert ok, errors
+        assert (tmp_path / "latest").read_text().strip() == "t1"
+        # no staging residue after a clean commit
+        assert not list(tmp_path.glob("*.tmp"))
+
+    @pytest.mark.parametrize("point", ["after_state_save", "before_commit"])
+    def test_midsave_crash_preserves_previous_tag(self, tmp_path, point):
+        """Acceptance: a kill during save leaves `latest` at the previous
+        committed tag; the next load resumes from it with no manual help."""
+        eng = _make_engine()
+        rng = np.random.default_rng(0)
+        eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path), tag="good")
+        w_good = _w(eng)
+
+        eng.train_batch(_batch(rng))
+        with faults.crash_save(point):
+            with pytest.raises(faults.FaultInjected):
+                eng.save_checkpoint(str(tmp_path), tag="doomed")
+
+        # the doomed tag never committed; latest still names the good one
+        assert not manifest_mod.is_committed(tmp_path / "doomed")
+        assert (tmp_path / "good.tmp").exists() is False
+        assert get_latest_tag(str(tmp_path)) == "good"
+
+        eng.train_batch(_batch(rng))  # diverge further
+        path, client = eng.load_checkpoint(str(tmp_path))
+        assert path is not None and path.endswith("good")
+        np.testing.assert_allclose(_w(eng), w_good, rtol=1e-6)
+        assert eng.global_steps == 1
+
+        # the orphaned staging dir is GC'd by the next save
+        assert (tmp_path / ("doomed" + manifest_mod.TMP_SUFFIX)).exists()
+        eng.save_checkpoint(str(tmp_path), tag="next")
+        assert not (tmp_path / ("doomed" + manifest_mod.TMP_SUFFIX)).exists()
+
+    def test_crash_after_commit_before_latest_is_recoverable(self, tmp_path):
+        """Commit succeeded but `latest` never advanced: the manifest is the
+        source of truth, so resolution returns the NEWER committed tag over
+        the stale pointer — no committed work is ever silently discarded."""
+        eng = _make_engine()
+        rng = np.random.default_rng(0)
+        eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path), tag="a")
+        eng.train_batch(_batch(rng))
+        with faults.crash_save("after_commit"):
+            with pytest.raises(faults.FaultInjected):
+                eng.save_checkpoint(str(tmp_path), tag="b")
+        assert manifest_mod.is_committed(tmp_path / "b")
+        assert (tmp_path / "latest").read_text().strip() == "a"
+        assert get_latest_tag(str(tmp_path)) == "b"  # stale pointer overridden
+        # a lost/empty pointer falls back to the same scan
+        (tmp_path / "latest").write_text("")
+        assert get_latest_tag(str(tmp_path)) == "b"
+        (tmp_path / "latest").unlink()
+        assert get_latest_tag(str(tmp_path)) == "b"
+        path, _ = eng.load_checkpoint(str(tmp_path))
+        assert path.endswith("b") and eng.global_steps == 2
+
+    def test_resave_same_tag_is_crash_safe(self, tmp_path):
+        """Overwriting a committed tag goes through rename-aside, never
+        rmtree-then-rename: a crash before the commit leaves the OLD copy
+        committed and loadable."""
+        eng = _make_engine()
+        rng = np.random.default_rng(0)
+        eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path), tag="best")
+        w_old = _w(eng)
+        eng.train_batch(_batch(rng))
+        with faults.crash_save("before_commit"):
+            with pytest.raises(faults.FaultInjected):
+                eng.save_checkpoint(str(tmp_path), tag="best")
+        ok, errors = manifest_mod.verify_manifest(tmp_path / "best", deep=True)
+        assert ok, errors  # old committed copy untouched
+        path, _ = eng.load_checkpoint(str(tmp_path), tag="best")
+        assert path is not None
+        np.testing.assert_allclose(_w(eng), w_old, rtol=1e-6)
+        # a successful re-save replaces it and leaves no aside residue
+        eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path), tag="best")
+        assert not list(tmp_path.glob("*.tmp"))
+        m = manifest_mod.read_manifest(tmp_path / "best")
+        assert m["step"] == 2  # load above rewound the counter to 1
+
+    def test_explicit_missing_tag_is_not_substituted(self, tmp_path):
+        """A typo'd explicit tag must not silently load a different tag."""
+        eng = _make_engine()
+        rng = np.random.default_rng(0)
+        eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path), tag="t1")
+        path, client = eng.load_checkpoint(str(tmp_path), tag="nope")
+        assert path is None and client is None
+
+    def test_latest_written_atomically(self, tmp_path):
+        eng = _make_engine()
+        rng = np.random.default_rng(0)
+        eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path), tag="t")
+        # no tempfile residue from the latest write
+        assert [p.name for p in tmp_path.glob("latest*")] == ["latest"]
+
+
+# ----------------------------------------------------------------------
+# validated load + rollback-on-corruption walk
+# ----------------------------------------------------------------------
+
+
+class TestCorruptionFallback:
+    def _two_tags(self, tmp_path, engine_kind="orbax"):
+        eng = _make_engine(engine_kind=engine_kind)
+        rng = np.random.default_rng(0)
+        eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path), tag="t1")
+        w1 = _w(eng)
+        eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path), tag="t2")
+        return eng, rng, w1
+
+    @pytest.mark.parametrize("target", ["state", "manifest"])
+    def test_fallback_walks_to_newest_good_tag(self, tmp_path, target):
+        eng, rng, w1 = self._two_tags(tmp_path)
+        faults.corrupt_checkpoint(tmp_path, tag="t2", target=target)
+        path, client = eng.load_checkpoint(str(tmp_path))
+        assert path is not None and path.endswith("t1")
+        np.testing.assert_allclose(_w(eng), w1, rtol=1e-6)
+        assert eng.global_steps == 1
+
+    def test_explicit_tag_corruption_also_walks_back(self, tmp_path):
+        eng, rng, w1 = self._two_tags(tmp_path)
+        faults.corrupt_checkpoint(tmp_path, tag="t2", target="state",
+                                  mode="truncate")
+        path, _ = eng.load_checkpoint(str(tmp_path), tag="t2")
+        assert path.endswith("t1")
+
+    def test_all_tags_corrupt_raises(self, tmp_path):
+        eng, rng, _ = self._two_tags(tmp_path)
+        faults.corrupt_checkpoint(tmp_path, tag="t1", target="state")
+        faults.corrupt_checkpoint(tmp_path, tag="t2", target="state")
+        with pytest.raises(CheckpointCorruptionError):
+            eng.load_checkpoint(str(tmp_path))
+
+    def test_numpy_engine_same_protocol(self, tmp_path):
+        eng, rng, w1 = self._two_tags(tmp_path, engine_kind="numpy")
+        faults.corrupt_checkpoint(tmp_path, tag="t2", target="state")
+        path, _ = eng.load_checkpoint(str(tmp_path))
+        assert path.endswith("t1")
+        np.testing.assert_allclose(_w(eng), w1, rtol=1e-6)
+
+    def test_structure_mismatch_detected(self, tmp_path):
+        """A manifest whose tree disagrees with the restore template (wrong
+        shape) is rejected before any deserialization is attempted."""
+        eng, rng, w1 = self._two_tags(tmp_path)
+        mpath = tmp_path / "t2" / manifest_mod.MANIFEST_FILE
+        m = json.loads(mpath.read_text())
+        for e in m["tree"]:
+            if e["key"] == "params/w":
+                e["shape"] = [64, 64]
+        mpath.write_text(json.dumps(m))
+        path, _ = eng.load_checkpoint(str(tmp_path))
+        assert path.endswith("t1")
+
+    def test_empty_dir_still_returns_none(self, tmp_path):
+        eng = _make_engine()
+        path, client = eng.load_checkpoint(str(tmp_path / "nothing_here"))
+        assert path is None and client is None
+
+
+# ----------------------------------------------------------------------
+# retention + async engines
+# ----------------------------------------------------------------------
+
+
+class TestRetentionAndAsync:
+    def test_keep_last_n_gc(self, tmp_path):
+        eng = _make_engine(checkpoint={"keep_last_n": 2})
+        rng = np.random.default_rng(0)
+        for i in range(4):
+            eng.train_batch(_batch(rng))
+            eng.save_checkpoint(str(tmp_path))
+        tags = [t for t, _ in manifest_mod.committed_tags(tmp_path)]
+        assert tags == ["global_step4", "global_step3"]
+        assert not (tmp_path / "global_step1").exists()
+        assert get_latest_tag(str(tmp_path)) == "global_step4"
+
+    def test_retention_never_deletes_uncommitted(self, tmp_path):
+        eng = _make_engine(checkpoint={"keep_last_n": 1})
+        rng = np.random.default_rng(0)
+        # a legacy-looking (manifest-less) dir must survive retention
+        legacy = tmp_path / "legacy_tag"
+        (legacy / "state").mkdir(parents=True)
+        (legacy / "client.json").write_text("{}")
+        for _ in range(3):
+            eng.train_batch(_batch(rng))
+            eng.save_checkpoint(str(tmp_path))
+        assert legacy.exists()
+        assert len(manifest_mod.committed_tags(tmp_path)) == 1
+
+    def test_orbax_async_save_is_wired(self, tmp_path):
+        """Satellite: async_save reaches the orbax engine (no eager
+        wait_until_finished inside save); the commit protocol still holds."""
+        eng = _make_engine(checkpoint={"async_save": True})
+        assert getattr(eng, "_ckpt_engine", None) is None
+        rng = np.random.default_rng(0)
+        eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path), tag="a1")
+        assert eng._ckpt_engine.async_save is True
+        wait_pending_save(eng)
+        assert manifest_mod.is_committed(tmp_path / "a1")
+        assert (tmp_path / "latest").read_text().strip() == "a1"
+        w = _w(eng)
+        eng.train_batch(_batch(rng))
+        path, _ = eng.load_checkpoint(str(tmp_path))  # waits internally
+        assert path.endswith("a1")
+        np.testing.assert_allclose(_w(eng), w, rtol=1e-6)
+
+    def test_async_numpy_crash_surfaces_and_preserves_latest(self, tmp_path):
+        eng = _make_engine(engine_kind="numpy")
+        eng.config.checkpoint.async_save = True
+        rng = np.random.default_rng(0)
+        eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path), tag="ok")
+        wait_pending_save(eng)
+        with faults.crash_save("before_commit"):
+            eng.save_checkpoint(str(tmp_path), tag="doomed")
+            with pytest.raises(faults.FaultInjected):
+                wait_pending_save(eng)
+        assert get_latest_tag(str(tmp_path)) == "ok"
+        assert not manifest_mod.is_committed(tmp_path / "doomed")
+
+
+# ----------------------------------------------------------------------
+# bad-state sentinel + in-process rollback
+# ----------------------------------------------------------------------
+
+
+class TestSentinel:
+    def test_unit_budgets(self):
+        s = BadStateSentinel(None, enabled=True)
+        s.nonfinite_budget, s.overflow_budget = 2, 3
+        assert s.observe(1.0) is None
+        assert s.observe(float("nan")) is None
+        assert s.observe(float("nan")) == CAUSE_NONFINITE
+        s.reset()
+        # a finite loss resets the non-finite streak
+        assert s.observe(float("nan")) is None
+        assert s.observe(0.5) is None
+        assert s.observe(float("nan")) is None
+        # overflow steps count on their own budget
+        s.reset()
+        assert s.observe(float("inf"), overflow=True) is None
+        assert s.observe(float("inf"), overflow=True) is None
+        assert s.observe(float("inf"), overflow=True) == CAUSE_OVERFLOW
+
+    def test_unit_loss_spike(self):
+        s = BadStateSentinel(None, enabled=True)
+        s.loss_spike_window, s.loss_spike_factor, s.loss_spike_patience = 4, 10.0, 2
+        s.reset()  # resize the rolling window
+        for v in (1.0, 1.1, 0.9, 1.0):
+            assert s.observe(v) is None
+        assert s.observe(50.0) is None          # first spike: patience
+        assert s.observe(50.0) == CAUSE_LOSS_SPIKE
+
+    def test_nan_injection_triggers_rollback(self, tmp_path):
+        """Acceptance: NaN gradients persisting past the skip-step roll the
+        engine back in-process to the last good checkpoint."""
+        eng = _make_engine(fault_tolerance={"enabled": True,
+                                            "nonfinite_budget": 2,
+                                            "auto_rollback": True})
+        rng = np.random.default_rng(0)
+        for _ in range(2):
+            eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path))
+        w_good = _w(eng)
+
+        clean = _batch(rng)
+        # bf16 has no loss-scaler mask: one poisoned batch NaNs the params,
+        # and the damage persists on clean data — exactly what the sentinel
+        # must catch and roll back
+        eng.train_batch(faults.poison_batch(clean))
+        assert not np.isfinite(_w(eng)).all()
+        eng.train_batch(clean)  # second consecutive non-finite step -> rollback
+
+        assert eng.rollbacks == 1
+        assert eng.global_steps == 2
+        np.testing.assert_allclose(_w(eng), w_good, rtol=1e-6)
+        # training continues cleanly after the rollback
+        loss = float(eng.train_batch(_batch(rng)))
+        assert np.isfinite(loss)
+
+    def test_no_checkpoint_raises_bad_state(self, tmp_path):
+        eng = _make_engine(fault_tolerance={"enabled": True,
+                                            "nonfinite_budget": 1,
+                                            "auto_rollback": True})
+        rng = np.random.default_rng(0)
+        with pytest.raises(BadStateError) as ei:
+            eng.train_batch(faults.poison_batch(_batch(rng)))
+        assert ei.value.cause == CAUSE_NONFINITE
+
+    def test_rollback_budget_exhaustion_raises(self, tmp_path):
+        eng = _make_engine(fault_tolerance={"enabled": True,
+                                            "nonfinite_budget": 1,
+                                            "auto_rollback": True,
+                                            "max_rollbacks": 1})
+        rng = np.random.default_rng(0)
+        eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path))
+        eng.train_batch(faults.poison_batch(_batch(rng)))
+        assert eng.rollbacks == 1
+        with pytest.raises(BadStateError):
+            eng.train_batch(faults.poison_batch(_batch(rng)))
+
+
+# ----------------------------------------------------------------------
+# elastic agent: taxonomy, budgets, resume-tag negotiation, resharding
+# ----------------------------------------------------------------------
+
+
+class TestElasticAgent:
+    def test_restart_cause_taxonomy_and_budgets(self):
+        from deepspeed_tpu.elasticity.elastic_agent import (AgentSpec,
+                                                            ElasticAgent,
+                                                            MembershipChanged,
+                                                            RestartCause)
+        ds_config = {"elasticity": {"enabled": True, "max_train_batch_size": 240,
+                                    "micro_batch_sizes": [2, 4]}}
+        script = [MembershipChanged("chips lost"),
+                  BadStateError("nonfinite_loss", "nan"),
+                  BadStateError("nonfinite_loss", "nan again")]
+
+        def run_fn(world, micro):
+            if script:
+                raise script.pop(0)
+
+        agent = ElasticAgent(AgentSpec(
+            run_fn=run_fn, world_size_fn=lambda: 8, ds_config=ds_config,
+            max_restarts=10, restart_backoff_s=0.0,
+            max_restarts_per_cause={RestartCause.BAD_STATE: 1}))
+        # membership restart ok; first bad_state ok; second exhausts its budget
+        assert agent.run() is False
+        assert agent.restart_causes[RestartCause.MEMBERSHIP] == 1
+        assert agent.restart_causes[RestartCause.BAD_STATE] == 2
+        assert agent.last_cause == RestartCause.BAD_STATE
+
+    def test_backoff_grows_and_caps(self):
+        from deepspeed_tpu.elasticity.elastic_agent import AgentSpec, ElasticAgent
+        agent = ElasticAgent(AgentSpec(
+            run_fn=lambda w, m: None, world_size_fn=lambda: 8,
+            ds_config={}, restart_backoff_s=1.0, backoff_factor=2.0,
+            max_backoff_s=5.0, backoff_jitter=0.0))
+        delays = []
+        for r in (1, 2, 3, 4, 5):
+            agent.restarts = r
+            delays.append(agent._backoff_delay())
+        assert delays[:3] == [1.0, 2.0, 4.0]
+        assert delays[3] == delays[4] == 5.0  # capped
+
+    def test_elastic_restart_resharding_to_smaller_world(self, tmp_path):
+        """Acceptance: mid-save kill + membership shrink (8 -> 4 chips). The
+        agent negotiates the newest COMMITTED tag (the doomed save never
+        commits) and the restarted run restores onto the smaller mesh."""
+        from deepspeed_tpu.elasticity.elastic_agent import (AgentSpec,
+                                                            ElasticAgent,
+                                                            MembershipChanged,
+                                                            RestartCause)
+        ds_config = {"elasticity": {"enabled": True, "max_train_batch_size": 240,
+                                    "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                                    "max_gpus": 16}}
+        ckpt = tmp_path / "ckpt"
+        world_view = {"size": 8}
+        log = {"worlds": [], "resumed": [], "tags": [], "w_after_resume": None}
+        rng = np.random.default_rng(0)
+
+        def run_fn(world, micro, resume_tag):
+            mesh_mod.clear_mesh()
+            mesh_mod.init_mesh(MeshConfig(data=world), n_devices=world)
+            eng = _make_engine(mesh={"data": world})
+            if resume_tag is not None:
+                path, _ = eng.load_checkpoint(str(ckpt), tag=resume_tag)
+                assert path is not None
+                log["w_after_resume"] = _w(eng)
+            log["worlds"].append(world)
+            log["resumed"].append(eng.global_steps)
+            log["tags"].append(resume_tag)
+            for _ in range(2):
+                eng.train_batch(_batch(rng))
+                eng.save_checkpoint(str(ckpt))
+            if world == 8:
+                # the slice shrinks DURING the next save: the save dies
+                # mid-commit, then membership change surfaces
+                with faults.crash_save("before_commit"):
+                    eng.train_batch(_batch(rng))
+                    try:
+                        eng.save_checkpoint(str(ckpt))
+                    except faults.FaultInjected:
+                        pass
+                world_view["size"] = 4
+                raise MembershipChanged("lost 4 of 8 chips")
+
+        agent = ElasticAgent(AgentSpec(
+            run_fn=run_fn, world_size_fn=lambda: world_view["size"],
+            ds_config=ds_config, max_restarts=3, restart_backoff_s=0.0,
+            checkpoint_dir=str(ckpt)))
+        assert agent.run() is True
+        assert agent.restarts == 1
+        assert agent.restart_causes[RestartCause.MEMBERSHIP] == 1
+        assert log["worlds"] == [8, 4]
+        assert log["tags"][0] is None
+        # negotiated tag = last COMMITTED save (step 2), not the doomed step-3
+        assert log["tags"][1] == "global_step2"
+        assert log["resumed"] == [0, 2]
+        assert np.isfinite(log["w_after_resume"]).all()
+        mesh_mod.clear_mesh()
+
+
+# ----------------------------------------------------------------------
+# doctor CLI
+# ----------------------------------------------------------------------
+
+
+class TestDoctor:
+    def _root(self, tmp_path):
+        eng = _make_engine()
+        rng = np.random.default_rng(0)
+        eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path), tag="t1")
+        eng.train_batch(_batch(rng))
+        eng.save_checkpoint(str(tmp_path), tag="t2")
+        return eng
+
+    def test_healthy_root_exits_zero(self, tmp_path, capsys):
+        from deepspeed_tpu.checkpoint.doctor import main
+        self._root(tmp_path)
+        assert main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "OK" in out and "t2" in out
+
+    def test_detects_corruption_and_fixes_latest(self, tmp_path, capsys):
+        from deepspeed_tpu.checkpoint.doctor import main
+        self._root(tmp_path)
+        faults.corrupt_checkpoint(tmp_path, tag="t2", target="state")
+        assert main([str(tmp_path), "--json"]) == 1
+        report = json.loads(capsys.readouterr().out)
+        by_tag = {t["tag"]: t for t in report["tags"]}
+        assert by_tag["t2"]["valid"] is False and by_tag["t1"]["valid"] is True
+        assert report["newest_valid_tag"] == "t1"
+        # --fix-latest repoints at the newest valid tag -> healthy again
+        assert main([str(tmp_path), "--fix-latest"]) == 0
+        assert (tmp_path / "latest").read_text().strip() == "t1"
+
+    def test_gc_and_retention(self, tmp_path, capsys):
+        from deepspeed_tpu.checkpoint.doctor import main
+        eng = self._root(tmp_path)
+        orphan = tmp_path / ("dead" + manifest_mod.TMP_SUFFIX)
+        orphan.mkdir()
+        assert main([str(tmp_path), "--gc", "--keep-last-n", "1"]) == 0
+        assert not orphan.exists()
+        assert not (tmp_path / "t1").exists()
+        assert (tmp_path / "t2").exists()
+
+    def test_single_tag_mode(self, tmp_path, capsys):
+        from deepspeed_tpu.checkpoint.doctor import main
+        self._root(tmp_path)
+        assert main([str(tmp_path), "--tag", "t1", "--json"]) == 0
+        faults.corrupt_checkpoint(tmp_path, tag="t1", target="state")
+        capsys.readouterr()
+        assert main([str(tmp_path), "--tag", "t1", "--json"]) == 1
+        report = json.loads(capsys.readouterr().out)
+        assert any("checksum mismatch" in e or "size mismatch" in e
+                   for e in report["errors"])
+
+
+# ----------------------------------------------------------------------
+# recovery observability
+# ----------------------------------------------------------------------
+
+
+def test_recovery_events_reach_csv_monitor(tmp_path):
+    eng = _make_engine()
+    eng.config.csv_monitor.enabled = True
+    eng.config.csv_monitor.output_path = str(tmp_path / "mon")
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+    eng.monitor = MonitorMaster(eng.config)
+    rng = np.random.default_rng(0)
+    eng.train_batch(_batch(rng))
+    eng.save_checkpoint(str(tmp_path / "ckpt"))
+    mon_dir = tmp_path / "mon" / eng.config.csv_monitor.job_name
+    names = {p.name for p in mon_dir.glob("*.csv")}
+    assert "Checkpoint_save_ms.csv" in names
+    assert "Checkpoint_bytes.csv" in names
+    assert "Checkpoint_last_good_step.csv" in names
