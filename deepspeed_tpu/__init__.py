@@ -13,6 +13,7 @@ version = __version__
 from deepspeed_tpu.config.core import TpuTrainConfig
 from deepspeed_tpu.runtime.engine import Engine, initialize
 from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
+from deepspeed_tpu.inference.scheduler import Request, ServingEngine
 from deepspeed_tpu import comm
 from deepspeed_tpu import zero
 from deepspeed_tpu.utils.logging import logger, log_dist
@@ -60,6 +61,8 @@ __all__ = [
     "HybridEngine",
     "DeepSpeedHybridEngine",
     "InferenceEngine",
+    "ServingEngine",
+    "Request",
     "TpuTrainConfig",
     "DeepSpeedConfig",
     "TpuInferenceConfig",

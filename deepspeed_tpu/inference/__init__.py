@@ -1,2 +1,4 @@
 from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
-from deepspeed_tpu.inference.config import TpuInferenceConfig
+from deepspeed_tpu.inference.config import TpuInferenceConfig, ServingConfig
+from deepspeed_tpu.inference.scheduler import (CompletedRequest, Request,
+                                               ServingEngine)

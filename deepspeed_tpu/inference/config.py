@@ -20,6 +20,43 @@ class TensorParallelConfig(ConfigModel):
 
 
 @dataclass
+class ServingConfig(ConfigModel):
+    """Continuous-batching serving engine (`inference/scheduler.py`).
+
+    The serving layer runs a FIXED-shape decode step over `max_slots`
+    sequence slots against one engine-owned paged KV pool; requests are
+    admitted into freed slots every step and retire (freeing their blocks)
+    the moment they emit EOS. All shape knobs here are compile-stability
+    knobs: each one pins a jitted program's shape for the engine's lifetime.
+    """
+    max_slots: int = 8            # decode batch slots — THE decode step shape
+    max_context: int = 0          # per-sequence cap (prompt + generated);
+                                  # 0 = the engine's max_out_tokens. Sets the
+                                  # block-table width nb = ceil(max_context /
+                                  # kv_block_size)
+    num_kv_blocks: int = 0        # physical pool blocks (incl. the reserved
+                                  # trash block 0); 0 = worst case:
+                                  # max_slots * nb + 1 (no admission can ever
+                                  # starve); smaller values oversubscribe the
+                                  # pool and lean on admission backpressure
+    prefill_chunk: int = 0        # chunked-prefill bucket: prompts process in
+                                  # fixed [1, chunk] slices (one compile
+                                  # total); 0 = kv_block_size
+    prefill_chunks_per_step: int = 1  # prefill work interleaved per decode
+                                  # step — bounds how long an arriving prompt
+                                  # can stall the running batch
+    decode_steps_per_sync: int = 1  # decode WINDOW: tokens decoded per
+                                  # scheduler sync, inside one jitted
+                                  # lax.scan (vLLM's multi-step scheduling).
+                                  # >1 amortizes per-call dispatch + the
+                                  # host roundtrip over K tokens — the lever
+                                  # on dispatch-latency-bound backends — at
+                                  # the cost of K-step retirement/admission
+                                  # granularity (a sequence finishing
+                                  # mid-window wastes the window's tail)
+
+
+@dataclass
 class TpuInferenceConfig(ConfigModel):
     dtype: str = "bfloat16"
     tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
@@ -48,6 +85,8 @@ class TpuInferenceConfig(ConfigModel):
     # bandwidth-floor block on v5e; 0 disables the rounding (legacy exact-
     # length caches; the kernel then pays a runtime pad-to-block copy).
     kv_block_size: int = 512
+    # continuous-batching serving engine knobs (InferenceEngine.serving())
+    serving: ServingConfig = field(default_factory=ServingConfig)
     # ZeRO-Inference parameter spill (reference ds_config "zero_optimization"
     # with stage-3 param offload): {"offload_param": {"device": "cpu"|"nvme",
     # "nvme_path": ..., "lookahead": 1, "staging": 3}}

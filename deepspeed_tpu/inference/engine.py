@@ -51,6 +51,20 @@ class DecodeModelSpec:
     param_specs: Any = None
     eos_token_id: Optional[int] = None
     name: str = "model"
+    # paged-pool serving contract (inference/scheduler.py). Optional: models
+    # without it serve through generate() only. Shapes are FIXED per engine —
+    # that is what keeps the serving step at one compile for its lifetime.
+    #   prefill_paged_fn(params, tokens[B,C], start_pos[B], last_idx[B],
+    #                    pool, block_tables[B,nb]) -> (logits[B,V], pool)
+    #     one chunk of chunked prefill: writes the chunk's k/v into the
+    #     slot's pool blocks and returns the logits at last_idx (the true
+    #     final prompt token on the last chunk; ignored on earlier chunks)
+    #   decode_paged_fn(params, token[B], pos[B], pool, block_tables[B,nb])
+    #       -> (logits[B,V], pool)
+    #   init_paged_pool(num_blocks, block_size, dtype) -> pool pytree
+    prefill_paged_fn: Optional[Callable] = None
+    decode_paged_fn: Optional[Callable] = None
+    init_paged_pool: Optional[Callable] = None
 
 
 class InferenceEngine:
@@ -95,6 +109,16 @@ class InferenceEngine:
         self._prefill = jax.jit(self._fn_transform(model.prefill_fn))
         self._decode = jax.jit(self._fn_transform(model.decode_fn), donate_argnums=(3,))
         self._generate_jit = None
+        # engine-owned KV cache: forward()/generate() reuse the zeros
+        # template when (B, max_len, dtype) matches the previous call
+        # instead of re-allocating (and re-zeroing) a fresh cache every
+        # call. ONE entry only — a multi-shape store would pin several
+        # full-size caches in HBM, a peak-memory regression; a shape miss
+        # just re-allocates, which is exactly the old per-call behavior.
+        # The template is never mutated: the jitted programs are functional
+        # and nothing donates it.
+        self._cache_entry = None          # ((B, max_len, dtype), cache)
+        self._cache_hits = 0
         log_dist(f"inference engine: {model.name} dtype={dtype} "
                  f"tp={config.tensor_parallel.tp_size} "
                  f"quant={'int%d' % config.quant.bits if config.quant.enabled else 'off'}",
@@ -108,15 +132,29 @@ class InferenceEngine:
         bs = int(getattr(self.config, "kv_block_size", 0) or 0)
         return -(-min_len // bs) * bs if bs else min_len
 
+    def _get_cache(self, batch, max_len):
+        """Engine-owned KV cache for (batch, max_len): reused whenever the
+        shape matches the last call (the old per-call init_cache was a fresh
+        HBM allocation + zero-fill per generate()); a shape change replaces
+        the single retained template, so peak HBM never exceeds the old
+        behavior by more than one cache."""
+        key = (int(batch), int(max_len), str(self.config.kv_cache_dtype))
+        if self._cache_entry is not None and self._cache_entry[0] == key:
+            self._cache_hits += 1
+            return self._cache_entry[1]
+        cache = self.model_spec.init_cache(
+            batch, max_len, jnp.dtype(self.config.kv_cache_dtype))
+        self._cache_entry = (key, cache)
+        return cache
+
     def forward(self, tokens, cache=None, pad_mask=None):
         """Prefill forward (logits for a full sequence)."""
         tokens = jnp.asarray(tokens)
         if cache is None:
-            cache = self.model_spec.init_cache(
+            cache = self._get_cache(
                 tokens.shape[0],
                 self._cache_len(max(self.config.max_out_tokens,
-                                    tokens.shape[1])),
-                jnp.dtype(self.config.kv_cache_dtype))
+                                    tokens.shape[1])))
         return self._prefill(self.params, tokens, cache, pad_mask)
 
     __call__ = forward
@@ -181,8 +219,11 @@ class InferenceEngine:
         lens = np.asarray([len(t) for t in tokens], np.int32)
         T = int(lens.max())
         out = np.zeros((len(tokens), T), np.int32)
-        for i, t in enumerate(tokens):
-            out[i, :lens[i]] = np.asarray(t, np.int32)
+        # single boolean-mask scatter instead of a per-row Python loop: the
+        # mask enumerates valid slots row-major, matching the concatenation
+        # order of the ragged rows
+        mask = np.arange(T)[None, :] < lens[:, None]
+        out[mask] = np.concatenate([np.asarray(t, np.int32) for t in tokens])
         return out, lens
 
     def generate(self, tokens, max_new_tokens=32, rng=None, prompt_lens=None,
@@ -202,8 +243,21 @@ class InferenceEngine:
             tokens, prompt_lens = self._pad_ragged(tokens)
         tokens = jnp.asarray(tokens)
         B, T = tokens.shape
-        max_len = self._cache_len(T + max_new_tokens)
-        cache = self.model_spec.init_cache(B, max_len, jnp.dtype(self.config.kv_cache_dtype))
+        # max_new is a static argnum of the jitted loop (the scan length must
+        # be a compile-time constant), so every distinct value used to build
+        # a fresh executable. Bucket it to the next power of two and trim the
+        # surplus host-side: a mixed-request server compiles O(log max_new)
+        # programs instead of one per distinct value. EOS semantics survive
+        # the over-generation — finished rows emit pad_token_id, and the
+        # extra columns are sliced off before anyone sees them. The trade-off
+        # is deliberate: the surplus scan steps (up to 2x decode compute at
+        # the bucket edge, ~1.4x expected) run on every call, bought against
+        # a multi-second XLA compile per distinct max_new; workloads where
+        # per-call decode cost dominates compile amortization should serve
+        # through the continuous-batching scheduler, which has neither cost.
+        max_new_bucket = max(1, 1 << (int(max_new_tokens) - 1).bit_length())
+        max_len = self._cache_len(T + max_new_bucket)
+        cache = self._get_cache(B, max_len)
         if prompt_lens is None:
             prompt_len = jnp.full((B,), T, jnp.int32)
         else:
@@ -217,9 +271,17 @@ class InferenceEngine:
             eos = -1
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         out = self._generate_jit(self.params, tokens, cache, prompt_len,
-                                 max_new_tokens, rng,
+                                 max_new_bucket, rng,
                                  jnp.int32(eos), jnp.int32(pad_token_id))
-        return np.asarray(jax.device_get(out))
+        return np.asarray(jax.device_get(out))[:, :max_new_tokens]
+
+    def serving(self, **overrides):
+        """Continuous-batching serving engine over this engine's params:
+        persistent paged KV pool + request scheduler (inference/scheduler.py).
+        `overrides` patch `config.serving` fields (max_slots, max_context,
+        num_kv_blocks, prefill_chunk, prefill_chunks_per_step)."""
+        from deepspeed_tpu.inference.scheduler import ServingEngine
+        return ServingEngine(self, **overrides)
 
 
 def init_inference(model=None, config=None, **kwargs):

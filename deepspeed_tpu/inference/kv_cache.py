@@ -1,0 +1,116 @@
+"""Paged KV-cache pool — the serving engine's memory system.
+
+vLLM's PagedAttention insight mapped onto the existing blocked cache layout
+(`TpuInferenceConfig.kv_block_size`): instead of one contiguous
+[B, Hkv, M, hd] slab per generate() call, the engine owns a SINGLE pool of
+physical [block, hd] KV blocks allocated once at init —
+``k/v: [L, num_blocks, Hkv, block, hd]`` — and each serving slot holds a
+block TABLE mapping its logical blocks to physical pool blocks. The decode
+kernel (`ops/pallas/decode_attention.paged_decode_attention`) walks a row's
+logical blocks and resolves them through the scalar-prefetched table, so:
+
+  * no per-request cache allocation, ever — admission is a free-list pop;
+  * a sequence's memory is freed the step it emits EOS (continuous batching
+    can admit a queued request into the freed blocks immediately);
+  * fragmentation is bounded to < one block per sequence.
+
+Block 0 is RESERVED as the trash block: inactive slots point every table
+entry at it, so the fixed-shape decode step can run over all slots — the
+writes of dead slots land in the trash block and their reads produce garbage
+the scheduler never looks at. This is what keeps the decode program's shape
+(and therefore its compile) constant for the lifetime of the engine.
+
+The allocator is deliberately host-side and stdlib-only: block alloc/free
+happens at request admission/retirement (a few times per second), not in the
+per-token hot loop, which stays a single fixed-shape jitted call.
+"""
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+TRASH_BLOCK = 0  # physical block 0: write sink for inactive slots
+
+
+class BlockAllocator:
+    """Free-list over the physical blocks of a paged KV pool.
+
+    Block 0 (TRASH_BLOCK) is never handed out. alloc() is all-or-nothing:
+    a request either gets every block it needs or stays queued — partial
+    allocation would deadlock two half-admitted requests against each other.
+    """
+
+    def __init__(self, num_blocks: int):
+        assert num_blocks >= 2, "pool needs >= 1 usable block past the trash block"
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, 0, -1))  # pop() yields low ids first
+
+    @property
+    def capacity(self) -> int:
+        """Usable blocks (the trash block is not allocatable)."""
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Pop n blocks, or None (and no state change) if fewer are free."""
+        if n > len(self._free):
+            return None
+        got = [self._free.pop() for _ in range(n)]
+        return got
+
+    def free(self, blocks: List[int]):
+        for b in blocks:
+            assert b != TRASH_BLOCK, "freeing the trash block"
+            assert b not in self._free, f"double free of block {b}"
+            self._free.append(b)
+
+
+def max_written_pos(prompt_len: int, padded_prompt: int, max_new: int,
+                    window: int = 1) -> int:
+    """Highest cache position a request ever WRITES — the single source of
+    truth for pool sizing (blocks_needed) AND admission validation (the
+    scheduler's table-width check); two copies of this math drifting apart
+    would let a request scribble past its allocated blocks.
+
+    Chunked prefill writes the padded prompt's tail (masked garbage,
+    overwritten by decode as it advances), and decode writes token i's k/v
+    at prompt_len + i for i in [0, max_new-1) — the final sampled token is
+    emitted without a decode step, so it never lands in the cache. With a
+    decode window (`decode_steps_per_sync` > 1) the device runs whole
+    windows blindly, so the max_new-1 decode writes round UP to a window
+    multiple (the tail of the last window is garbage the scheduler
+    discards — but it was written).
+    """
+    decode_writes = max_new - 1
+    if window > 1 and decode_writes > 0:
+        decode_writes = -(-decode_writes // window) * window
+    return max(padded_prompt - 1, prompt_len - 1 + decode_writes)
+
+
+def blocks_needed(prompt_len: int, padded_prompt: int, max_new: int,
+                  block_size: int, window: int = 1) -> int:
+    """Physical blocks a request occupies for its whole lifetime (see
+    max_written_pos for the write-extent reasoning)."""
+    return max_written_pos(prompt_len, padded_prompt, max_new,
+                           window) // block_size + 1
+
+
+def gather_block_kv(pool_k_l, pool_v_l, block_tables):
+    """Materialize each row's logical KV as contiguous [B, Hkv, nb*block, hd].
+
+    The XLA fallback path for paged attention (short contexts / CPU harness /
+    alibi + sliding-window archs): one gather per layer per step. The Pallas
+    kernel exists precisely to NOT pay this — it resolves the table inside
+    the block index map — but the gathered form keeps a dense oracle for
+    numerics and covers every arch flag.
+
+    pool_[kv]_l: [N, Hkv, block, hd] (one layer's pool); block_tables: [B, nb].
+    """
+    B, nb = block_tables.shape
+    N, Hkv, bm, hd = pool_k_l.shape
+    k = jnp.moveaxis(pool_k_l[block_tables], 2, 1).reshape(B, Hkv, nb * bm, hd)
+    v = jnp.moveaxis(pool_v_l[block_tables], 2, 1).reshape(B, Hkv, nb * bm, hd)
+    return k, v

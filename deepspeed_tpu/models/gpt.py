@@ -824,6 +824,39 @@ def init_kv_cache(cfg: GPTConfig, batch_size, max_len, dtype=jnp.bfloat16):
             "length": jnp.zeros((batch_size,), jnp.int32)}
 
 
+def _decode_qkv(x, p, positions, cfg: GPTConfig):
+    """Shared decode-path preamble: ln1 -> fused qkv -> split/reshape ->
+    rope at absolute positions. One definition for the contiguous-cache
+    half AND the paged half — a rope/GQA change cannot diverge them.
+    x: [B, C, D]; positions: [B, C]. Returns q [B,C,H,hd], k/v [B,C,Hkv,hd].
+    (The training `_attn_half` stays separate: it additionally threads
+    act-quant gates, remat checkpoint names, and shard constraints.)"""
+    B, C, _ = x.shape
+    H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm,
+              cfg.norm_eps)
+    qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
+    q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
+    q = q.reshape(B, C, H, hd)
+    k = k.reshape(B, C, Hkv, hd)
+    v = v.reshape(B, C, Hkv, hd)
+    if cfg.use_rotary:
+        rd = int(cfg.rotary_pct * hd) // 2 * 2
+        q = _rope(q, positions, rd, cfg.rope_theta)
+        k = _rope(k, positions, rd, cfg.rope_theta)
+    return q, k, v
+
+
+def _decode_kernel_wanted(cfg: GPTConfig, M):
+    """Shared auto-engage rule for the streaming decode kernels: explicit
+    True forces, auto engages from DECODE_KERNEL_MIN_CTX with a
+    block-tileable length (contiguous path: M = allocated cache length;
+    paged path: M = table_width * block = the effective context)."""
+    return (cfg.use_flash_attention is True
+            or (cfg.use_flash_attention is None
+                and M >= DECODE_KERNEL_MIN_CTX and M % 128 == 0))
+
+
 def _decode_attn_half(x, p, cache_k, cache_v, pos, cfg: GPTConfig,
                       local_flag=None):
     """Single-token attention half: writes k/v at `pos` into the head-major
@@ -832,18 +865,7 @@ def _decode_attn_half(x, p, cache_k, cache_v, pos, cfg: GPTConfig,
     B, _, D = x.shape
     H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
     M = cache_k.shape[2]
-    use_rms = cfg.use_rmsnorm
-
-    h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), use_rms, cfg.norm_eps)
-    qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
-    q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
-    q = q.reshape(B, 1, H, hd)
-    k = k.reshape(B, 1, Hkv, hd)
-    v = v.reshape(B, 1, Hkv, hd)
-    if cfg.use_rotary:
-        rd = int(cfg.rotary_pct * hd) // 2 * 2
-        q = _rope(q, pos[:, None], rd, cfg.rope_theta)
-        k = _rope(k, pos[:, None], rd, cfg.rope_theta)
+    q, k, v = _decode_qkv(x, p, pos[:, None], cfg)
 
     # write k,v at pos via one-hot masked rewrite. Counterintuitive but
     # measured: streaming the whole [B,Hkv,M,hd] cache through fused
@@ -870,10 +892,7 @@ def _decode_attn_half(x, p, cache_k, cache_v, pos, cfg: GPTConfig,
     # unrounded cache would otherwise pay a whole-cache pad-to-block copy
     # INSIDE every jitted decode step (the engine's kv_block_size rounding
     # guarantees this; direct callers with odd M stay on XLA)
-    want_kernel = (cfg.use_flash_attention is True
-                   or (cfg.use_flash_attention is None
-                       and M >= DECODE_KERNEL_MIN_CTX and M % 128 == 0))
-    if want_kernel and not use_plain_path:
+    if _decode_kernel_wanted(cfg, M) and not use_plain_path:
         from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
         attn = decode_attention(
             q[:, 0], cache_k, cache_v, pos,
@@ -967,8 +986,161 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
     def init_cache(batch_size, max_len, dtype=jnp.bfloat16):
         return init_kv_cache(cfg, batch_size, max_len, dtype)
 
+    # paged-pool serving contract (see DecodeModelSpec): both fns scan the
+    # stacked blocks with the pool's layer axis as scan data, exactly like
+    # the contiguous cache path, so layer count stays out of compile time
+
+    def _scan_paged(params, x, pool, block_tables, positions):
+        flags = _layer_local_flags(cfg)
+
+        def body(x, inputs, flag=None):
+            p, pk, pv = inputs
+            x, pk, pv = _block_paged(x, p, pk, pv, positions, block_tables,
+                                     cfg, local_flag=flag)
+            return x, (pk, pv)
+
+        layers = (params["blocks"], pool["k"], pool["v"])
+        if flags is None:
+            x, (ks, vs) = jax.lax.scan(body, x, layers)
+        else:
+            x, (ks, vs) = jax.lax.scan(
+                lambda c, inp: body(c, inp[0], flag=inp[1]), x, (layers, flags))
+        return x, {"k": ks, "v": vs}
+
+    def prefill_paged_fn(params, tokens, start_pos, last_idx, pool,
+                         block_tables):
+        B, C = tokens.shape
+        positions = start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None]
+        x = _embed(params, tokens, positions, cfg)
+        x, pool = _scan_paged(params, x, pool, block_tables, positions)
+        last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)
+        logits = _lm_head(params, last, cfg)[:, 0]
+        return logits, pool
+
+    def decode_paged_fn(params, token, pos, pool, block_tables):
+        x = _embed(params, token[:, None], pos[:, None], cfg)
+        x, pool = _scan_paged(params, x, pool, block_tables, pos[:, None])
+        logits = _lm_head(params, x, cfg)[:, 0]
+        return logits, pool
+
+    def init_paged_pool(num_blocks, block_size, dtype=jnp.bfloat16):
+        return init_paged_kv_pool(cfg, num_blocks, block_size, dtype)
+
     return DecodeModelSpec(prefill_fn=prefill_fn, decode_fn=decode_fn,
-                           init_cache=init_cache, params=params, name=name)
+                           init_cache=init_cache, params=params, name=name,
+                           prefill_paged_fn=prefill_paged_fn,
+                           decode_paged_fn=decode_paged_fn,
+                           init_paged_pool=init_paged_pool)
+
+
+# ----------------------------------------------------------------------
+# paged decode path — for the continuous-batching serving engine
+# (inference/scheduler.py): KV lives in a shared pool of physical blocks,
+# each slot addresses it through a block table
+# ----------------------------------------------------------------------
+
+
+def init_paged_kv_pool(cfg: GPTConfig, num_blocks, block_size,
+                       dtype=jnp.bfloat16):
+    """[L, num_blocks, Hkv, block, hd] physical-block pool, allocated ONCE at
+    serving-engine init (vLLM's PagedAttention layout on the blocked cache
+    unit). Block 0 is the trash block (inference/kv_cache.py): inactive
+    slots' writes land there so the fixed-shape decode step never branches
+    on liveness."""
+    shape = (cfg.n_layer, num_blocks, cfg.n_kv_head, block_size, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _paged_attend(q, k_ctx, v_ctx, q_pos, cfg: GPTConfig, local_flag=None):
+    """Attend q over table-gathered KV with ABSOLUTE positions.
+
+    q: [B, C, H, hd] (C = 1 for decode, = chunk length for chunked prefill);
+    k_ctx/v_ctx: [B, Hkv, S, hd] in logical order (S = nb * block — gathered
+    rows ARE position order, so k index == absolute position); q_pos: [B, C].
+    Causal/window masks and alibi bias are built from absolute positions
+    per row — unlike the training path, two rows of a serving batch sit at
+    different positions. Returns [B, C, H*hd]; fp32 softmax."""
+    B, C, H, hd = q.shape
+    Hkv, S = k_ctx.shape[1], k_ctx.shape[2]
+    G = H // Hkv
+    scale = 1.0 / math.sqrt(hd) if cfg.scale_attn else 1.0
+    k_pos = jnp.arange(S, dtype=jnp.int32)
+    valid = k_pos[None, None, :] <= q_pos[:, :, None]          # [B, C, S]
+    if cfg.sliding_window:
+        win = valid & (q_pos[:, :, None] - k_pos[None, None, :]
+                       < cfg.sliding_window)
+        valid = win if local_flag is None else jnp.where(local_flag, win, valid)
+    qg = q.reshape(B, C, Hkv, G, hd)
+    logits = jnp.einsum("bckgd,bksd->bkgcs", qg,
+                        k_ctx).astype(jnp.float32) * scale
+    if cfg.use_alibi:
+        dist = (q_pos[:, :, None] - k_pos[None, None, :]).astype(jnp.float32)
+        logits = logits - (_alibi_slopes(H).reshape(Hkv, G)[None, :, :, None, None]
+                           * dist[:, None, None, :, :])
+    logits = jnp.where(valid[:, None, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgcs,bksd->bckgd", probs, v_ctx)
+    return out.reshape(B, C, H * hd)
+
+
+def _paged_attn_half(x, p, pool_k_l, pool_v_l, positions, block_tables,
+                     cfg: GPTConfig, local_flag=None):
+    """Attention half-block against one layer's paged pool.
+
+    x: [B, C, D]; pool_[kv]_l: [N, Hkv, block, hd]; positions: [B, C]
+    absolute; block_tables: [B, nb]. Writes the C new tokens' k/v into each
+    row's blocks (logical position -> table -> physical block scatter), then
+    attends over the row's whole table. Returns (attn_out, pool_k, pool_v).
+    """
+    from deepspeed_tpu.inference.kv_cache import gather_block_kv
+
+    B, C, D = x.shape
+    bs = pool_k_l.shape[2]
+    nb = block_tables.shape[1]
+
+    q, k, v = _decode_qkv(x, p, positions, cfg)
+
+    # scatter the new k/v through the table: logical block = pos // bs,
+    # physical block = table[row, logical], offset = pos % bs. Rows of
+    # inactive slots (all-trash tables, pos 0) collide in the trash block —
+    # duplicate-index scatter order is unspecified there and irrelevant.
+    blk = jnp.take_along_axis(block_tables, positions // bs, axis=1)  # [B, C]
+    off = positions % bs
+    pool_k_l = pool_k_l.at[blk, :, off, :].set(k.astype(pool_k_l.dtype))
+    pool_v_l = pool_v_l.at[blk, :, off, :].set(v.astype(pool_v_l.dtype))
+
+    use_plain_path = cfg.use_alibi or cfg.sliding_window
+    # single-token steps ride the paged Pallas kernel when it is worth it:
+    # same engage rule as the contiguous decode path (forced, or auto at
+    # serving-scale effective context nb*bs), PLUS the paged-only
+    # constraints: the kernel's no-bias/no-window contract, a lane-aligned
+    # pool block (it cannot pad physical blocks the way the contiguous
+    # kernel pads a whole cache), and C == 1 — chunked prefill always takes
+    # the gather path (matmul-bound, not gather-bound).
+    want_kernel = (C == 1 and not use_plain_path and bs % 128 == 0
+                   and _decode_kernel_wanted(cfg, nb * bs))
+    if want_kernel:
+        from deepspeed_tpu.ops.pallas.decode_attention import \
+            paged_decode_attention
+        attn = paged_decode_attention(
+            q[:, 0], pool_k_l, pool_v_l, block_tables, positions[:, 0],
+            sm_scale=None if cfg.scale_attn else 1.0).reshape(B, 1, D)
+    else:
+        k_ctx, v_ctx = gather_block_kv(pool_k_l, pool_v_l, block_tables)
+        attn = _paged_attend(q, k_ctx, v_ctx, positions, cfg,
+                             local_flag=local_flag)
+    attn_out = attn @ p["attn_out_w"] + p["attn_out_b"]
+    return attn_out, pool_k_l, pool_v_l
+
+
+def _block_paged(x, p, pool_k_l, pool_v_l, positions, block_tables,
+                 cfg: GPTConfig, local_flag=None):
+    """One transformer block against the paged pool (decode or prefill chunk)."""
+    attn_out, pool_k_l, pool_v_l = _paged_attn_half(
+        x, p, pool_k_l, pool_v_l, positions, block_tables, cfg,
+        local_flag=local_flag)
+    x = _residual_mlp(x, attn_out, p, cfg, constrain=False)
+    return x, pool_k_l, pool_v_l
 
 
 # ----------------------------------------------------------------------

@@ -164,6 +164,97 @@ def decode_attention(q, k, v, pos, sm_scale=None, block_m=None, interpret=None):
     return out.reshape(B, H, hd)
 
 
+def _paged_decode_kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
+                         m_ref, l_ref, *, sm_scale, block_m):
+    # Same math as _decode_kernel — only the ADDRESSING differs: the grid's
+    # block axis walks LOGICAL blocks 0..nb-1 of each row, and the index map
+    # (not this body) resolves each one to a physical pool block through the
+    # scalar-prefetched block table. bt_ref is therefore unused here; the
+    # online-softmax state, the live-prefix predicate (j*block_m <= pos) and
+    # the in-block position mask are identical because logical positions are
+    # what `pos` counts.
+    del bt_ref
+    _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                   sm_scale=sm_scale, block_m=block_m)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, pos, sm_scale=None,
+                           interpret=None):
+    """Decode attention over a PAGED KV pool (vLLM's PagedAttention layout).
+
+    q: [B, H, hd]; k_pool/v_pool: [N, Hkv, block, hd] physical blocks shared
+    by every sequence; block_tables: [B, nb] int32 mapping each row's logical
+    block j to a physical pool block; pos: [B] int32 (current position,
+    inclusive — the new token's k/v must already be scattered at pos).
+    Returns [B, H, hd].
+
+    The grid walks each row's logical blocks; the kv index map resolves
+    logical → physical through the scalar-prefetched table, so the kernel
+    DMAs exactly the pool tiles covering the live prefix — no [B, M] gather
+    is ever materialized in HBM (the XLA fallback path pays that gather
+    every step). Past-prefix steps clamp to the frontier logical block:
+    consecutive equal physical indices elide the DMA, same trick as the
+    contiguous kernel. Rows whose table entries all point at the reserved
+    trash block (inactive slots) produce garbage output that callers ignore.
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    B, H, hd = q.shape
+    N, Hkv, block_m, _ = k_pool.shape
+    nb = block_tables.shape[1]
+    assert H % Hkv == 0
+    G = H // Hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(hd)
+
+    pos = pos.astype(jnp.int32)
+    block_tables = block_tables.astype(jnp.int32)
+    qg = q.reshape(B, Hkv, G, hd)
+
+    def kv_index(b, h, j, pos_ref, bt_ref):
+        # clamp to the frontier LOGICAL block, then translate to physical:
+        # dead logical blocks re-serve the frontier's physical tile and the
+        # repeated index elides the DMA
+        jj = jnp.minimum(j, pos_ref[b] // block_m)
+        return (bt_ref[b, jj], h, 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, sm_scale=sm_scale,
+                          block_m=block_m),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, Hkv, nb),
+            in_specs=[
+                pl.BlockSpec((1, 1, G, hd),
+                             lambda b, h, j, pos_ref, bt_ref: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_m, hd), kv_index),
+                pl.BlockSpec((1, 1, block_m, hd), kv_index),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, G, hd), lambda b, h, j, pos_ref, bt_ref: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((G, hd), jnp.float32),
+                pltpu.VMEM((G, _LANES), jnp.float32),
+                pltpu.VMEM((G, _LANES), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype),
+        interpret=interpret,
+    )(pos, block_tables, qg, k_pool, v_pool)
+    return out.reshape(B, H, hd)
+
+
+def paged_decode_attention_reference(q, k_pool, v_pool, block_tables, pos,
+                                     sm_scale=None):
+    """jnp oracle: gather each row's blocks into a contiguous cache (the
+    SAME gather the XLA fallback path uses — one definition, so the oracle
+    cannot silently diverge from production), then run the contiguous
+    reference."""
+    from deepspeed_tpu.inference.kv_cache import gather_block_kv
+    k, v = gather_block_kv(k_pool, v_pool, block_tables)
+    return decode_attention_reference(q, k, v, pos, sm_scale=sm_scale)
+
+
 def decode_attention_reference(q, k, v, pos, sm_scale=None):
     """jnp reference (numerics oracle for tests)."""
     B, H, hd = q.shape

@@ -45,6 +45,11 @@ def pytest_configure(config):
                    "(tests/test_fault_tolerance.py) — fast and "
                    "JAX_PLATFORMS=cpu-safe, so it rides in tier-1; run it "
                    "alone with pytest -m fault)")
+    config.addinivalue_line(
+        "markers", "serving: continuous-batching serving engine + paged "
+                   "KV-cache pool suite (tests/test_serving.py) — fast and "
+                   "CPU-harness-safe, rides in tier-1; run it alone with "
+                   "pytest -m serving)")
 
 
 # The slow tier, by measured duration (r5 full-suite run with --durations,

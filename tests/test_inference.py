@@ -275,6 +275,40 @@ def test_generate_eos_stop_mask():
             np.testing.assert_array_equal(out[r], free[r])
 
 
+def test_pad_ragged_vectorized_incl_length_one():
+    """_pad_ragged regression: the vectorized mask-scatter must right-pad
+    exactly like the old per-row loop, including length-1 rows (a [1]-shaped
+    row exercises the mask's edge: exactly one valid slot)."""
+    rows = [[7], [1, 2, 3], [9, 8], [4]]
+    out, lens = InferenceEngine._pad_ragged(rows)
+    np.testing.assert_array_equal(lens, [1, 3, 2, 1])
+    np.testing.assert_array_equal(out, [[7, 0, 0], [1, 2, 3], [9, 8, 0],
+                                        [4, 0, 0]])
+    assert out.dtype == np.int32
+    # degenerate: every row length 1
+    out1, lens1 = InferenceEngine._pad_ragged([[5], [6]])
+    np.testing.assert_array_equal(out1, [[5], [6]])
+    np.testing.assert_array_equal(lens1, [1, 1])
+
+
+def test_generate_accepts_length_one_ragged_prompt():
+    """Ragged batch containing a length-1 prompt decodes per-row identically
+    to generating that row alone (regression for the _pad_ragged rewrite)."""
+    _mk_mesh(data=1)
+    spec = make_gpt_decode_model(cfg=TINY, name="tiny")
+    engine = init_inference(model=spec, config={"dtype": "float32",
+                                                "kv_cache_dtype": "float32",
+                                                "greedy": True})
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(0, TINY.vocab_size, (L,)).astype(np.int32)
+               for L in (1, 7, 4)]
+    out = engine.generate(list(prompts), max_new_tokens=4)
+    assert out.shape == (3, 4)
+    for i, p in enumerate(prompts):
+        ref = engine.generate(p[None, :], max_new_tokens=4)
+        np.testing.assert_array_equal(out[i], ref[0])
+
+
 def test_inference_config_legacy_kwargs():
     """Reference init_inference kwargs: mp_size (deprecated TP degree), torch
     dtype spellings, replace_method — must not be silently dropped."""

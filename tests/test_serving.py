@@ -1,0 +1,386 @@
+"""Continuous-batching serving engine + paged KV-cache pool
+(inference/scheduler.py, inference/kv_cache.py, the paged decode kernel).
+
+Everything here rides the `serving` marker (tier-1; run alone with
+`pytest -m serving`).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.config.core import MeshConfig
+from deepspeed_tpu.inference.engine import init_inference
+from deepspeed_tpu.inference.kv_cache import (BlockAllocator, TRASH_BLOCK,
+                                              blocks_needed)
+from deepspeed_tpu.inference.scheduler import Request
+from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_decode_model
+
+pytestmark = pytest.mark.serving
+
+TINY = GPTConfig(n_layer=2, n_head=4, d_model=64, max_seq_len=256,
+                 vocab_size=256, dtype=jnp.float32, remat=False)
+
+
+def _mk_mesh(**axes):
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    return mesh_mod.init_mesh(MeshConfig(**{**dict(data=1, tensor=1, sequence=1,
+                                                   expert=1, pipe=1), **axes}))
+
+
+def _mk_engine(cfg=TINY, **cfg_over):
+    _mk_mesh(data=1)
+    spec = make_gpt_decode_model(cfg=cfg, name="tiny")
+    return init_inference(model=spec, config={
+        "dtype": "float32", "kv_cache_dtype": "float32", "greedy": True,
+        "kv_block_size": 16, "max_out_tokens": 64, **cfg_over})
+
+
+def _ragged_prompts(rng, lens, vocab=TINY.vocab_size):
+    return [rng.integers(0, vocab, (L,)).astype(np.int32) for L in lens]
+
+
+# ----------------------------------------------------------------------
+# allocator + sizing math
+# ----------------------------------------------------------------------
+
+
+def test_block_allocator_free_list():
+    alloc = BlockAllocator(8)            # block 0 reserved
+    assert alloc.capacity == 7
+    a = alloc.alloc(3)
+    b = alloc.alloc(4)
+    assert a is not None and b is not None
+    assert TRASH_BLOCK not in a + b and len(set(a + b)) == 7
+    assert alloc.alloc(1) is None        # exhausted: all-or-nothing, no change
+    alloc.free(a)
+    assert alloc.num_free == 3
+    c = alloc.alloc(3)
+    assert sorted(c) == sorted(a)        # freed blocks get reused
+    with pytest.raises(AssertionError):
+        alloc.free([b[0], b[0]])         # double free
+
+
+def test_blocks_needed_math():
+    # prompt 5 padded to 16, 4 new tokens, block 16: prefill writes 0..15,
+    # decode writes positions 5..7 -> 1 block
+    assert blocks_needed(5, 16, 4, 16) == 1
+    # decode crosses into a second block: prompt 14, +6 new writes up to 18
+    assert blocks_needed(14, 16, 6, 16) == 2
+    # max_new=1: the single token is sampled from prefill logits, never
+    # written -> padded prompt alone decides
+    assert blocks_needed(16, 16, 1, 16) == 1
+    # decode window: max_new-1=5 decode writes round up to 8 (one 8-window
+    # tail is written blindly) -> prompt 14 writes up to position 21
+    assert blocks_needed(14, 16, 6, 16, window=8) == 2
+    assert blocks_needed(14, 16, 12, 16, window=8) == 2   # 11 -> 16 writes, pos 29
+    assert blocks_needed(14, 16, 20, 16, window=8) == 3   # 19 -> 24 writes, pos 37
+
+
+# ----------------------------------------------------------------------
+# paged decode kernel vs gather oracle (interpret mode on the CPU harness)
+# ----------------------------------------------------------------------
+
+
+def test_paged_decode_kernel_matches_gather_oracle():
+    from deepspeed_tpu.ops.pallas.decode_attention import (
+        paged_decode_attention, paged_decode_attention_reference)
+    rng = np.random.default_rng(11)
+    B, H, Hkv, hd, bm, N, nb = 4, 8, 4, 64, 128, 12, 3
+    q = jnp.asarray(rng.normal(size=(B, H, hd)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(N, Hkv, bm, hd)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(N, Hkv, bm, hd)), jnp.float32)
+    # shuffled physical mapping incl. a row parked on the trash block only
+    bt = jnp.asarray([[7, 2, 10], [1, 9, 4], [3, 5, 8], [0, 0, 0]], jnp.int32)
+    pos = jnp.asarray([5, 200, 383, 0], jnp.int32)
+    out = paged_decode_attention(q, kp, vp, bt, pos)
+    ref = paged_decode_attention_reference(q, kp, vp, bt, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+# ----------------------------------------------------------------------
+# serving engine: correctness, retirement, backpressure, compile accounting
+# ----------------------------------------------------------------------
+
+
+def test_serving_matches_static_generate_on_ragged_trace():
+    """Block-table correctness end to end: a mixed-length trace through the
+    continuous-batching engine must emit EXACTLY the tokens each prompt gets
+    from static-batch generate() (same greedy math, chunked prefill +
+    paged decode vs whole-prompt prefill + contiguous cache)."""
+    engine = _mk_engine()
+    rng = np.random.default_rng(1)
+    prompts = _ragged_prompts(rng, (5, 11, 3, 8, 14, 2, 31, 17))
+    serving = engine.serving(max_slots=3, max_context=64, prefill_chunk=16)
+    reqs = [Request(uid=i, tokens=p, max_new_tokens=3 + i % 5,
+                    stop_on_eos=False)
+            for i, p in enumerate(prompts)]
+    res = serving.run(reqs)
+    assert sorted(res) == list(range(len(prompts)))
+    for i, p in enumerate(prompts):
+        ref = engine.generate(p[None, :], max_new_tokens=3 + i % 5,
+                              stop_on_eos=False)
+        np.testing.assert_array_equal(res[i].tokens, ref[0])
+        assert res[i].finish_reason == "length"
+
+
+def test_serving_single_compile_per_program_across_mixed_trace():
+    """THE recompile-tax guarantee: one decode program and one prefill-chunk
+    program for the engine's lifetime, across arbitrary prompt lengths,
+    max_new values, and admission orders."""
+    engine = _mk_engine()
+    rng = np.random.default_rng(2)
+    serving = engine.serving(max_slots=2, max_context=64, prefill_chunk=16)
+    for wave in ((4, 9), (21, 2, 33), (15,)):
+        reqs = [Request(uid=f"{wave}-{i}", tokens=p,
+                        max_new_tokens=2 + i * 3, stop_on_eos=False)
+                for i, p in enumerate(_ragged_prompts(rng, wave))]
+        serving.run(reqs)
+    assert serving.compile_stats() == {"decode_step": 1, "prefill_step": 1}, \
+        serving.compile_stats()
+
+
+def test_eos_retirement_frees_slot_and_blocks_immediately():
+    """A sequence retires the step it emits EOS: its blocks return to the
+    pool, its slot admits the next queued request, and the emitted output
+    keeps the EOS token (generate()'s contract)."""
+    engine = _mk_engine()
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, TINY.vocab_size, (6,)).astype(np.int32)
+    # free-run to discover what greedy emits, then use token 2 as "EOS"
+    free = engine.generate(prompt[None], max_new_tokens=8, stop_on_eos=False)[0]
+    eos = int(free[2])
+    serving = engine.serving(max_slots=1, max_context=64, prefill_chunk=16)
+    free_blocks0 = serving.allocator.num_free
+    res = serving.run([Request(uid="a", tokens=prompt, max_new_tokens=8,
+                               eos_token_id=eos)])
+    out = res["a"].tokens
+    assert res["a"].finish_reason == "eos"
+    assert out[-1] == eos and len(out) <= 3 + 1
+    np.testing.assert_array_equal(out, free[:len(out)])
+    assert serving.allocator.num_free == free_blocks0, "blocks leaked"
+    # slot is reusable: a second request runs through the same slot
+    res2 = serving.run([Request(uid="b", tokens=prompt, max_new_tokens=4,
+                                stop_on_eos=False)])
+    np.testing.assert_array_equal(res2["b"].tokens, free[:4])
+    assert serving.compile_stats() == {"decode_step": 1, "prefill_step": 1}
+
+
+def test_pool_exhaustion_backpressure():
+    """A pool sized for ~one request at a time: excess requests WAIT in the
+    queue (no crash, no over-allocation) and complete as blocks free up."""
+    engine = _mk_engine()
+    rng = np.random.default_rng(4)
+    prompts = _ragged_prompts(rng, (17, 20, 18))
+    # each request: padded prompt 32 -> 2 blocks of 16; 3 usable blocks fit
+    # one request at a time, never two
+    serving = engine.serving(max_slots=3, max_context=48, prefill_chunk=16,
+                             num_kv_blocks=4)
+    reqs = [Request(uid=i, tokens=p, max_new_tokens=6, stop_on_eos=False)
+            for i, p in enumerate(prompts)]
+    res = serving.run(reqs)
+    assert sorted(res) == [0, 1, 2]
+    assert serving.peak_active == 1, \
+        "backpressure failed: two requests shared a 1-request pool"
+    for i, p in enumerate(prompts):
+        ref = engine.generate(p[None, :], max_new_tokens=6, stop_on_eos=False)
+        np.testing.assert_array_equal(res[i].tokens, ref[0])
+    assert serving.allocator.num_free == serving.allocator.capacity
+
+
+def test_submit_rejects_impossible_requests():
+    engine = _mk_engine()
+    serving = engine.serving(max_slots=2, max_context=32, prefill_chunk=16)
+    with pytest.raises(ValueError, match="max_context"):
+        serving.submit(Request(uid=0, tokens=list(range(30)),
+                               max_new_tokens=16))
+    with pytest.raises(ValueError, match="empty prompt"):
+        serving.submit(Request(uid=1, tokens=[], max_new_tokens=4))
+    small = engine.serving(max_slots=1, max_context=64, prefill_chunk=16,
+                           num_kv_blocks=2)
+    with pytest.raises(ValueError, match="KV blocks"):
+        small.submit(Request(uid=2, tokens=list(range(40)), max_new_tokens=8))
+
+
+def test_serving_interleaves_prefill_with_decode():
+    """A long prompt arriving mid-flight must not stall the running batch:
+    with prefill_chunks_per_step=1 the already-decoding request keeps
+    emitting a token every step while the newcomer prefills chunk by chunk."""
+    engine = _mk_engine()
+    rng = np.random.default_rng(5)
+    short, long = _ragged_prompts(rng, (4, 60))
+    serving = engine.serving(max_slots=2, max_context=96, prefill_chunk=16,
+                             prefill_chunks_per_step=1)
+    serving.submit(Request(uid="short", tokens=short, max_new_tokens=12,
+                           stop_on_eos=False))
+    # warm the short request into decode
+    serving.step()
+    emitted_before = serving.slots and max(
+        len(s.emitted) for s in serving.slots if s.uid == "short")
+    serving.submit(Request(uid="long", tokens=long, max_new_tokens=2,
+                           stop_on_eos=False))
+    done = {}
+    for _ in range(4):           # long needs 4 chunks of 16 to finish prefill
+        for f in serving.step():
+            done[f.uid] = f
+    short_slot = [s for s in serving.slots if s.uid == "short"]
+    assert short_slot, "short request should still be decoding"
+    # the short request advanced EVERY step while the long one prefilled
+    assert len(short_slot[0].emitted) == emitted_before + 4
+    while serving.num_active or serving.queue:
+        for f in serving.step():
+            done[f.uid] = f
+    ref_s = engine.generate(short[None], max_new_tokens=12, stop_on_eos=False)
+    ref_l = engine.generate(long[None], max_new_tokens=2, stop_on_eos=False)
+    np.testing.assert_array_equal(done["short"].tokens, ref_s[0])
+    np.testing.assert_array_equal(done["long"].tokens, ref_l[0])
+
+
+def test_decode_window_matches_per_step_and_generate():
+    """decode_steps_per_sync > 1 (multi-step scheduling: a whole window of
+    tokens per jitted call) must emit the same tokens as window=1 and as
+    static generate(), including EOS truncation mid-window."""
+    engine = _mk_engine()
+    rng = np.random.default_rng(12)
+    prompts = _ragged_prompts(rng, (5, 11, 3, 22))
+    news = [9, 4, 13, 6]
+    ref = {i: engine.generate(p[None], max_new_tokens=n, stop_on_eos=False)[0]
+           for i, (p, n) in enumerate(zip(prompts, news))}
+    for window in (4, 8):
+        serving = engine.serving(max_slots=2, max_context=96, prefill_chunk=16,
+                                 decode_steps_per_sync=window)
+        res = serving.run([Request(uid=i, tokens=p, max_new_tokens=n,
+                                   stop_on_eos=False)
+                           for i, (p, n) in enumerate(zip(prompts, news))])
+        for i in ref:
+            np.testing.assert_array_equal(res[i].tokens, ref[i]), (window, i)
+        assert serving.compile_stats() == {"decode_step": 1,
+                                           "prefill_step": 1}
+    # EOS mid-window: discover a token greedy emits, stop on it, and check
+    # the output truncates exactly there (the window tail is discarded)
+    eos = int(ref[0][3])
+    serving = engine.serving(max_slots=1, max_context=96, prefill_chunk=16,
+                             decode_steps_per_sync=4)
+    out = serving.run([Request(uid="e", tokens=prompts[0], max_new_tokens=9,
+                               eos_token_id=eos)])["e"]
+    hits = np.flatnonzero(ref[0] == eos)
+    np.testing.assert_array_equal(out.tokens, ref[0][:hits[0] + 1])
+    assert out.finish_reason == "eos"
+    assert serving.allocator.num_free == serving.allocator.capacity
+
+
+def test_serving_arch_flags_parity():
+    """Paged prefill/decode honor the arch flags (rotary+GQA+swiglu+rmsnorm,
+    alibi, sliding window) — same tokens as static generate per arch."""
+    archs = {
+        "llama-style": dict(use_rotary=True, use_rmsnorm=True, use_swiglu=True,
+                            n_kv_head=2),
+        "bloom-style": dict(use_alibi=True, use_emb_ln=True),
+        "mistral-style": dict(use_rotary=True, n_kv_head=2, sliding_window=6),
+    }
+    rng = np.random.default_rng(6)
+    for name, flags in archs.items():
+        cfg = GPTConfig(n_layer=2, n_head=4, d_model=64, max_seq_len=256,
+                        vocab_size=128, dtype=jnp.float32, remat=False, **flags)
+        engine = _mk_engine(cfg=cfg)
+        prompts = _ragged_prompts(rng, (5, 9, 3), vocab=cfg.vocab_size)
+        serving = engine.serving(max_slots=2, max_context=48, prefill_chunk=16)
+        res = serving.run([Request(uid=i, tokens=p, max_new_tokens=4,
+                                   stop_on_eos=False)
+                           for i, p in enumerate(prompts)])
+        for i, p in enumerate(prompts):
+            ref = engine.generate(p[None], max_new_tokens=4, stop_on_eos=False)
+            np.testing.assert_array_equal(res[i].tokens, ref[0]), (name, i)
+
+
+def test_serving_forced_paged_kernel_matches_gather_path():
+    """use_flash_attention=True forces the paged Pallas kernel into the
+    decode step (block 128 for lane alignment); tokens must match the
+    default XLA gather path exactly."""
+    rng = np.random.default_rng(7)
+    prompts = _ragged_prompts(rng, (5, 150, 40))
+    outs = {}
+    for flag in (False, True):
+        cfg = dataclasses.replace(TINY, use_flash_attention=flag)
+        engine = _mk_engine(cfg=cfg, kv_block_size=128)
+        serving = engine.serving(max_slots=3, max_context=256,
+                                 prefill_chunk=128)
+        res = serving.run([Request(uid=i, tokens=p, max_new_tokens=5,
+                                   stop_on_eos=False)
+                           for i, p in enumerate(prompts)])
+        outs[flag] = [res[i].tokens for i in range(len(prompts))]
+    for a, b in zip(outs[True], outs[False]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_serving_under_tensor_parallel_mesh():
+    """The serving engine composes with TP sharding: params sharded over the
+    tensor axis, pool replicated, same tokens as the single-device run."""
+    rng = np.random.default_rng(8)
+    prompts = _ragged_prompts(rng, (5, 9))
+
+    engine1 = _mk_engine()
+    ref = engine1.serving(max_slots=2, max_context=64, prefill_chunk=16).run(
+        [Request(uid=i, tokens=p, max_new_tokens=4, stop_on_eos=False)
+         for i, p in enumerate(prompts)])
+
+    _mk_mesh(tensor=4)
+    from deepspeed_tpu.models.gpt import gpt_param_specs
+    spec = make_gpt_decode_model(cfg=TINY, name="tiny")
+    spec.param_specs = gpt_param_specs(TINY)
+    engine = init_inference(model=spec, config={
+        "dtype": "float32", "kv_cache_dtype": "float32", "greedy": True,
+        "kv_block_size": 16, "max_out_tokens": 64})
+    serving = engine.serving(max_slots=2, max_context=64, prefill_chunk=16)
+    res = serving.run([Request(uid=i, tokens=p, max_new_tokens=4,
+                               stop_on_eos=False)
+                       for i, p in enumerate(prompts)])
+    for i in range(len(prompts)):
+        np.testing.assert_array_equal(res[i].tokens, ref[i].tokens)
+
+
+# ----------------------------------------------------------------------
+# satellite regressions: generate() bucketing + engine-owned cache reuse
+# ----------------------------------------------------------------------
+
+
+def test_generate_max_new_bucketing_single_compile():
+    """max_new_tokens is a static argnum: 5/6/7/8 must share ONE pow2-bucket
+    compile, and the trimmed outputs must be prefixes of each other."""
+    engine = _mk_engine()
+    toks = np.random.default_rng(9).integers(
+        0, TINY.vocab_size, (2, 6)).astype(np.int32)
+    outs = {n: engine.generate(toks, max_new_tokens=n, stop_on_eos=False)
+            for n in (5, 6, 7, 8)}
+    assert engine._generate_jit._cache_size() == 1, \
+        "max_new 5..8 must share the bucket-8 compile"
+    for n in (5, 6, 7, 8):
+        assert outs[n].shape == (2, n)
+        np.testing.assert_array_equal(outs[n], outs[8][:, :n])
+    engine.generate(toks, max_new_tokens=9, stop_on_eos=False)  # next bucket
+    assert engine._generate_jit._cache_size() == 2
+
+
+def test_engine_reuses_kv_cache_across_calls():
+    """Shape-matching forward()/generate() calls reuse the engine-owned
+    cache instead of re-allocating (satellite: stop re-tracing init_cache)."""
+    engine = _mk_engine()
+    toks = np.random.default_rng(10).integers(
+        0, TINY.vocab_size, (2, 8)).astype(np.int32)
+    engine.generate(toks, max_new_tokens=4, stop_on_eos=False)
+    hits0 = engine._cache_hits
+    out2 = engine.generate(toks, max_new_tokens=4, stop_on_eos=False)
+    assert engine._cache_hits == hits0 + 1
+    # reuse must not change results (the template is never mutated)
+    np.testing.assert_array_equal(
+        out2, engine.generate(toks, max_new_tokens=4, stop_on_eos=False))
+    engine.forward(toks)
+    h = engine._cache_hits
+    engine.forward(toks)
+    assert engine._cache_hits == h + 1
