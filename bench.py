@@ -479,6 +479,92 @@ def run_serving_lane(steps=1, warmup=1):
     return result
 
 
+def run_prefix_cache_lane():
+    """PREFIX-CACHE lane (BENCH_SERVING gate): cold-vs-warm aggregate
+    tokens/s on a trace whose requests all share a long common system
+    prompt — the workload automatic prefix caching targets. Two identical
+    waves run through ONE cache-enabled serving engine: wave 1 is cold
+    (the shared prefix prefills once and registers), wave 2 is warm (every
+    request maps the cached blocks and skips those prefill chunks).
+    vs_baseline is warm/cold tokens/s on identical work; the proof of
+    mechanism is `prefill_chunks` per wave — warm must execute strictly
+    fewer — and compile_stats() pinned at one per program across both
+    waves (a hit changes host-side tables only, never a traced shape)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.inference.engine import init_inference
+    from deepspeed_tpu.inference.scheduler import Request
+    from deepspeed_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                          make_gpt_decode_model)
+
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    n_req = int(os.environ.get("BENCH_PREFIX_REQUESTS", "16"))
+    slots = int(os.environ.get("BENCH_PREFIX_SLOTS", "8"))
+    prefix_len = int(os.environ.get("BENCH_PREFIX_LEN", "512"))
+    cfg = GPTConfig(n_layer=8, n_head=8, n_kv_head=4, d_model=1024,
+                    max_seq_len=1024, vocab_size=50304, remat=False,
+                    use_rotary=True)
+    params = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16), init_gpt_params(cfg, seed=0))
+    spec = make_gpt_decode_model(cfg=cfg, params=params)
+    engine = init_inference(model=spec, config={
+        "dtype": "bfloat16", "kv_cache_dtype": "bfloat16", "greedy": True,
+        "kv_block_size": 128, "max_out_tokens": 1024})
+    rng = np.random.default_rng(0)
+    # shared system prompt + short per-request user turns + modest outputs:
+    # the few-shot-template shape where prefill dominates end-to-end cost
+    prefix = rng.integers(0, cfg.vocab_size, (prefix_len,)).astype(np.int32)
+    tails = [rng.integers(0, cfg.vocab_size, (int(t),)).astype(np.int32)
+             for t in rng.integers(8, 64, n_req)]
+    news = [int(n) for n in rng.integers(8, 32, n_req)]
+
+    serving = engine.serving(max_slots=slots, max_context=1024,
+                             prefill_chunk=128, enable_prefix_caching=True)
+
+    def wave(uid_base):
+        reqs = [Request(uid=uid_base + i, tokens=np.concatenate([prefix, t]),
+                        max_new_tokens=n, stop_on_eos=False)
+                for i, (t, n) in enumerate(zip(tails, news))]
+        chunks0, t0 = serving.prefill_chunks, time.perf_counter()
+        res = serving.run(reqs)
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in res.values())
+        return toks / dt, serving.prefill_chunks - chunks0, dt
+
+    # wave 1 COLD: includes the engine's two compiles + the first prefix
+    # prefill. wave 2 WARM: every admission hits the registered prefix
+    # blocks (the cold wave's requests retired, so their blocks sit on the
+    # reclaimable list with their hashes live).
+    cold_tps, cold_chunks, cold_wall = wave(0)
+    warm_tps, warm_chunks, warm_wall = wave(10_000)
+    st = serving.stats()["prefix_cache"]
+
+    result = {
+        "metric": "gpt_serving_prefix_cache_warm_tokens_per_sec",
+        "value": round(warm_tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(warm_tps / cold_tps, 4),
+        "extra": {
+            "cold_tokens_per_sec": round(cold_tps, 1),
+            "cold_wall_s": round(cold_wall, 2),
+            "warm_wall_s": round(warm_wall, 2),
+            "requests_per_wave": n_req, "slots": slots,
+            "shared_prefix_tokens": prefix_len,
+            "prefill_chunks_cold": cold_chunks,
+            "prefill_chunks_warm": warm_chunks,
+            "prefill_chunks_saved": cold_chunks - warm_chunks,
+            "prefix_hit_tokens": st["hit_tokens"],
+            "prefix_evictions": st["evictions"],
+            "compiles": serving.compile_stats(),
+        },
+    }
+    print(json.dumps(result))
+    return result
+
+
 REF_BERT_SAMPLES = {128: 272.0, 512: 52.0}   # V100 samples/s/GPU, fastest-BERT post
 V100_FP16_PEAK = 125.0                        # TFLOPs
 
@@ -556,6 +642,9 @@ def main():
         return
     if env("BENCH_SERVING_CHILD") == "1":  # serving sub-lane child process
         run_serving_lane()
+        return
+    if env("BENCH_PREFIX_CHILD") == "1":  # prefix-cache sub-lane child
+        run_prefix_cache_lane()
         return
     model_name = env("BENCH_MODEL", "gpt2-760m")
     import jax.numpy as jnp
@@ -675,6 +764,18 @@ def main():
         if serving is not None:
             print(json.dumps(serving))
 
+    # prefix-cache lane (same gate as serving): cold-vs-warm tokens/s +
+    # prefill chunks saved on a shared-system-prompt trace
+    prefix_cache = None
+    if env("BENCH_SERVING", "1") == "1" and "BENCH_MODEL" not in os.environ:
+        prefix_cache = sub_lane(
+            "prefix_cache", BENCH_PREFIX_CHILD="1",
+            BENCH_PREFIX_REQUESTS=env("BENCH_PREFIX_REQUESTS", "16"),
+            BENCH_PREFIX_SLOTS=env("BENCH_PREFIX_SLOTS", "8"),
+            BENCH_PREFIX_LEN=env("BENCH_PREFIX_LEN", "512"))
+        if prefix_cache is not None:
+            print(json.dumps(prefix_cache))
+
     # BERT lane (reference's second headline; VERDICT r4 item 5): raw
     # samples/s + MFU on both conventions, both reference shapes
     bert = None
@@ -733,6 +834,15 @@ def main():
             "metric": serving["metric"], "value": serving["value"],
             "vs_baseline": serving["vs_baseline"],
             "static_tokens_per_sec": serving["extra"]["static_tokens_per_sec"],
+        }
+    if prefix_cache is not None:
+        headline["extra"]["prefix_cache"] = {
+            "metric": prefix_cache["metric"], "value": prefix_cache["value"],
+            "vs_baseline": prefix_cache["vs_baseline"],
+            "cold_tokens_per_sec":
+                prefix_cache["extra"]["cold_tokens_per_sec"],
+            "prefill_chunks_saved":
+                prefix_cache["extra"]["prefill_chunks_saved"],
         }
     if bert is not None:
         headline["extra"]["bert"] = bert["extra"]

@@ -2,3 +2,5 @@ from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
 from deepspeed_tpu.inference.config import TpuInferenceConfig, ServingConfig
 from deepspeed_tpu.inference.scheduler import (CompletedRequest, Request,
                                                ServingEngine)
+from deepspeed_tpu.inference.kv_cache import BlockAllocator
+from deepspeed_tpu.inference.prefix_cache import PrefixCache

@@ -54,6 +54,20 @@ class ServingConfig(ConfigModel):
                                   # the cost of K-step retirement/admission
                                   # granularity (a sequence finishing
                                   # mid-window wastes the window's tail)
+    enable_prefix_caching: bool = False  # automatic prefix caching
+                                  # (inference/prefix_cache.py): full prompt
+                                  # blocks are content-hashed and reused
+                                  # across requests — a shared system prompt
+                                  # prefills once. Token-identical greedy
+                                  # output, zero new compiles; costs only
+                                  # host-side hashing at submit
+    prefix_cache_policy: str = "lru"  # what happens to a cached block when
+                                  # its last reader retires: "lru" parks it
+                                  # on the reclaimable list (evicted oldest-
+                                  # first only when an alloc would fail —
+                                  # caching never reduces usable capacity);
+                                  # "none" frees + unregisters immediately
+                                  # (only concurrently-active sharing)
 
 
 @dataclass

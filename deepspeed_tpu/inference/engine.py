@@ -30,15 +30,34 @@ from deepspeed_tpu.utils.logging import logger, log_dist
 from deepspeed_tpu.utils.tree import tree_cast
 
 
-def sample_logits(logits, rng, greedy=True, temperature=1.0, top_k=0):
-    """One sampling rule for every inference engine (resident + spill):
-    greedy argmax, or temperature/top-k categorical."""
+def sample_logits(logits, rng, greedy=True, temperature=1.0, top_k=0,
+                  top_p=1.0):
+    """One sampling rule for every inference engine (resident + spill +
+    serving): greedy argmax, or temperature/top-k/top-p categorical.
+    Filters compose in the standard order: temperature, then top-k, then
+    nucleus (top-p) on the surviving distribution."""
     if greedy or rng is None:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / jnp.maximum(temperature, 1e-6)
     if top_k and top_k > 0:
         kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        # nucleus sampling (Holtzman et al.): keep the smallest head of the
+        # sorted distribution whose cumulative probability reaches top_p.
+        # The exclusive cumsum (cum - probs) keeps the argmax even when its
+        # own probability already exceeds top_p; ties at the cutoff logit
+        # are all kept (harmless: they carry equal probability).
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        keep = jnp.cumsum(probs, axis=-1) - probs < top_p
+        # top-1 survives unconditionally, including top_p <= 0 (a common
+        # spelling of "argmax"): an all-False keep would mask EVERY token
+        # and categorical over all -inf degenerates to token id 0
+        keep = keep.at[..., 0].set(True)
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -65,6 +84,13 @@ class DecodeModelSpec:
     prefill_paged_fn: Optional[Callable] = None
     decode_paged_fn: Optional[Callable] = None
     init_paged_pool: Optional[Callable] = None
+    # cache-identity fingerprint for the prefix cache's hash chain
+    # (inference/prefix_cache.py): every arch field that changes the KV
+    # VALUES written for a given token stream must be folded in, so two
+    # specs can never serve each other's cached blocks. None falls back to
+    # `name` (weights are engine-local, so the fingerprint guards config
+    # divergence, not parameters).
+    cache_fingerprint: Optional[str] = None
 
 
 class InferenceEngine:
@@ -165,10 +191,12 @@ class InferenceEngine:
         greedy = self.config.greedy
         temperature = self.config.temperature
         top_k = self.config.top_k
+        top_p = self.config.top_p
 
         def sample(logits, rng):
             return sample_logits(logits, rng, greedy=greedy,
-                                 temperature=temperature, top_k=top_k)
+                                 temperature=temperature, top_k=top_k,
+                                 top_p=top_p)
 
         def generate(params, tokens, cache, prompt_len, max_new, rng, eos_id, pad_id):
             B, T = tokens.shape

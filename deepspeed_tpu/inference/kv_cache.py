@@ -23,8 +23,18 @@ the scheduler never looks at. This is what keeps the decode program's shape
 The allocator is deliberately host-side and stdlib-only: block alloc/free
 happens at request admission/retirement (a few times per second), not in the
 per-token hot loop, which stays a single fixed-shape jitted call.
+
+Prefix caching (`inference/prefix_cache.py`) layers on the allocator's
+REFERENCE COUNTS: a physical block shared by several sequences (same prompt
+prefix) is freed only when its last reader retires, and a refcount-0 block
+whose content is still registered in the prefix cache parks on a
+"reclaimable" LRU list instead of the free list — its KV stays resurrectable
+for future hits, but `alloc()` treats it as available and evicts it (via the
+`on_evict` hook, which unregisters the hash) the moment a fresh allocation
+would otherwise fail. Caching therefore never reduces usable capacity.
 """
 
+import collections
 from typing import List, Optional
 
 import jax.numpy as jnp
@@ -33,17 +43,39 @@ TRASH_BLOCK = 0  # physical block 0: write sink for inactive slots
 
 
 class BlockAllocator:
-    """Free-list over the physical blocks of a paged KV pool.
+    """Ref-counted free-list over the physical blocks of a paged KV pool.
 
     Block 0 (TRASH_BLOCK) is never handed out. alloc() is all-or-nothing:
     a request either gets every block it needs or stays queued — partial
     allocation would deadlock two half-admitted requests against each other.
+
+    Every allocated block carries a refcount (1 at alloc). `incref` adds a
+    reader (a prefix-cache hit mapping the block into another slot's table);
+    `free` is a DECREF — the block returns to circulation only at zero. A
+    zero-refcount block that `is_cached` claims (its content hash is still
+    registered) moves to the reclaimable LRU instead of the free list; it is
+    recycled lazily, oldest first, only when alloc() finds the free list
+    short, calling `on_evict(block)` so the cache unregisters the hash
+    before the block's KV can be overwritten.
+
+    The free list is a list (deterministic pop order: low ids first) + a
+    shadow set, so the double-free guard is O(1) per freed block instead of
+    an O(n) list scan.
     """
 
-    def __init__(self, num_blocks: int):
+    def __init__(self, num_blocks: int, policy: str = "lru"):
         assert num_blocks >= 2, "pool needs >= 1 usable block past the trash block"
+        assert policy in ("lru", "none"), \
+            f"unknown reclaim policy {policy!r} (expected 'lru' or 'none')"
         self.num_blocks = num_blocks
+        self.policy = policy
         self._free = list(range(num_blocks - 1, 0, -1))  # pop() yields low ids first
+        self._free_set = set(self._free)
+        self._refs = {}                     # block -> refcount (0 = reclaimable)
+        self._reclaimable = collections.OrderedDict()  # LRU: oldest first
+        self.is_cached = None               # hook: block -> bool (prefix cache)
+        self.on_evict = None                # hook: block evicted -> unregister
+        self.evictions = 0
 
     @property
     def capacity(self) -> int:
@@ -54,18 +86,86 @@ class BlockAllocator:
     def num_free(self) -> int:
         return len(self._free)
 
+    @property
+    def num_reclaimable(self) -> int:
+        return len(self._reclaimable)
+
+    @property
+    def available(self) -> int:
+        """Blocks an alloc() can actually obtain: free + reclaimable. This,
+        not num_free, is the admission-backpressure quantity — cached
+        refcount-0 blocks are usable capacity, merely lazily recycled."""
+        return len(self._free) + len(self._reclaimable)
+
+    def refcount(self, b: int) -> int:
+        return self._refs.get(b, 0)
+
+    def _push_free(self, b: int):
+        self._free.append(b)
+        self._free_set.add(b)
+
+    def _evict_one(self):
+        """Recycle the least-recently-parked reclaimable block: unregister
+        its cached content (on_evict) and hand it to the free list."""
+        b, _ = self._reclaimable.popitem(last=False)
+        del self._refs[b]
+        self.evictions += 1
+        if self.on_evict is not None:
+            self.on_evict(b)
+        self._push_free(b)
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """Pop n blocks, or None (and no state change) if fewer are free."""
-        if n > len(self._free):
+        """Pop n blocks, or None (and no state change) if fewer are
+        available. Reclaimable cached blocks are evicted LRU-first, but only
+        as many as the free list is short — eviction never runs ahead of
+        demand."""
+        if n > self.available:
             return None
-        got = [self._free.pop() for _ in range(n)]
+        while len(self._free) < n:
+            self._evict_one()
+        got = []
+        for _ in range(n):
+            b = self._free.pop()
+            self._free_set.discard(b)
+            self._refs[b] = 1
+            got.append(b)
         return got
 
+    def incref(self, b: int) -> int:
+        """Add a reader to an allocated or reclaimable block (prefix-cache
+        hit). A reclaimable block is resurrected: it leaves the LRU and its
+        KV content becomes live again without a copy."""
+        assert b != TRASH_BLOCK, "incref of the trash block"
+        assert b in self._refs and b not in self._free_set, \
+            f"incref of unallocated block {b}"
+        self._refs[b] += 1
+        if b in self._reclaimable:
+            del self._reclaimable[b]
+        return self._refs[b]
+
     def free(self, blocks: List[int]):
+        """Decref each block. At zero: cached blocks (per `is_cached`) park
+        on the reclaimable LRU (policy 'lru'); everything else — and all
+        blocks under policy 'none' — returns to the free list, cached
+        content unregistered on the spot."""
         for b in blocks:
             assert b != TRASH_BLOCK, "freeing the trash block"
-            assert b not in self._free, f"double free of block {b}"
-            self._free.append(b)
+            assert b not in self._free_set, f"double free of block {b}"
+            assert self._refs.get(b, 0) > 0, f"free of unallocated block {b}"
+            self._refs[b] -= 1
+            if self._refs[b] > 0:
+                continue
+            cached = self.is_cached is not None and self.is_cached(b)
+            if cached and self.policy == "lru":
+                self._reclaimable[b] = None     # most-recently-parked end
+            else:
+                # policy "none" unregisters on the spot but does NOT count
+                # as an eviction: `evictions` means demand-driven reclaim
+                # (pool pressure), not routine retirement
+                if cached and self.on_evict is not None:
+                    self.on_evict(b)
+                del self._refs[b]
+                self._push_free(b)
 
 
 def max_written_pos(prompt_len: int, padded_prompt: int, max_new: int,

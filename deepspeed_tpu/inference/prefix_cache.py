@@ -1,0 +1,119 @@
+"""Automatic prefix caching: content-addressed KV-block reuse across requests.
+
+Real serving traffic is dominated by shared prefixes — system prompts,
+few-shot templates, multi-turn histories — yet the continuous-batching
+engine (PR 3) prefills every prompt from scratch. vLLM's automatic prefix
+caching (Kwon et al. 2023) and SGLang's RadixAttention (Zheng et al. 2023)
+showed that FULL KV blocks are reusable verbatim across requests at zero
+accuracy cost: a block's KV content is a pure function of (the tokens in and
+before it, the model). The paged pool is exactly the substrate this needs —
+sharing a prefix is just mapping the same physical blocks into several
+slots' block tables.
+
+Design, layered over `inference/kv_cache.BlockAllocator`:
+
+  * every FULL prompt block gets a CHAINED content hash —
+    ``h_i = sha256(h_{i-1} || tokens[i*bs:(i+1)*bs])`` seeded with the
+    model's cache-identity fingerprint (`DecodeModelSpec.cache_fingerprint`)
+    — so a hash names the whole prefix through that block, not the block's
+    tokens alone;
+  * a hash -> physical-block map serves longest-prefix match at admission:
+    the scheduler maps the hit blocks straight into the new slot's table,
+    bumps their refcounts, and starts the chunked-prefill cursor at the
+    cached boundary;
+  * a block is registered only once its content is FULLY WRITTEN (the
+    prefill cursor passed it) and only if it lies strictly below
+    ``prompt_len`` — the padded tail and every decode-written block stay
+    private, so shared blocks are immutable by construction;
+  * refcount-0 registered blocks park on the allocator's reclaimable LRU;
+    eviction (hash unregistration, via the allocator's `on_evict` hook)
+    happens only when a fresh allocation would otherwise fail, so caching
+    never reduces usable pool capacity.
+
+Nothing here touches the compiled step programs: a hit changes only host-
+side table contents and the prefill start cursor — same shapes, zero new
+compiles (`ServingEngine.compile_stats()` stays at one per program).
+"""
+
+import hashlib
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.inference.kv_cache import BlockAllocator
+
+
+class PrefixCache:
+    """Hash-chain -> physical-block map over a `BlockAllocator`.
+
+    The cache owns no blocks and moves no data: the allocator's refcounts
+    and reclaimable list carry the lifetime story, and this class installs
+    itself as the allocator's `is_cached` / `on_evict` hooks so eviction
+    and hash unregistration can never drift apart.
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int,
+                 fingerprint: Optional[str] = None):
+        self.allocator = allocator
+        self.block_size = int(block_size)
+        # the chain root commits every hash to this model's cache identity:
+        # two archs (or two checkpoints someone names differently) can never
+        # serve each other's KV even if their token streams collide
+        self._root = hashlib.sha256(
+            b"dstpu-prefix-cache:" + (fingerprint or "").encode()).digest()
+        self._by_hash: Dict[bytes, int] = {}
+        self._by_block: Dict[int, bytes] = {}
+        allocator.is_cached = self._by_block.__contains__
+        allocator.on_evict = self._unregister_block
+
+    # ------------------------------------------------------------------
+    # hashing + lookup
+    # ------------------------------------------------------------------
+
+    def hash_chain(self, prompt: Sequence[int]) -> List[bytes]:
+        """Chained hashes of the prompt's full blocks (one per block the
+        prompt completely fills). Computed once per request at submit."""
+        arr = np.ascontiguousarray(np.asarray(prompt, np.int32).reshape(-1))
+        bs = self.block_size
+        out, h = [], self._root
+        for i in range(len(arr) // bs):
+            h = hashlib.sha256(h + arr[i * bs:(i + 1) * bs].tobytes()).digest()
+            out.append(h)
+        return out
+
+    def match(self, hashes: Sequence[bytes]) -> List[int]:
+        """Longest-prefix match: physical blocks for the leading run of
+        registered hashes. Pure lookup — the caller increfs winners (and
+        only then is the hit protected from eviction)."""
+        blocks = []
+        for h in hashes:
+            b = self._by_hash.get(h)
+            if b is None:
+                break
+            blocks.append(b)
+        return blocks
+
+    # ------------------------------------------------------------------
+    # registration / eviction
+    # ------------------------------------------------------------------
+
+    def register(self, h: bytes, block: int) -> bool:
+        """Announce that `block` now holds the fully written KV content
+        named by `h`. First writer wins: if another block already carries
+        this hash (two requests with the same prefix admitted before either
+        registered), the newcomer stays uncached and frees normally."""
+        if h in self._by_hash or block in self._by_block:
+            return False
+        self._by_hash[h] = block
+        self._by_block[block] = h
+        return True
+
+    def _unregister_block(self, block: int):
+        h = self._by_block.pop(block, None)
+        if h is not None:
+            self._by_hash.pop(h, None)
+
+    @property
+    def num_cached(self) -> int:
+        """Registered blocks (live shared + reclaimable)."""
+        return len(self._by_block)

@@ -25,6 +25,14 @@ together.
 Compile accounting is first-class: `compile_stats()` reads the jit caches,
 and the serving tests assert <= 1 compile per bucket across a mixed-length
 request trace.
+
+Automatic prefix caching (`serving.enable_prefix_caching`,
+`inference/prefix_cache.py`) rides the same machinery: at admission the
+prompt's hash chain is matched against previously written full blocks, hit
+blocks are mapped into the new slot's table with a refcount bump, and the
+chunked-prefill cursor starts at the cached boundary — a shared system
+prompt prefills once per engine, not once per request. Only host-side state
+changes; the two compiled programs and their shapes are untouched.
 """
 
 import collections
@@ -57,6 +65,8 @@ class CompletedRequest:
     prompt_len: int
     tokens: np.ndarray        # generated tokens; the EOS (if emitted) is kept
     finish_reason: str        # "eos" | "length"
+    cached_prefix_tokens: int = 0  # prompt tokens whose KV came from the
+                              # prefix cache (0 when caching is off/missed)
 
 
 _FREE, _PREFILL, _DECODE = 0, 1, 2
@@ -64,7 +74,8 @@ _FREE, _PREFILL, _DECODE = 0, 1, 2
 
 class _Slot:
     __slots__ = ("idx", "state", "uid", "prompt", "prompt_len", "padded_len",
-                 "max_new", "eos", "blocks", "cursor", "pos", "emitted")
+                 "max_new", "eos", "blocks", "cursor", "pos", "emitted",
+                 "hashes", "reg", "cached")
 
     def __init__(self, idx):
         self.idx = idx
@@ -78,6 +89,9 @@ class _Slot:
         self.blocks = []
         self.cursor = self.pos = 0
         self.emitted = []
+        self.hashes = None      # prefix-cache hash chain (full prompt blocks)
+        self.reg = 0            # blocks [0, reg) already registered/cached
+        self.cached = 0         # blocks mapped from the cache at admission
 
 
 class ServingEngine:
@@ -134,7 +148,14 @@ class ServingEngine:
             spec.init_paged_pool(num_blocks, bs,
                                  jnp.dtype(engine.config.kv_cache_dtype)),
             NamedSharding(engine.mesh, PartitionSpec()))
-        self.allocator = BlockAllocator(num_blocks)
+        self.allocator = BlockAllocator(
+            num_blocks, policy=str(scfg.prefix_cache_policy or "lru"))
+        self.prefix_cache = None
+        if scfg.enable_prefix_caching:
+            from deepspeed_tpu.inference.prefix_cache import PrefixCache
+            self.prefix_cache = PrefixCache(
+                self.allocator, bs,
+                fingerprint=spec.cache_fingerprint or spec.name)
         self.tables = np.full((self.max_slots, self.nb), TRASH_BLOCK, np.int32)
         self.slots = [_Slot(i) for i in range(self.max_slots)]
         self.queue = collections.deque()
@@ -146,6 +167,9 @@ class ServingEngine:
         self.steps = 0
         self.decode_steps = 0
         self.prefill_chunks = 0
+        self.prefill_chunks_skipped = 0     # chunks the prefix cache elided
+        self.prefix_hit_blocks = 0
+        self.prefix_hit_tokens = 0
         self.tokens_generated = 0
         self.peak_active = 0
 
@@ -170,7 +194,8 @@ class ServingEngine:
 
         def sample(logits, rng):
             return sample_logits(logits, rng, greedy=cfg.greedy,
-                                 temperature=cfg.temperature, top_k=cfg.top_k)
+                                 temperature=cfg.temperature, top_k=cfg.top_k,
+                                 top_p=cfg.top_p)
 
         window = self.window
 
@@ -243,7 +268,11 @@ class ServingEngine:
             raise ValueError(
                 f"request {request.uid}: needs {need} KV blocks, pool has "
                 f"{self.allocator.capacity} (raise serving.num_kv_blocks)")
-        self.queue.append((request, prompt, prompt_len, padded, need))
+        # hash once at submit; the admission loop re-matches the chain every
+        # step while backpressured (cache contents change between steps)
+        hashes = (self.prefix_cache.hash_chain(prompt)
+                  if self.prefix_cache is not None else None)
+        self.queue.append((request, prompt, prompt_len, padded, need, hashes))
 
     def _resolve_eos(self, req: Request):
         if not req.stop_on_eos:
@@ -258,13 +287,41 @@ class ServingEngine:
     def _admit(self):
         free = [s for s in self.slots if s.state == _FREE]
         while self.queue and free:
-            req, prompt, prompt_len, padded, need = self.queue[0]
-            blocks = self.allocator.alloc(need)
+            req, prompt, prompt_len, padded, need, hashes = self.queue[0]
+            hit = []
+            if hashes:
+                # longest-prefix match, capped so at least the final prompt
+                # token is always prefilled — its logits seed the first
+                # sampled token, so a 100%-cached prompt still runs one
+                # chunk. The hit is then truncated to whole-CHUNK coverage:
+                # prefill chunks start on the absolute j*chunk grid, so a
+                # partial-chunk hit saves nothing (its chunk re-runs in
+                # full) and would overstate every hit counter — and
+                # dropping it means no chunk ever overlaps a shared block,
+                # so registered blocks are never written again, period.
+                # incref BEFORE alloc: the hit blocks may be sitting
+                # refcount-0 on the reclaimable list, and our own alloc's
+                # eviction must not recycle them out from under the match.
+                limit = (prompt_len - 1) // self.block_size
+                hit = self.prefix_cache.match(hashes[:limit])
+                m = len(hit)
+                while m and (m * self.block_size) % self.chunk:
+                    m -= 1
+                hit = hit[:m]
+                for b in hit:
+                    self.allocator.incref(b)
+            blocks = self.allocator.alloc(need - len(hit))
             if blocks is None:
                 # pool exhausted: FIFO backpressure — the head waits for
                 # retirements to free blocks (no reordering: a stream of
-                # small requests must not starve a big one)
+                # small requests must not starve a big one). Decref the
+                # tentative hit tail-first, like _retire: the chain head
+                # must park most-recent so demand eviction trims tails
+                # before it strands a whole chain
+                if hit:
+                    self.allocator.free(hit[::-1])
                 break
+            blocks = hit + blocks
             self.queue.popleft()
             slot = free.pop()
             slot.state = _PREFILL
@@ -275,20 +332,39 @@ class ServingEngine:
             slot.max_new = int(req.max_new_tokens)
             slot.eos = self._resolve_eos(req)
             slot.blocks = blocks
-            slot.cursor = 0
+            # prefill resumes at the cached boundary — exactly on the chunk
+            # grid, because the hit was truncated to whole-chunk coverage
+            # above. With the default prefill_chunk == kv_block_size every
+            # hit block skips a whole chunk.
+            slot.cursor = len(hit) * self.block_size
+            slot.hashes = hashes
+            slot.reg = len(hit)
+            slot.cached = len(hit)
             slot.pos = prompt_len
             slot.emitted = []
             self.tables[slot.idx, :] = TRASH_BLOCK
             self.tables[slot.idx, :len(blocks)] = blocks
+            if hit:
+                self.prefix_hit_blocks += len(hit)
+                self.prefix_hit_tokens += len(hit) * self.block_size
+                self.prefill_chunks_skipped += slot.cursor // self.chunk
 
     def _retire(self, slot: _Slot, reason: str) -> CompletedRequest:
-        # blocks return to the pool the step the sequence finishes — the
-        # next _admit (same step or next) can hand them to a queued request
-        self.allocator.free(slot.blocks)
+        # blocks return to the pool the step the sequence finishes — a
+        # DECREF: blocks shared through the prefix cache stay live until
+        # their last reader retires, and registered refcount-0 blocks park
+        # on the reclaimable list instead of the free list. Freed in
+        # REVERSE block order so the hash-chain TAIL parks LRU-oldest:
+        # demand eviction then trims chains tail-first, and the surviving
+        # prefix stays matchable (match walks head-first and stops at the
+        # first unregistered hash — evicting a head strands its whole tail)
+        self.allocator.free(slot.blocks[::-1])
         self.tables[slot.idx, :] = TRASH_BLOCK
         done = CompletedRequest(uid=slot.uid, prompt_len=slot.prompt_len,
                                 tokens=np.asarray(slot.emitted, np.int32),
-                                finish_reason=reason)
+                                finish_reason=reason,
+                                cached_prefix_tokens=slot.cached
+                                * self.block_size)
         slot.reset()
         return done
 
@@ -332,6 +408,19 @@ class ServingEngine:
                 slot.cursor = start + self.chunk
                 budget -= 1
                 self.prefill_chunks += 1
+                if self.prefix_cache is not None and slot.hashes:
+                    # register blocks the cursor just finished writing —
+                    # full blocks strictly below prompt_len only (the
+                    # padded tail and decode-written blocks stay private,
+                    # so shared blocks are immutable by construction). A
+                    # block becomes matchable only here, AFTER its content
+                    # exists in the pool: registering at admission would
+                    # let a same-step sibling map garbage.
+                    hi = min(slot.cursor, slot.prompt_len) // self.block_size
+                    for i in range(slot.reg, hi):
+                        self.prefix_cache.register(slot.hashes[i],
+                                                   slot.blocks[i])
+                    slot.reg = max(slot.reg, hi)
                 if final:
                     slot.state = _DECODE
                     self._emit(slot, int(np.asarray(tok)[0]), finished)
@@ -397,10 +486,32 @@ class ServingEngine:
                 "prefill_step": int(self._prefill_step._cache_size())}
 
     def stats(self) -> Dict[str, Any]:
-        return {"steps": self.steps, "decode_steps": self.decode_steps,
-                "prefill_chunks": self.prefill_chunks,
-                "tokens_generated": self.tokens_generated,
-                "peak_active": self.peak_active,
-                "queued": len(self.queue), "active": self.num_active,
-                "free_blocks": self.allocator.num_free,
-                "compiles": self.compile_stats()}
+        out = {"steps": self.steps, "decode_steps": self.decode_steps,
+               "prefill_chunks": self.prefill_chunks,
+               "tokens_generated": self.tokens_generated,
+               "peak_active": self.peak_active,
+               "queued": len(self.queue), "active": self.num_active,
+               "free_blocks": self.allocator.num_free,
+               "reclaimable_blocks": self.allocator.num_reclaimable,
+               "available_blocks": self.allocator.available,
+               "compiles": self.compile_stats()}
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = {
+                "hit_blocks": self.prefix_hit_blocks,
+                "hit_tokens": self.prefix_hit_tokens,
+                "prefill_chunks_skipped": self.prefill_chunks_skipped,
+                "cached_blocks": self.prefix_cache.num_cached,
+                "evictions": self.allocator.evictions}
+        return out
+
+    def write_monitor_events(self, monitor):
+        """Serving cache/pool observability through the experiment monitor
+        (same guarded best-effort contract as the PR 2 recovery events):
+        Serving/prefix_hit_tokens, Serving/prefix_evictions,
+        Serving/pool_free_blocks, stepped by the scheduler iteration."""
+        from deepspeed_tpu.monitor.monitor import write_serving_events
+        write_serving_events(monitor, [
+            ("Serving/prefix_hit_tokens", self.prefix_hit_tokens, self.steps),
+            ("Serving/prefix_evictions", self.allocator.evictions, self.steps),
+            ("Serving/pool_free_blocks", self.allocator.available, self.steps),
+        ])

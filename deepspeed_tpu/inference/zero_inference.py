@@ -154,7 +154,8 @@ class ZeroInferenceEngine:
         from deepspeed_tpu.inference.engine import sample_logits
         return sample_logits(logits, rng, greedy=self.config.greedy,
                              temperature=self.config.temperature,
-                             top_k=self.config.top_k)
+                             top_k=self.config.top_k,
+                             top_p=self.config.top_p)
 
     def generate(self, tokens, max_new_tokens=16, eos_token_id=None,
                  pad_token_id=0, rng=None):

@@ -929,6 +929,26 @@ def _block_decode(x, p, cache_k, cache_v, pos, cfg: GPTConfig, local_flag=None):
     return x, cache_k, cache_v
 
 
+def gpt_cache_identity(cfg: GPTConfig, name: str = "") -> str:
+    """Cache-identity fingerprint for the prefix cache's hash chain
+    (`DecodeModelSpec.cache_fingerprint`): every arch field that changes the
+    KV VALUES a prompt writes into the paged pool — layer/head geometry,
+    position encoding (learned wpe vs rotary incl. theta/pct, alibi),
+    normalization, embedding LayerNorm — plus the spec name. Two configs
+    differing in any of these can never serve each other's cached blocks
+    even on identical token streams. Weights are engine-local (the cache
+    lives inside one ServingEngine), so parameters are deliberately not
+    hashed."""
+    fields = (name, cfg.vocab_size, cfg.n_layer, cfg.n_head, cfg.n_kv_head,
+              cfg.d_model, cfg.d_ff, cfg.max_seq_len, cfg.use_rotary,
+              cfg.rotary_pct, cfg.rope_theta, cfg.use_alibi, cfg.use_emb_ln,
+              cfg.use_rmsnorm, cfg.norm_eps, cfg.sliding_window,
+              cfg.attn_layer_types, cfg.scale_attn, cfg.parallel_residual,
+              cfg.use_swiglu, cfg.activation, jnp.dtype(cfg.dtype).name,
+              jnp.dtype(cfg.softmax_dtype).name)
+    return "gpt:" + "|".join(map(str, fields))
+
+
 def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, seed=0):
     """DecodeModelSpec for the inference engine (prefill + per-token decode)."""
     from deepspeed_tpu.inference.engine import DecodeModelSpec
@@ -1030,7 +1050,8 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
                            init_cache=init_cache, params=params, name=name,
                            prefill_paged_fn=prefill_paged_fn,
                            decode_paged_fn=decode_paged_fn,
-                           init_paged_pool=init_paged_pool)
+                           init_paged_pool=init_paged_pool,
+                           cache_fingerprint=gpt_cache_identity(cfg, name))
 
 
 # ----------------------------------------------------------------------

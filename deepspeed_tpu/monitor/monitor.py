@@ -109,6 +109,15 @@ def write_recovery_events(monitor, event_list):
         logger.warning(f"recovery event emission failed: {e}")
 
 
+def write_serving_events(monitor, event_list):
+    """Serving-engine observability (Serving/prefix_hit_tokens,
+    Serving/prefix_evictions, Serving/pool_free_blocks — emitted by
+    `ServingEngine.write_monitor_events`) with the same never-die contract
+    as the recovery events above: a serving loop must not crash on a
+    monitoring failure."""
+    write_recovery_events(monitor, event_list)
+
+
 class MonitorMaster(Monitor):
     """Fans events out to every enabled monitor (reference same name)."""
 

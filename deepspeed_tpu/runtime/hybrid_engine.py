@@ -40,7 +40,7 @@ class HybridEngine(Engine):
         self._decode_spec = decode_spec
         self._generate_fn = None
 
-    def _build_generate(self, max_new, greedy, temperature, top_k):
+    def _build_generate(self, max_new, greedy, temperature, top_k, top_p):
         spec = self._decode_spec
         assert spec is not None, "HybridEngine needs a DecodeModelSpec (set_decode_spec)"
         # one sampling rule across the framework: the inference engines'
@@ -51,7 +51,8 @@ class HybridEngine(Engine):
 
         def sample(logits, rng):
             return sample_logits(logits, None if greedy else rng, greedy=greedy,
-                                 temperature=temperature, top_k=top_k)
+                                 temperature=temperature, top_k=top_k,
+                                 top_p=top_p)
 
         def generate(params, tokens, cache, prompt_len, rng):
             logits, cache = spec.prefill_fn(params, tokens, cache, None)
@@ -73,12 +74,13 @@ class HybridEngine(Engine):
         return jax.jit(generate)
 
     def generate(self, tokens, max_new_tokens=32, greedy=True, temperature=1.0,
-                 top_k=0, rng=None):
+                 top_k=0, top_p=1.0, rng=None):
         """Rollout with the CURRENT training params (reference `generate` :174)."""
-        key = (max_new_tokens, greedy, float(temperature), int(top_k))
+        key = (max_new_tokens, greedy, float(temperature), int(top_k),
+               float(top_p))
         if self._generate_fn is None or getattr(self, "_gen_key", None) != key:
             self._generate_fn = self._build_generate(max_new_tokens, greedy,
-                                                     temperature, top_k)
+                                                     temperature, top_k, top_p)
             self._gen_key = key
         tokens = jnp.asarray(tokens)
         B, T = tokens.shape

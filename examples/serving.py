@@ -1,6 +1,7 @@
 """Continuous-batching serving demo: a ragged stream of requests through the
-paged KV-cache pool + scheduler (docs/inference.md "Continuous-batching
-serving").
+paged KV-cache pool + scheduler, then a shared-system-prompt workload with
+automatic prefix caching (docs/inference.md "Continuous-batching serving" /
+"Automatic prefix caching").
 
 Run on any backend (CPU works):
     python examples/serving.py
@@ -24,15 +25,18 @@ from deepspeed_tpu.inference.scheduler import Request
 from deepspeed_tpu.models.gpt import GPT2_CONFIGS, make_gpt_decode_model
 
 
-def main():
-    engine = deepspeed_tpu.init_inference(
+def make_engine():
+    return deepspeed_tpu.init_inference(
         model=make_gpt_decode_model(name="gpt2-tiny"),
         config={"dtype": "bfloat16", "kv_cache_dtype": "bfloat16",
                 "greedy": True, "kv_block_size": 64, "max_out_tokens": 256,
                 "serving": {"max_slots": 4, "prefill_chunk": 64,
                             "decode_steps_per_sync": 4}})
-    serving = engine.serving()
 
+
+def ragged_demo(engine):
+    """Mixed prompt/output lengths through the continuous-batching core."""
+    serving = engine.serving()
     vocab = GPT2_CONFIGS["gpt2-tiny"].vocab_size
     rng = np.random.default_rng(0)
     for i, (plen, nnew) in enumerate([(17, 24), (90, 8), (5, 40), (33, 16),
@@ -47,6 +51,44 @@ def main():
                   f"{len(done.tokens)} generated ({done.finish_reason}); "
                   f"free blocks now {serving.allocator.num_free}")
     print("scheduler:", serving.stats())
+
+
+def prefix_caching_demo(engine):
+    """A chat-style workload: every request begins with the same 128-token
+    system prompt. With enable_prefix_caching the prompt prefills ONCE —
+    every later request maps the cached KV blocks and skips those chunks."""
+    serving = engine.serving(enable_prefix_caching=True)
+    vocab = GPT2_CONFIGS["gpt2-tiny"].vocab_size
+    rng = np.random.default_rng(1)
+    system_prompt = rng.integers(0, vocab, 128)           # 2 full 64-blocks
+    for i in range(8):
+        user_turn = rng.integers(0, vocab, int(rng.integers(5, 40)))
+        serving.submit(Request(uid=f"chat{i}",
+                               tokens=np.concatenate([system_prompt,
+                                                      user_turn]),
+                               max_new_tokens=16))
+
+    prompt_tokens = cached_tokens = 0
+    while serving.queue or serving.num_active:
+        for done in serving.step():
+            prompt_tokens += done.prompt_len
+            cached_tokens += done.cached_prefix_tokens
+            print(f"{done.uid}: prompt {done.prompt_len} tokens, "
+                  f"{done.cached_prefix_tokens} served from the prefix cache")
+    st = serving.stats()["prefix_cache"]
+    print(f"prefix cache: {cached_tokens}/{prompt_tokens} prompt tokens "
+          f"({100 * cached_tokens / prompt_tokens:.0f}%) from cache, "
+          f"{st['prefill_chunks_skipped']} prefill chunks skipped, "
+          f"{st['evictions']} evictions, "
+          f"{st['cached_blocks']} blocks registered")
+    print("compiles (still one per program):", serving.compile_stats())
+
+
+def main():
+    engine = make_engine()
+    ragged_demo(engine)
+    print()
+    prefix_caching_demo(engine)
 
 
 if __name__ == "__main__":

@@ -50,6 +50,12 @@ def pytest_configure(config):
                    "KV-cache pool suite (tests/test_serving.py) — fast and "
                    "CPU-harness-safe, rides in tier-1; run it alone with "
                    "pytest -m serving)")
+    config.addinivalue_line(
+        "markers", "prefix_cache: automatic prefix caching suite "
+                   "(tests/test_prefix_cache.py — ref-counted KV block "
+                   "reuse across serving requests) — fast and "
+                   "CPU-harness-safe, rides in tier-1; run it alone with "
+                   "pytest -m prefix_cache)")
 
 
 # The slow tier, by measured duration (r5 full-suite run with --durations,

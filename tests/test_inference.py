@@ -340,3 +340,79 @@ def test_decode_cache_dtype_narrower_than_compute():
     out = np.asarray(engine.generate(toks, max_new_tokens=4))
     assert out.shape == (2, 4)
     assert np.isfinite(out).all()
+
+
+def test_top_p_nucleus_sampling_distribution():
+    """Satellite regression: `top_p` existed in the config but sample_logits
+    never applied it. With a known distribution, nucleus sampling must (a)
+    never emit a token outside the smallest head whose cumulative
+    probability reaches top_p, (b) still reach every token inside it, and
+    (c) leave the distribution untouched at top_p=1.0."""
+    from deepspeed_tpu.inference.engine import sample_logits
+
+    # probs ~ [0.50, 0.30, 0.15, 0.05, ...]: top_p=0.6 keeps exactly {0, 1}
+    # (exclusive cumsum 0.0 / 0.5 / 0.8 / 0.95 vs the 0.6 threshold)
+    probs = np.array([0.50, 0.30, 0.15, 0.05] + [0.0] * 4)
+    logits = jnp.asarray(np.log(np.maximum(probs, 1e-30))[None], jnp.float32)
+    draws = np.array([
+        int(sample_logits(logits, jax.random.PRNGKey(i), greedy=False,
+                          top_p=0.6)[0]) for i in range(300)])
+    assert set(np.unique(draws)) == {0, 1}
+    # both survivors keep their relative odds (0.5 vs 0.3 -> ~62.5% zeros)
+    frac0 = float(np.mean(draws == 0))
+    assert 0.5 < frac0 < 0.75, frac0
+    # top_p covering everything == plain categorical (identical draws)
+    for i in (0, 7, 42):
+        a = sample_logits(logits, jax.random.PRNGKey(i), greedy=False,
+                          top_p=1.0)
+        b = sample_logits(logits, jax.random.PRNGKey(i), greedy=False)
+        assert int(a[0]) == int(b[0])
+    # a top_p smaller than the argmax's own probability keeps the argmax —
+    # including top_p=0.0, a common spelling of "argmax" (regression: an
+    # all-False keep mask degenerated categorical to vocab id 0, so the
+    # probe puts the argmax at id 2 to tell the two behaviors apart)
+    probs2 = np.array([0.05, 0.15, 0.50, 0.30] + [0.0] * 4)
+    logits2 = jnp.asarray(np.log(np.maximum(probs2, 1e-30))[None],
+                          jnp.float32)
+    for p in (0.1, 0.0):
+        one = np.array([int(sample_logits(logits2, jax.random.PRNGKey(i),
+                                          greedy=False, top_p=p)[0])
+                        for i in range(50)])
+        assert set(np.unique(one)) == {2}, p
+    # composes with top_k: top_k=3 then top_p=0.9 keeps {0, 1} (renormalized
+    # head 0.526/0.316/0.158 -> exclusive cumsum 0.0/0.526/0.842... third
+    # token's exclusive mass 0.842 < 0.9 keeps it too -> {0, 1, 2})
+    both = np.array([int(sample_logits(logits, jax.random.PRNGKey(i),
+                                       greedy=False, top_k=3, top_p=0.9)[0])
+                     for i in range(300)])
+    assert set(np.unique(both)) <= {0, 1, 2} and 3 not in both
+
+
+def test_generate_top_p_threaded_through_engines():
+    """cfg.top_p must reach the resident generate loop and the serving
+    scheduler: top_p ~ 0 collapses sampling to greedy, so a sampled run at
+    temperature 1 with tiny top_p must equal the greedy run token for
+    token."""
+    from deepspeed_tpu.inference.scheduler import Request
+    _mk_mesh(data=1)
+    spec = make_gpt_decode_model(cfg=TINY, name="tiny")
+    greedy_engine = init_inference(model=spec, config={
+        "dtype": "float32", "kv_cache_dtype": "float32", "greedy": True,
+        "kv_block_size": 16, "max_out_tokens": 64})
+    toks = np.random.default_rng(3).integers(
+        0, TINY.vocab_size, (12,)).astype(np.int32)
+    ref = greedy_engine.generate(toks[None], max_new_tokens=6,
+                                 stop_on_eos=False)
+
+    _mk_mesh(data=1)
+    nucleus = init_inference(model=spec, config={
+        "dtype": "float32", "kv_cache_dtype": "float32", "greedy": False,
+        "temperature": 1.0, "top_p": 1e-6,
+        "kv_block_size": 16, "max_out_tokens": 64})
+    out = nucleus.generate(toks[None], max_new_tokens=6, stop_on_eos=False,
+                           rng=jax.random.PRNGKey(5))
+    np.testing.assert_array_equal(out, ref)
+    serving = nucleus.serving(max_slots=1, max_context=64, prefill_chunk=16)
+    res = serving.run([Request(uid=0, tokens=toks, max_new_tokens=6,
+                               stop_on_eos=False)])
+    np.testing.assert_array_equal(res[0].tokens, ref[0])
