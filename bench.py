@@ -195,6 +195,13 @@ def run_lane(model_name, batch, seq, gas, zero_stage, *, steps, warmup=3,
     }
     if grad_accum_dtype:
         ds_cfg["data_types"] = {"grad_accum_dtype": grad_accum_dtype}
+    # registry-only telemetry (no exporter files from a bench run): step-time
+    # histogram + the engine's own achieved-MFU gauge ride into extra. The
+    # analytic 6N numerator (measure_program_flops=False) avoids paying a
+    # second full XLA compile of the train step just to read its flops.
+    ds_cfg["telemetry"] = {"enabled": True, "prometheus": False,
+                           "jsonl": False, "monitor_bridge": False,
+                           "measure_program_flops": False}
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_cfg)
 
     rng = np.random.default_rng(0)
@@ -259,10 +266,38 @@ def run_lane(model_name, batch, seq, gas, zero_stage, *, steps, warmup=3,
             "global_batch": engine.train_batch_size(),
             "n_chips": n_chips,
             "loss": float(loss),
+            # the telemetry layer's own read of the same run (its MFU gauge
+            # uses the per-chip generation peak; step-time percentiles come
+            # from the train/step_time_ms histogram over warmup+timed steps)
+            "telemetry": _train_telemetry_extra(engine),
         },
     }
     del engine, model
     return result
+
+
+def _train_telemetry_extra(engine):
+    snap = engine.telemetry.registry.snapshot()
+    out = {}
+    if "train/mfu" in snap:
+        out["mfu"] = round(snap["train/mfu"]["value"], 4)
+    st = snap.get("train/step_time_ms")
+    if st:
+        out["step_time_p50_ms"] = round(st["p50"], 2)
+        out["step_time_p99_ms"] = round(st["p99"], 2)
+    return out
+
+
+def _latency_extra(serving):
+    """TTFT/TPOT/queue-wait/e2e percentiles from the serving engine's
+    telemetry histograms — the numbers BENCH_*.json should capture alongside
+    aggregate tokens/s."""
+    out = {}
+    for name, m in serving.latency_snapshot().items():
+        out[name] = {"count": m["count"], "p50": round(m["p50"], 2),
+                     "p90": round(m["p90"], 2), "p99": round(m["p99"], 2),
+                     "mean": round(m["mean"], 2)}
+    return out
 
 
 def peak_hbm_gbps():
@@ -416,7 +451,11 @@ def run_serving_lane(steps=1, warmup=1):
     spec = make_gpt_decode_model(cfg=cfg, params=params)
     engine = init_inference(model=spec, config={
         "dtype": "bfloat16", "kv_cache_dtype": "bfloat16", "greedy": True,
-        "kv_block_size": 128, "max_out_tokens": 1024})
+        "kv_block_size": 128, "max_out_tokens": 1024,
+        # registry-only telemetry: TTFT/TPOT/queue-wait histograms for the
+        # extra block, no exporter files from a bench run
+        "telemetry": {"enabled": True, "prometheus": False, "jsonl": False,
+                      "monitor_bridge": False}})
     rng = np.random.default_rng(0)
     prompts, news = _serving_trace(rng, n_req, cfg.vocab_size)
     reqs = [Request(uid=i, tokens=p, max_new_tokens=n, stop_on_eos=False)
@@ -464,6 +503,9 @@ def run_serving_lane(steps=1, warmup=1):
             "serving_wall_s": round(dt_cont, 2),
             "static_wall_s": round(dt_stat, 2),
             "decode_window": window,
+            # per-request latency distributions (telemetry histograms):
+            # aggregate tokens/s hides the tail — these do not
+            "latency": _latency_extra(serving),
             "compiles": serving.compile_stats(),
             # the recompile tax, counted: generate programs static batching
             # built for this one trace (one per batch shape x max_new
@@ -512,7 +554,9 @@ def run_prefix_cache_lane():
     spec = make_gpt_decode_model(cfg=cfg, params=params)
     engine = init_inference(model=spec, config={
         "dtype": "bfloat16", "kv_cache_dtype": "bfloat16", "greedy": True,
-        "kv_block_size": 128, "max_out_tokens": 1024})
+        "kv_block_size": 128, "max_out_tokens": 1024,
+        "telemetry": {"enabled": True, "prometheus": False, "jsonl": False,
+                      "monitor_bridge": False}})
     rng = np.random.default_rng(0)
     # shared system prompt + short per-request user turns + modest outputs:
     # the few-shot-template shape where prefill dominates end-to-end cost
@@ -558,6 +602,9 @@ def run_prefix_cache_lane():
             "prefill_chunks_saved": cold_chunks - warm_chunks,
             "prefix_hit_tokens": st["hit_tokens"],
             "prefix_evictions": st["evictions"],
+            # both waves' requests land in one distribution; the warm wave
+            # pulls the TTFT tail in — visible in p90/p99 vs mean
+            "latency": _latency_extra(serving),
             "compiles": serving.compile_stats(),
         },
     }

@@ -325,6 +325,15 @@ def _world_info(engine):
 
 
 def _emit_ckpt_events(engine, events):
+    # route through the telemetry registry first (when enabled): save
+    # latency becomes a `Checkpoint/save_ms` HISTOGRAM with percentiles
+    # instead of a last-write-wins scalar
+    telem = getattr(engine, "telemetry", None)
+    if telem is not None:
+        try:
+            telem.record_events(events)
+        except Exception as e:
+            logger.warning(f"checkpoint telemetry events not recorded: {e}")
     mon = getattr(engine, "monitor", None)
     try:
         from deepspeed_tpu.monitor.monitor import write_recovery_events

@@ -239,6 +239,32 @@ class FlopsProfilerConfig(ConfigModel):
 
 
 @dataclass
+class TelemetryConfig(ConfigModel):
+    """Unified telemetry (`deepspeed_tpu/telemetry/`): metrics registry +
+    exporters + spans. Opt-in: when disabled (default) the instrumented
+    subsystems record nothing and NO files are written. Shared by the train
+    config and `TpuInferenceConfig` — the serving scheduler reads the same
+    block."""
+    enabled: bool = False
+    output_path: str = "telemetry"   # dir for <subsystem>.prom/.jsonl/.trace.json
+    export_interval: int = 20        # steps between exports (scheduler
+                                     # iterations for serving, optimizer steps
+                                     # for training)
+    prometheus: bool = True          # text-exposition file (atomic rewrite)
+    jsonl: bool = True               # append-only log (bin/dstpu_metrics)
+    monitor_bridge: bool = True      # flatten snapshots into MonitorMaster
+                                     # scalars so TB/WandB/CSV keep working
+    chrome_trace: bool = False       # host-side span timeline (Perfetto)
+    peak_tflops: float = 0.0         # per-chip peak override for MFU (TFLOPs);
+                                     # 0 = auto-detect from the device kind
+    measure_program_flops: bool = True  # MFU numerator: cost-analyze the
+                                     # compiled step once at first step (XLA's
+                                     # exact program flops — an extra one-time
+                                     # compile); False = analytic 6N model
+                                     # flops (the PaLM MFU convention, free)
+
+
+@dataclass
 class EigenvalueConfig(ConfigModel):
     """Reference: eigenvalue block (`runtime/config.py:545`) — curvature
     estimation driving the MoQ quantization schedule."""
@@ -459,6 +485,7 @@ class TpuTrainConfig(ConfigModel):
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     fault_tolerance: FaultToleranceConfig = field(default_factory=FaultToleranceConfig)
     moe: MoEConfig = field(default_factory=MoEConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
     gradient_clipping: float = 0.0
     prescale_gradients: bool = False

@@ -3,7 +3,7 @@
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
-from deepspeed_tpu.config.core import ConfigModel
+from deepspeed_tpu.config.core import ConfigModel, TelemetryConfig
 
 
 @dataclass
@@ -101,6 +101,10 @@ class TpuInferenceConfig(ConfigModel):
     kv_block_size: int = 512
     # continuous-batching serving engine knobs (InferenceEngine.serving())
     serving: ServingConfig = field(default_factory=ServingConfig)
+    # unified telemetry (deepspeed_tpu/telemetry/): TTFT/TPOT/queue-wait
+    # histograms + pool gauges on the serving scheduler; disabled by default
+    # (zero overhead, no files written)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     # ZeRO-Inference parameter spill (reference ds_config "zero_optimization"
     # with stage-3 param offload): {"offload_param": {"device": "cpu"|"nvme",
     # "nvme_path": ..., "lookahead": 1, "staging": 3}}

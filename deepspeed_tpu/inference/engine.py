@@ -307,7 +307,10 @@ class InferenceEngine:
         """Continuous-batching serving engine over this engine's params:
         persistent paged KV pool + request scheduler (inference/scheduler.py).
         `overrides` patch `config.serving` fields (max_slots, max_context,
-        num_kv_blocks, prefill_chunk, prefill_chunks_per_step)."""
+        num_kv_blocks, prefill_chunk, prefill_chunks_per_step). The
+        scheduler also reads this config's `telemetry` block: when enabled
+        it records TTFT/TPOT/queue-wait/e2e histograms and pool gauges
+        (docs/profiling.md "Telemetry")."""
         from deepspeed_tpu.inference.scheduler import ServingEngine
         return ServingEngine(self, **overrides)
 

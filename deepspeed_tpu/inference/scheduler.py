@@ -37,6 +37,7 @@ changes; the two compiled programs and their shapes are untouched.
 
 import collections
 import dataclasses
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -45,6 +46,7 @@ import numpy as np
 
 from deepspeed_tpu.inference.kv_cache import (BlockAllocator, TRASH_BLOCK,
                                               blocks_needed, max_written_pos)
+from deepspeed_tpu.telemetry import Telemetry
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -67,6 +69,9 @@ class CompletedRequest:
     finish_reason: str        # "eos" | "length"
     cached_prefix_tokens: int = 0  # prompt tokens whose KV came from the
                               # prefix cache (0 when caching is off/missed)
+    timing: Optional[Dict[str, float]] = None  # telemetry only: monotonic
+                              # arrival/admit/first_token/finish stamps
+                              # (None when telemetry is disabled)
 
 
 _FREE, _PREFILL, _DECODE = 0, 1, 2
@@ -75,7 +80,7 @@ _FREE, _PREFILL, _DECODE = 0, 1, 2
 class _Slot:
     __slots__ = ("idx", "state", "uid", "prompt", "prompt_len", "padded_len",
                  "max_new", "eos", "blocks", "cursor", "pos", "emitted",
-                 "hashes", "reg", "cached")
+                 "hashes", "reg", "cached", "t_arrive", "t_admit", "t_first")
 
     def __init__(self, idx):
         self.idx = idx
@@ -92,6 +97,7 @@ class _Slot:
         self.hashes = None      # prefix-cache hash chain (full prompt blocks)
         self.reg = 0            # blocks [0, reg) already registered/cached
         self.cached = 0         # blocks mapped from the cache at admission
+        self.t_arrive = self.t_admit = self.t_first = None  # telemetry stamps
 
 
 class ServingEngine:
@@ -162,6 +168,13 @@ class ServingEngine:
 
         self._rng = jax.random.PRNGKey(0)
         self._build_step_fns()
+
+        # telemetry (deepspeed_tpu/telemetry/): TTFT/TPOT/queue-wait/e2e
+        # histograms + queue/slot/pool gauges + per-phase spans. Disabled by
+        # default — then every record site below is a single attribute check
+        # and NOTHING is written anywhere.
+        self.telemetry = Telemetry(getattr(engine.config, "telemetry", None),
+                                   subsystem="serving")
 
         # observability
         self.steps = 0
@@ -272,7 +285,8 @@ class ServingEngine:
         # step while backpressured (cache contents change between steps)
         hashes = (self.prefix_cache.hash_chain(prompt)
                   if self.prefix_cache is not None else None)
-        self.queue.append((request, prompt, prompt_len, padded, need, hashes))
+        self.queue.append((request, prompt, prompt_len, padded, need, hashes,
+                           time.monotonic()))
 
     def _resolve_eos(self, req: Request):
         if not req.stop_on_eos:
@@ -287,7 +301,8 @@ class ServingEngine:
     def _admit(self):
         free = [s for s in self.slots if s.state == _FREE]
         while self.queue and free:
-            req, prompt, prompt_len, padded, need, hashes = self.queue[0]
+            (req, prompt, prompt_len, padded, need, hashes,
+             t_arrive) = self.queue[0]
             hit = []
             if hashes:
                 # longest-prefix match, capped so at least the final prompt
@@ -342,6 +357,11 @@ class ServingEngine:
             slot.cached = len(hit)
             slot.pos = prompt_len
             slot.emitted = []
+            slot.t_arrive = t_arrive
+            if self.telemetry.enabled:
+                slot.t_admit = time.monotonic()
+                self.telemetry.observe("serving/queue_wait_ms",
+                                       (slot.t_admit - t_arrive) * 1e3)
             self.tables[slot.idx, :] = TRASH_BLOCK
             self.tables[slot.idx, :len(blocks)] = blocks
             if hit:
@@ -360,17 +380,38 @@ class ServingEngine:
         # first unregistered hash — evicting a head strands its whole tail)
         self.allocator.free(slot.blocks[::-1])
         self.tables[slot.idx, :] = TRASH_BLOCK
+        timing = None
+        if self.telemetry.enabled and slot.t_admit is not None:
+            t_finish = time.monotonic()
+            n = len(slot.emitted)
+            self.telemetry.observe("serving/e2e_ms",
+                                   (t_finish - slot.t_arrive) * 1e3)
+            if n > 1 and slot.t_first is not None:
+                # time-per-output-token over the DECODE phase only (vLLM's
+                # TPOT definition): first token is TTFT's, the remaining
+                # n-1 amortize the window/step cadence
+                self.telemetry.observe(
+                    "serving/tpot_ms",
+                    (t_finish - slot.t_first) / (n - 1) * 1e3)
+            timing = {"arrival": slot.t_arrive, "admit": slot.t_admit,
+                      "first_token": slot.t_first, "finish": t_finish}
         done = CompletedRequest(uid=slot.uid, prompt_len=slot.prompt_len,
                                 tokens=np.asarray(slot.emitted, np.int32),
                                 finish_reason=reason,
                                 cached_prefix_tokens=slot.cached
-                                * self.block_size)
+                                * self.block_size,
+                                timing=timing)
         slot.reset()
         return done
 
     def _emit(self, slot: _Slot, tok: int, finished: List[CompletedRequest]):
         slot.emitted.append(int(tok))
         self.tokens_generated += 1
+        if self.telemetry.enabled and len(slot.emitted) == 1 \
+                and slot.t_arrive is not None:
+            slot.t_first = time.monotonic()
+            self.telemetry.observe("serving/ttft_ms",
+                                   (slot.t_first - slot.t_arrive) * 1e3)
         if slot.eos is not None and int(tok) == slot.eos:
             finished.append(self._retire(slot, "eos"))
         elif len(slot.emitted) >= slot.max_new:
@@ -386,7 +427,8 @@ class ServingEngine:
         self.steps += 1
         params = self.engine.params
 
-        self._admit()
+        with self.telemetry.span("serving/admit"):
+            self._admit()
 
         # chunked prefill, bounded per step so arriving prompts cannot stall
         # the running batch for more than prefill_budget chunk-times
@@ -401,10 +443,11 @@ class ServingEngine:
                 chunk[0, :len(seg)] = seg
                 final = start + self.chunk >= slot.padded_len
                 last = (slot.prompt_len - 1 - start) if final else self.chunk - 1
-                tok, self.pool = self._prefill_step(
-                    params, chunk, np.asarray([start], np.int32),
-                    np.asarray([last], np.int32), self.pool,
-                    self.tables[slot.idx][None], self._next_rng())
+                with self.telemetry.span("serving/prefill_chunk"):
+                    tok, self.pool = self._prefill_step(
+                        params, chunk, np.asarray([start], np.int32),
+                        np.asarray([last], np.int32), self.pool,
+                        self.tables[slot.idx][None], self._next_rng())
                 slot.cursor = start + self.chunk
                 budget -= 1
                 self.prefill_chunks += 1
@@ -440,9 +483,11 @@ class ServingEngine:
                 tok[s.idx] = s.emitted[-1]
                 pos[s.idx] = s.pos
                 tables[s.idx] = self.tables[s.idx]
-            nxt, self.pool = self._decode_step(params, tok, pos, self.pool,
-                                               tables, self._next_rng())
-            nxt = np.asarray(jax.device_get(nxt))       # [S, window]
+            with self.telemetry.span("serving/decode_window"):
+                nxt, self.pool = self._decode_step(params, tok, pos,
+                                                   self.pool, tables,
+                                                   self._next_rng())
+                nxt = np.asarray(jax.device_get(nxt))   # [S, window]
             self.decode_steps += 1
             for s in dec:
                 s.pos += self.window
@@ -450,6 +495,13 @@ class ServingEngine:
                     self._emit(s, int(t), finished)
                     if s.state == _FREE:                # retired mid-window
                         break
+
+        if self.telemetry.enabled:
+            self.telemetry.set_gauge("serving/queue_depth", len(self.queue))
+            self.telemetry.set_gauge("serving/active_slots", self.num_active)
+            self.telemetry.set_gauge("serving/free_blocks",
+                                     self.allocator.available)
+            self.telemetry.maybe_export(self.steps)
 
         return finished
 
@@ -476,6 +528,10 @@ class ServingEngine:
                     f"serving scheduler made no progress: queue="
                     f"{len(self.queue)} active={self.num_active} "
                     f"free_blocks={self.allocator.num_free}")
+        # drained: flush the tail of the trace into the exporters (a run
+        # shorter than export_interval would otherwise leave no files)
+        if self.telemetry.enabled:
+            self.telemetry.export(self.steps)
         return out
 
     def compile_stats(self) -> Dict[str, int]:
@@ -502,7 +558,19 @@ class ServingEngine:
                 "prefill_chunks_skipped": self.prefill_chunks_skipped,
                 "cached_blocks": self.prefix_cache.num_cached,
                 "evictions": self.allocator.evictions}
+        if self.telemetry.enabled:
+            out["latency"] = self.latency_snapshot()
         return out
+
+    def latency_snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Per-request latency histogram snapshots (ttft_ms / tpot_ms /
+        queue_wait_ms / e2e_ms -> count/mean/p50/p90/p99/min/max). Empty
+        when telemetry is disabled."""
+        if not self.telemetry.enabled:
+            return {}
+        snap = self.telemetry.registry.snapshot()
+        return {name.split("/", 1)[1]: m for name, m in snap.items()
+                if m.get("type") == "histogram" and name.startswith("serving/")}
 
     def write_monitor_events(self, monitor):
         """Serving cache/pool observability through the experiment monitor

@@ -27,6 +27,9 @@ class Monitor:
     def write_events(self, event_list):
         raise NotImplementedError
 
+    def close(self):
+        """Release writer resources; safe to call more than once."""
+
 
 class TensorBoardMonitor(Monitor):
     def __init__(self, config):
@@ -49,6 +52,14 @@ class TensorBoardMonitor(Monitor):
         if flush:
             self.summary_writer.flush()
 
+    def close(self):
+        if self.summary_writer is not None:
+            try:
+                self.summary_writer.close()
+            except Exception as e:
+                logger.warning(f"tensorboard close failed: {e}")
+            self.summary_writer = None
+
 
 class WandbMonitor(Monitor):
     def __init__(self, config):
@@ -67,14 +78,32 @@ class WandbMonitor(Monitor):
         if self.run is None:
             return
         import wandb
-        for name, value, step in event_list:
-            wandb.log({name: value}, step=step)
+        for i, (name, value, step) in enumerate(event_list):
+            # never-die: a dropped network must not crash the caller (same
+            # contract write_events_safe documents — but wandb is the only
+            # backend that talks to a REMOTE service per event, so it guards
+            # its own loop too: callers going through MonitorMaster directly
+            # are just as exposed)
+            try:
+                wandb.log({name: value}, step=step)
+            except Exception as e:
+                logger.warning(f"wandb log failed ({e}); dropping the "
+                               f"remaining {len(event_list) - i} events")
+                break
+
+    def close(self):
+        if self.run is not None:
+            try:
+                self.run.finish()
+            except Exception as e:
+                logger.warning(f"wandb finish failed: {e}")
+            self.run = None
 
 
 class CsvMonitor(Monitor):
     def __init__(self, config):
         super().__init__(config)
-        self.filenames = {}
+        self._files = {}    # tag -> (handle, csv.writer): opened once per tag
         if self.enabled and _rank() == 0:
             self.output_path = pathlib.Path(config.output_path or "./csv_monitor") / config.job_name
             self.output_path.mkdir(parents=True, exist_ok=True)
@@ -85,37 +114,54 @@ class CsvMonitor(Monitor):
         if not self.enabled:
             return
         for name, value, step in event_list:
-            fname = self.output_path / (name.replace("/", "_") + ".csv")
-            new = not fname.exists()
-            with open(fname, "a", newline="") as f:
+            entry = self._files.get(name)
+            if entry is None:
+                fname = self.output_path / (name.replace("/", "_") + ".csv")
+                new = not fname.exists()
+                f = open(fname, "a", newline="")
                 w = csv.writer(f)
                 if new:
                     w.writerow(["step", name])
-                w.writerow([step, value])
+                entry = self._files[name] = (f, w)
+            f, w = entry
+            w.writerow([step, value])
+            f.flush()
+
+    def close(self):
+        for f, _w in self._files.values():
+            try:
+                f.close()
+            except Exception:
+                pass
+        self._files = {}
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
-def write_recovery_events(monitor, event_list):
-    """Best-effort emission of checkpoint/recovery observability events
-    (Checkpoint/save_ms, Checkpoint/bytes, Recovery/restarts_total by cause,
-    Recovery/last_good_step, ...). Recovery paths must never die on a
-    monitoring failure — and they run from contexts where no monitor may
-    exist (async save finalizer threads, the elastic agent supervisor) — so
-    this guards both, unlike MonitorMaster.write_events."""
+def write_events_safe(monitor, event_list):
+    """Best-effort event emission: the ONE guarded entry point for every
+    caller that must never die on a monitoring failure — checkpoint/recovery
+    paths (Checkpoint/save_ms, Recovery/restarts_total by cause, ...), the
+    serving scheduler (Serving/*), and the telemetry monitor bridge. These
+    run from contexts where no monitor may exist at all (async save
+    finalizer threads, the elastic agent supervisor), so both the lookup and
+    the write are guarded, unlike MonitorMaster.write_events."""
     if monitor is None or not getattr(monitor, "enabled", False):
         return
     try:
         monitor.write_events(list(event_list))
     except Exception as e:
-        logger.warning(f"recovery event emission failed: {e}")
+        logger.warning(f"monitor event emission failed: {e}")
 
 
-def write_serving_events(monitor, event_list):
-    """Serving-engine observability (Serving/prefix_hit_tokens,
-    Serving/prefix_evictions, Serving/pool_free_blocks — emitted by
-    `ServingEngine.write_monitor_events`) with the same never-die contract
-    as the recovery events above: a serving loop must not crash on a
-    monitoring failure."""
-    write_recovery_events(monitor, event_list)
+# Historical aliases (PR 2 recovery events, PR 4 serving events) — one
+# implementation, kept importable under both names.
+write_recovery_events = write_events_safe
+write_serving_events = write_events_safe
 
 
 class MonitorMaster(Monitor):
@@ -134,3 +180,10 @@ class MonitorMaster(Monitor):
         for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
             if m.enabled:
                 m.write_events(event_list)
+
+    def close(self):
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            try:
+                m.close()
+            except Exception:
+                pass

@@ -19,6 +19,7 @@ API parity with the reference engine: `train_batch`, `forward`, `backward`, `ste
 
 import dataclasses
 import inspect
+import time
 from typing import Any, Callable, NamedTuple, Optional, Union
 
 import jax
@@ -36,6 +37,7 @@ from deepspeed_tpu.runtime.dataloader import TpuDataLoader, RepeatingLoader
 from deepspeed_tpu.runtime.precision import LossScaler, LossScaleState, masked_update
 from deepspeed_tpu.runtime.sentinel import BadStateError, BadStateSentinel
 from deepspeed_tpu.runtime.zero import ZeroShardingPolicy
+from deepspeed_tpu.telemetry import Telemetry
 from deepspeed_tpu.utils.logging import logger, log_dist
 from deepspeed_tpu.utils.timer import (SynchronizedWallClockTimer, ThroughputTimer,
                                        FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
@@ -282,6 +284,14 @@ class Engine:
         self.monitor = self._build_monitor()
         self.losses = None
         self._last_metrics = {}
+
+        # unified telemetry (deepspeed_tpu/telemetry/, `telemetry` config
+        # block): step-time histograms, tokens/s + achieved-MFU gauges,
+        # device-memory watermarks. Opt-in; the default-disabled object costs
+        # one attribute check per step and writes nothing.
+        self.telemetry = Telemetry(config.telemetry, subsystem="train",
+                                   monitor=self.monitor)
+        self._program_flops = None   # per-train_batch flops, measured once
 
         # ---- fault tolerance: bad-state sentinel + rollback bookkeeping
         # (docs/fault_tolerance.md; opt-in via the fault_tolerance block —
@@ -1044,6 +1054,7 @@ class Engine:
             batch = self._inject_routing_directives(batch)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
+        t_step0 = time.perf_counter()   # timer.start() already fenced the device
         placed = None
         if self.host_optimizer is not None:
             metrics = self._host_train_batch(batch)
@@ -1051,6 +1062,7 @@ class Engine:
             placed = self._maybe_split_gas(batch)
             self.state, metrics = self._run_stateful_step(self._train_step, placed)
         self.timers(TRAIN_BATCH_TIMER).stop()
+        step_seconds = time.perf_counter() - t_step0   # incl. stop()'s fence
         self.tput_timer.stop(global_step=True)
         # auto-profile at profile_step (reference engine.forward:1782 /
         # step:2162 flops_profiler_profile_step hook); outside the timer
@@ -1066,6 +1078,8 @@ class Engine:
                 from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
                 self._flops_profiler = FlopsProfiler(ds_engine=self)
         self._after_step(metrics, count_micro=True)
+        if self.telemetry.enabled:
+            self._record_step_telemetry(batch, placed, step_seconds)
         self._maybe_step_moq(batch)
         self._maybe_step_compression()
         return metrics["loss"]
@@ -1259,6 +1273,67 @@ class Engine:
             if cause is not None:
                 self._recover_bad_state(cause)
 
+    # ------------------------------------------------------------------
+    # telemetry (deepspeed_tpu/telemetry/; opt-in `telemetry` config block)
+    # ------------------------------------------------------------------
+
+    def _record_step_telemetry(self, batch, placed, step_seconds):
+        """Per-step observability: step-time histogram, tokens/s gauge, and
+        achieved MFU = program flops / (step wall time x per-chip peak).
+        Program flops are measured ONCE (see _measure_program_flops); the
+        peak comes from the device-generation table with a
+        `telemetry.peak_tflops` override knob."""
+        reg = self.telemetry.registry
+        reg.histogram("train/step_time_ms").observe(step_seconds * 1e3)
+        tokens = None
+        if isinstance(batch, dict):
+            t = batch.get("tokens", batch.get("input_ids"))
+            if t is not None:
+                tokens = int(np.asarray(t).size)
+        if tokens:
+            reg.gauge("train/tokens_per_sec").set(tokens / step_seconds)
+        if self._program_flops is None:
+            self._program_flops = self._measure_program_flops(placed, tokens)
+        if self._program_flops > 0:
+            achieved = self._program_flops / step_seconds   # per-chip FLOPs/s
+            reg.gauge("train/tflops_per_chip").set(achieved / 1e12)
+            reg.gauge("train/mfu").set(achieved / self.telemetry.peak_flops())
+        # device-memory watermarks (best-effort: the CPU harness and some
+        # runtimes expose no allocator stats)
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            for src, dst in (("bytes_in_use", "train/hbm_bytes_in_use"),
+                             ("peak_bytes_in_use", "train/hbm_peak_bytes")):
+                if src in stats:
+                    reg.gauge(dst).set(float(stats[src]))
+        except Exception:
+            pass
+        self.telemetry.maybe_export(self.global_steps)
+
+    def _measure_program_flops(self, placed, tokens):
+        """The PER-CHIP MFU numerator, decided once at the first instrumented
+        step: XLA's cost analysis of the compiled train step (the flops the
+        partitioned per-device program actually schedules — one extra AOT
+        lowering+compile, same machinery as the flops profiler) when
+        `telemetry.measure_program_flops` is on, else the analytic
+        6N-model-flops PaLM convention (total-mesh flops, so divided over
+        the chips here — both paths return the same unit). Returns 0.0 when
+        neither is available so the measurement is never retried per step."""
+        flops = 0.0
+        if getattr(self.config.telemetry, "measure_program_flops", True) \
+                and self._train_step is not None and placed is not None:
+            try:
+                from deepspeed_tpu.profiling.flops_profiler import cost_analysis
+                flops = float(cost_analysis(self._train_step, self.state,
+                                            placed).get("flops", 0.0) or 0.0)
+            except Exception as e:
+                logger.warning(f"telemetry: program cost analysis failed "
+                               f"({e}); falling back to 6N model flops")
+        if flops <= 0.0 and tokens:
+            flops = 6.0 * tree_num_params(self.state.params) * tokens \
+                / max(self.mesh.devices.size, 1)
+        return flops
+
     def _recover_bad_state(self, cause):
         """Persistent bad state past the masked skip-step: roll back to the
         last good checkpoint in-process when configured (and possible), else
@@ -1277,14 +1352,16 @@ class Engine:
                 self.rollbacks += 1
                 self._sentinel.reset()
                 self._fast_forward_data()
+                events = [
+                    ("Recovery/rollbacks_total", float(self.rollbacks),
+                     self.global_steps),
+                    ("Recovery/last_good_step", float(self.global_steps),
+                     self.global_steps),
+                ]
+                self.telemetry.record_events(events)
                 if self.monitor is not None and self.monitor.enabled:
                     from deepspeed_tpu.monitor.monitor import write_recovery_events
-                    write_recovery_events(self.monitor, [
-                        ("Recovery/rollbacks_total", float(self.rollbacks),
-                         self.global_steps),
-                        ("Recovery/last_good_step", float(self.global_steps),
-                         self.global_steps),
-                    ])
+                    write_recovery_events(self.monitor, events)
                 log_dist(f"rollback #{self.rollbacks} complete: resumed at "
                          f"step {self.global_steps} (cause: {cause})", ranks=[0])
                 return

@@ -1,0 +1,159 @@
+"""Unified telemetry: one registry, many sinks.
+
+`Telemetry` is the object the instrumented subsystems hold: it owns a
+`MetricsRegistry` (`registry.py`), the configured exporters
+(`exporters.py` — Prometheus textfile, JSONL log, monitor bridge) and an
+optional chrome-trace span sink (`spans.py`). Construction from a
+`TelemetryConfig` (config/core.py) with `enabled=False` — the default — is a
+complete no-op: no directory is created, no file is written, `span()`
+returns a shared null context and every record method returns immediately,
+so the serving scheduler and the train loop can instrument unconditionally.
+
+Wiring (all opt-in via the `telemetry` config block):
+
+  * ServingEngine (`inference/scheduler.py`): per-request
+    `serving/ttft_ms` / `serving/tpot_ms` / `serving/queue_wait_ms` /
+    `serving/e2e_ms` histograms, queue/slot/pool gauges, per-phase spans;
+  * training Engine (`runtime/engine.py`): `train/step_time_ms` histogram,
+    tokens/s + achieved-MFU gauges, device-memory watermarks;
+  * checkpoint saver / recovery paths: their `(tag, value, step)` events
+    route through `record_events`, turning save latency into a histogram.
+
+`bin/dstpu_metrics` renders the JSONL log (`telemetry/cli.py`).
+"""
+
+import contextlib
+import pathlib
+
+from deepspeed_tpu.telemetry.registry import (Counter, Gauge, Histogram,
+                                              MetricsRegistry)
+from deepspeed_tpu.telemetry.exporters import (JsonlExporter, MonitorBridge,
+                                               PrometheusFileExporter,
+                                               prometheus_text)
+from deepspeed_tpu.telemetry import spans
+from deepspeed_tpu.telemetry.spans import ChromeTraceSink, Span
+
+__all__ = ["Telemetry", "MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "PrometheusFileExporter", "JsonlExporter", "MonitorBridge",
+           "prometheus_text", "ChromeTraceSink", "Span"]
+
+_NULL_SPAN = contextlib.nullcontext()
+
+
+class Telemetry:
+    """Registry + exporters behind enable flags. See module docstring."""
+
+    def __init__(self, config=None, subsystem="metrics", monitor=None,
+                 registry=None):
+        self.config = config
+        self.subsystem = subsystem
+        self.enabled = bool(config is not None and
+                            getattr(config, "enabled", False))
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._exporters = []
+        self._trace = None
+        self._closed = False
+        if not self.enabled:
+            return
+        out = pathlib.Path(config.output_path or "telemetry")
+        if config.prometheus or config.jsonl or config.chrome_trace:
+            # registry-only configurations (all file sinks off — the bench
+            # lanes) must not litter an empty directory
+            out.mkdir(parents=True, exist_ok=True)
+        if config.prometheus:
+            self._exporters.append(
+                PrometheusFileExporter(out / f"{subsystem}.prom"))
+        if config.jsonl:
+            self._exporters.append(JsonlExporter(out / f"{subsystem}.jsonl"))
+        if config.monitor_bridge and monitor is not None and \
+                getattr(monitor, "enabled", False):
+            self._exporters.append(MonitorBridge(monitor))
+        if config.chrome_trace:
+            self._trace = ChromeTraceSink(out / f"{subsystem}.trace.json")
+
+    # ---- recording ---------------------------------------------------
+
+    def observe(self, name, value):
+        if self.enabled:
+            self.registry.histogram(name).observe(value)
+
+    def set_gauge(self, name, value):
+        if self.enabled:
+            self.registry.gauge(name).set(value)
+
+    def inc(self, name, n=1.0):
+        if self.enabled:
+            self.registry.counter(name).inc(n)
+
+    def record_events(self, event_list):
+        """Route monitor-style `(tag, value, step)` events into the registry:
+        `*_ms` / `*_seconds` tags become histogram observations (save latency
+        as a DISTRIBUTION, not a point value), everything else a gauge."""
+        if not self.enabled:
+            return
+        for tag, value, _step in event_list:
+            if tag.endswith(("_ms", "_seconds")):
+                self.registry.histogram(tag).observe(value)
+            else:
+                self.registry.gauge(tag).set(value)
+
+    def span(self, name):
+        """Timed/annotated region; a shared null context when disabled."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return spans.span(name, sink=self._trace)
+
+    # ---- export ------------------------------------------------------
+
+    def maybe_export(self, step):
+        """Export every `export_interval`-th step (cheap modulo when idle)."""
+        if not self.enabled:
+            return
+        interval = max(1, int(getattr(self.config, "export_interval", 1)))
+        if step % interval == 0:
+            self.export(step)
+
+    def export(self, step=None):
+        if not self.enabled:
+            return
+        snap = self.registry.snapshot()
+        for e in self._exporters:
+            e.export(self.registry, step=step, snapshot=snap)
+
+    def peak_flops(self):
+        """Per-chip peak FLOPs/s: the config override (TFLOPs) when set,
+        else the generation table in `profiling/flops_profiler.py`."""
+        override = float(getattr(self.config, "peak_tflops", 0.0) or 0.0)
+        if override > 0:
+            return override * 1e12
+        from deepspeed_tpu.profiling.flops_profiler import _peak_flops
+        return _peak_flops()
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        # final export so runs shorter than export_interval (and the tail of
+        # longer ones) still land in the files; guarded — close() also runs
+        # from __del__ during interpreter teardown
+        try:
+            if self.enabled and self.registry.metrics():
+                self.export()
+        except Exception:
+            pass
+        for e in self._exporters:
+            try:
+                e.close()
+            except Exception:
+                pass
+        if self._trace is not None:
+            try:
+                self._trace.close()
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,107 @@
+"""`dstpu_metrics` — render the latest snapshot from a telemetry JSONL log.
+
+The JSONL exporter appends one `{"step", "time", "metrics"}` object per
+export interval; this CLI tails that file (or the newest `*.jsonl` in a
+telemetry directory) and prints the latest snapshot as a table, as raw JSON
+(`--json`, for scripting / the golden round-trip test), or continuously
+(`--watch`).
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+
+def find_log(path):
+    """Resolve a metrics log: a .jsonl file as-is, a directory to its newest
+    `*.jsonl` by mtime. Returns None when nothing is there."""
+    p = pathlib.Path(path)
+    if p.is_file():
+        return p
+    if p.is_dir():
+        logs = sorted(p.glob("*.jsonl"), key=lambda f: f.stat().st_mtime)
+        if logs:
+            return logs[-1]
+    return None
+
+
+def load_latest(path):
+    """Last valid JSON record of the log (None when empty/absent). A torn
+    final line — the exporter crashed mid-append — falls back to the
+    previous record instead of erroring."""
+    log = find_log(path)
+    if log is None:
+        return None
+    record = None
+    with open(log) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return record
+
+
+def render(record):
+    """Human table for one snapshot record."""
+    metrics = record.get("metrics", {})
+    when = time.strftime("%Y-%m-%d %H:%M:%S",
+                         time.localtime(record.get("time", 0)))
+    lines = [f"step {record.get('step')} @ {when}", ""]
+    rows = [("metric", "type", "value / count", "mean", "p50", "p90", "p99")]
+    for name in sorted(metrics):
+        m = metrics[name]
+        if m.get("type") == "histogram":
+            rows.append((name, "hist", str(m["count"]),
+                         f"{m['mean']:.3f}", f"{m['p50']:.3f}",
+                         f"{m['p90']:.3f}", f"{m['p99']:.3f}"))
+        else:
+            rows.append((name, m.get("type", "?"), f"{m.get('value', 0):g}",
+                         "", "", "", ""))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for r in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="dstpu_metrics",
+        description="Summarize a deepspeed-tpu telemetry JSONL metrics log.")
+    ap.add_argument("path", nargs="?", default="telemetry",
+                    help="metrics .jsonl file or telemetry output dir "
+                         "(default: ./telemetry)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the latest snapshot record as raw JSON")
+    ap.add_argument("--watch", action="store_true",
+                    help="re-render every --interval seconds")
+    ap.add_argument("--interval", type=float, default=2.0)
+    args = ap.parse_args(argv)
+
+    def emit():
+        record = load_latest(args.path)
+        if record is None:
+            print(f"dstpu_metrics: no metrics log at {args.path!r}",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps(record) if args.json else render(record))
+        return 0
+
+    if not args.watch:
+        return emit()
+    try:
+        while True:
+            sys.stdout.write("\x1b[2J\x1b[H")
+            emit()
+            time.sleep(max(args.interval, 0.2))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

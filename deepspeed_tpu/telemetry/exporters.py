@@ -1,0 +1,126 @@
+"""Exporters: Prometheus text exposition, append-only JSONL, monitor bridge.
+
+Three sinks for one registry, each serving a different consumer:
+
+  * `PrometheusFileExporter` — the text exposition format written atomically
+    (tmp + rename), so a node-exporter-style textfile collector or a sidecar
+    `cat` can scrape mid-write without tearing;
+  * `JsonlExporter` — one JSON object per export (step, wall time, full
+    snapshot), append-only; `bin/dstpu_metrics` tails this file and the
+    bench records its latest snapshot into BENCH_*.json;
+  * `MonitorBridge` — flattens snapshots into `(tag, value, step)` scalars
+    through `monitor.write_events_safe`, so existing TB/WandB/CSV dashboards
+    keep working: a histogram fans out to `<name>/p50|p90|p99|mean|count`.
+"""
+
+import json
+import math
+import os
+import time
+
+from deepspeed_tpu.telemetry.registry import Counter, Gauge, Histogram
+
+__all__ = ["prometheus_text", "PrometheusFileExporter", "JsonlExporter",
+           "MonitorBridge"]
+
+
+def _prom_name(name):
+    """Sanitize a metric name for Prometheus ([a-zA-Z0-9_:] only)."""
+    return "".join(c if c.isalnum() or c in "_:" else "_" for c in name)
+
+
+def _fmt(v):
+    if v != v or v in (math.inf, -math.inf):     # NaN / +-Inf
+        return {math.inf: "+Inf", -math.inf: "-Inf"}.get(v, "NaN")
+    if float(v).is_integer():
+        return str(int(v))
+    return f"{v:.10g}"
+
+
+def prometheus_text(registry):
+    """Render a registry in the Prometheus text exposition format."""
+    lines = []
+    for name, m in registry.metrics():
+        pn = _prom_name(name)
+        if isinstance(m, Counter):
+            if not pn.endswith("_total"):
+                pn += "_total"
+            lines.append(f"# TYPE {pn} counter")
+            lines.append(f"{pn} {_fmt(m.value)}")
+        elif isinstance(m, Gauge):
+            lines.append(f"# TYPE {pn} gauge")
+            lines.append(f"{pn} {_fmt(m.value)}")
+        elif isinstance(m, Histogram):
+            lines.append(f"# TYPE {pn} histogram")
+            for edge, cum in m.cumulative_buckets():
+                lines.append(f'{pn}_bucket{{le="{_fmt(edge)}"}} {cum}')
+            lines.append(f"{pn}_sum {_fmt(m.sum)}")
+            lines.append(f"{pn}_count {m.count}")
+    return "\n".join(lines) + "\n"
+
+
+class PrometheusFileExporter:
+    """Atomic textfile exposition — write tmp, fsync-free rename (the file is
+    derived state; losing the last interval on a crash is fine, a half-
+    written scrape is not)."""
+
+    def __init__(self, path):
+        self.path = str(path)
+
+    def export(self, registry, step=None, snapshot=None):
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(prometheus_text(registry))
+        os.replace(tmp, self.path)
+
+    def close(self):
+        pass
+
+
+class JsonlExporter:
+    """Append-only metrics log: one `{"step", "time", "metrics"}` object per
+    export. Opened lazily so an enabled-but-never-exported telemetry block
+    leaves no empty file behind."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._f = None
+
+    def export(self, registry, step=None, snapshot=None):
+        if self._f is None:
+            self._f = open(self.path, "a")
+        snap = snapshot if snapshot is not None else registry.snapshot()
+        self._f.write(json.dumps({"step": step, "time": time.time(),
+                                  "metrics": snap}) + "\n")
+        self._f.flush()
+
+    def close(self):
+        if self._f is not None:
+            try:
+                self._f.close()
+            finally:
+                self._f = None
+
+
+class MonitorBridge:
+    """Registry snapshots -> MonitorMaster scalars (never-die contract)."""
+
+    def __init__(self, monitor):
+        self.monitor = monitor
+
+    def export(self, registry, step=None, snapshot=None):
+        from deepspeed_tpu.monitor.monitor import write_events_safe
+        snap = snapshot if snapshot is not None else registry.snapshot()
+        step = int(step or 0)
+        events = []
+        for name, m in snap.items():
+            if m["type"] == "histogram":
+                for stat in ("p50", "p90", "p99", "mean"):
+                    events.append((f"{name}/{stat}", float(m[stat]), step))
+                events.append((f"{name}/count", float(m["count"]), step))
+            else:
+                events.append((name, float(m["value"]), step))
+        write_events_safe(self.monitor, events)
+
+    def close(self):
+        pass

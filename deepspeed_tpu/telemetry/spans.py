@@ -1,0 +1,98 @@
+"""Host-side spans: named timed regions layered on the nvtx shim.
+
+A span does two things at once:
+
+  * enters a `jax.profiler.TraceAnnotation` via `utils/nvtx.annotate` (a hard
+    no-op when `jax.profiler` is unavailable), so the region shows up in a
+    real xprof/TensorBoard trace when one is being captured;
+  * optionally records `(name, start, duration)` into a `ChromeTraceSink`,
+    so a scheduler-step timeline (admit / prefill chunk / decode window) can
+    be opened in Perfetto WITHOUT a TPU profiler session — the host-side
+    phases are exactly the ones a device trace cannot see.
+
+The sink writes the Chrome trace event format as streamed JSON: an opening
+`[` then one complete ("ph": "X") event object per line, comma-terminated.
+Perfetto and chrome://tracing both accept the unterminated-array form, which
+is what makes the sink append-only and crash-safe.
+"""
+
+import json
+import os
+import threading
+import time
+
+from deepspeed_tpu.utils import nvtx
+
+__all__ = ["Span", "ChromeTraceSink", "span"]
+
+
+class ChromeTraceSink:
+    """Streamed chrome-trace event log (open directly in Perfetto). One sink
+    = one run = one file: the file is truncated at first write so a re-run
+    into the same output path cannot interleave two runs' timelines (every
+    event's `ts` is relative to THIS sink's construction). Within the run
+    events append and flush one by one — the timeline is readable mid-run
+    and survives a crash."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._f = None
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+
+    def add(self, name, start_s, dur_s, tid=0):
+        """Record one complete event; timestamps are seconds on the
+        `time.perf_counter` clock (converted to trace microseconds)."""
+        ev = {"name": name, "ph": "X", "pid": os.getpid(), "tid": tid,
+              "ts": round((start_s - self._t0) * 1e6, 3),
+              "dur": round(dur_s * 1e6, 3)}
+        with self._lock:
+            if self._f is None:
+                self._f = open(self.path, "w")
+                self._f.write("[\n")
+            self._f.write(json.dumps(ev) + ",\n")
+            self._f.flush()     # crash-safe: the timeline is readable mid-run
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.flush()
+                    self._f.close()
+                finally:
+                    self._f = None
+
+
+class Span:
+    """Context manager: nvtx annotation + optional chrome-trace event +
+    optional histogram observation (duration in ms)."""
+
+    __slots__ = ("name", "sink", "histogram", "_t0", "_nvtx")
+
+    def __init__(self, name, sink=None, histogram=None):
+        self.name = name
+        self.sink = sink
+        self.histogram = histogram
+        self._t0 = 0.0
+        self._nvtx = None
+
+    def __enter__(self):
+        self._nvtx = nvtx.annotate(self.name)
+        self._nvtx.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        self._nvtx.__exit__(exc_type, exc, tb)
+        self._nvtx = None
+        if self.sink is not None:
+            self.sink.add(self.name, self._t0, dur)
+        if self.histogram is not None:
+            self.histogram.observe(dur * 1e3)
+        return False
+
+
+def span(name, sink=None, histogram=None):
+    """Open a named span (see `Span`); usable as `with span("admit"): ...`."""
+    return Span(name, sink=sink, histogram=histogram)

@@ -1,11 +1,20 @@
 """Profiler range annotations — analog of the reference's nvtx shim
 (`deepspeed/utils/nvtx.py` `instrument_w_nvtx`, accelerator
 `range_push/range_pop`). On TPU these map to `jax.profiler` trace
-annotations, which show up in xprof/TensorBoard traces."""
+annotations, which show up in xprof/TensorBoard traces.
 
+Import-guarded: when `jax.profiler.TraceAnnotation` is unavailable (minimal
+environments, stripped jax builds) every entry point is a hard no-op, so the
+telemetry span layer (`telemetry/spans.py`) stays safe to call anywhere."""
+
+import contextlib
 import functools
 
-import jax
+try:
+    import jax
+    _TraceAnnotation = jax.profiler.TraceAnnotation
+except Exception:          # pragma: no cover - depends on the environment
+    _TraceAnnotation = None
 
 # LIFO of open ranges so range_pop() matches the reference accelerator API
 # (`accelerator/abstract_accelerator.py` range_pop takes no arguments).
@@ -14,7 +23,9 @@ _RANGE_STACK = []
 
 def range_push(msg):
     """Start a named range (reference accelerator.range_push)."""
-    t = jax.profiler.TraceAnnotation(msg)
+    if _TraceAnnotation is None:
+        return None
+    t = _TraceAnnotation(msg)
     t.__enter__()
     _RANGE_STACK.append(t)
     return t
@@ -39,16 +50,21 @@ def range_pop(t=None):
 
 def instrument_w_nvtx(func):
     """Decorator: wrap `func` in a named profiler range (reference
-    `utils/nvtx.py:instrument_w_nvtx`)."""
+    `utils/nvtx.py:instrument_w_nvtx`); returns `func` unchanged when the
+    profiler is unavailable."""
+    if _TraceAnnotation is None:
+        return func
 
     @functools.wraps(func)
     def wrapped(*args, **kwargs):
-        with jax.profiler.TraceAnnotation(func.__qualname__):
+        with _TraceAnnotation(func.__qualname__):
             return func(*args, **kwargs)
 
     return wrapped
 
 
 def annotate(name):
-    """Context manager for a named trace range."""
-    return jax.profiler.TraceAnnotation(name)
+    """Context manager for a named trace range (null when unavailable)."""
+    if _TraceAnnotation is None:
+        return contextlib.nullcontext()
+    return _TraceAnnotation(name)

@@ -56,6 +56,12 @@ def pytest_configure(config):
                    "reuse across serving requests) — fast and "
                    "CPU-harness-safe, rides in tier-1; run it alone with "
                    "pytest -m prefix_cache)")
+    config.addinivalue_line(
+        "markers", "telemetry: unified telemetry suite "
+                   "(tests/test_telemetry.py — metrics registry, TTFT/TPOT "
+                   "histograms, MFU accounting, exporters, dstpu_metrics) — "
+                   "fast and CPU-harness-safe, rides in tier-1; run it "
+                   "alone with pytest -m telemetry)")
 
 
 # The slow tier, by measured duration (r5 full-suite run with --durations,

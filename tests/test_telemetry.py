@@ -1,0 +1,466 @@
+"""Unified telemetry (deepspeed_tpu/telemetry/): metrics registry units,
+exporter golden output, ServingEngine TTFT/TPOT on a mixed trace, train-lane
+MFU accounting, monitor bridge + never-die, dstpu_metrics round-trip.
+
+Everything rides the `telemetry` marker (tier-1; run alone with
+`pytest -m telemetry`).
+"""
+
+import json
+import types
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.config.core import MeshConfig, TelemetryConfig
+from deepspeed_tpu.inference.engine import init_inference
+from deepspeed_tpu.inference.scheduler import Request
+from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_decode_model, \
+    make_gpt_model
+from deepspeed_tpu.telemetry import (Histogram, JsonlExporter,
+                                     MetricsRegistry, MonitorBridge,
+                                     PrometheusFileExporter, Telemetry,
+                                     prometheus_text)
+from deepspeed_tpu.telemetry.cli import load_latest, main as metrics_main
+
+pytestmark = pytest.mark.telemetry
+
+TINY = GPTConfig(n_layer=2, n_head=4, d_model=64, max_seq_len=256,
+                 vocab_size=256, dtype=jnp.float32, remat=False)
+
+
+def _mk_mesh(**axes):
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    return mesh_mod.init_mesh(MeshConfig(**{**dict(data=1, tensor=1,
+                                                   sequence=1, expert=1,
+                                                   pipe=1), **axes}))
+
+
+def _mk_serving_engine(tmp_path, telemetry=True, **tcfg):
+    _mk_mesh(data=1)
+    spec = make_gpt_decode_model(cfg=TINY, name="tiny")
+    cfg = {"dtype": "float32", "kv_cache_dtype": "float32", "greedy": True,
+           "kv_block_size": 16, "max_out_tokens": 64}
+    if telemetry:
+        cfg["telemetry"] = {"enabled": True, "output_path": str(tmp_path),
+                            "export_interval": 4, **tcfg}
+    return init_inference(model=spec, config=cfg)
+
+
+# ----------------------------------------------------------------------
+# registry units
+# ----------------------------------------------------------------------
+
+
+def test_histogram_bucket_and_percentile_math():
+    h = Histogram("t")
+    vals = [1.0, 2.0, 3.0, 10.0, 100.0, 1000.0]
+    for v in vals:
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 6
+    assert snap["sum"] == pytest.approx(sum(vals))
+    assert snap["mean"] == pytest.approx(sum(vals) / 6)
+    assert snap["min"] == 1.0 and snap["max"] == 1000.0
+    # log-bucket interpolation: p50 lands between the 3rd and 4th value
+    assert 3.0 <= snap["p50"] <= 10.0
+    assert snap["p50"] <= snap["p90"] <= snap["p99"] <= snap["max"]
+    # quantiles clamp to the observed range
+    assert h.quantile(0.0) >= snap["min"]
+    assert h.quantile(1.0) <= snap["max"]
+    # out-of-range observations land in the edge buckets, never lost
+    h.observe(1e-9)
+    h.observe(1e12)
+    assert h.count == 8 == sum(h.counts)
+    assert h.cumulative_buckets()[-1] == (float("inf"), 8)
+
+
+def test_histogram_empty_and_single():
+    h = Histogram("t")
+    assert h.snapshot() == {"type": "histogram", "count": 0, "sum": 0.0,
+                            "mean": 0.0, "min": 0.0, "max": 0.0, "p50": 0.0,
+                            "p90": 0.0, "p99": 0.0}
+    h.observe(42.0)
+    s = h.snapshot()
+    assert s["p50"] == s["p99"] == s["min"] == s["max"] == 42.0
+
+
+def test_registry_snapshot_deterministic():
+    def build():
+        r = MetricsRegistry()
+        r.gauge("z/gauge").set(3)
+        r.counter("a/count").inc(2)
+        h = r.histogram("m/lat_ms")
+        for v in (5, 50, 500):
+            h.observe(v)
+        return r
+
+    r1, r2 = build(), build()
+    assert r1.snapshot() == r2.snapshot()
+    # name-sorted iteration order regardless of creation order
+    assert [n for n, _ in r1.metrics()] == ["a/count", "m/lat_ms", "z/gauge"]
+    # type conflicts are errors, not silent coercions
+    with pytest.raises(TypeError):
+        r1.counter("z/gauge")
+
+
+def test_registry_get_or_create_identity():
+    r = MetricsRegistry()
+    assert r.histogram("h") is r.histogram("h")
+    r.counter("c").inc()
+    r.counter("c").inc()
+    assert r.snapshot()["c"]["value"] == 2.0
+
+
+# ----------------------------------------------------------------------
+# exporters: golden output
+# ----------------------------------------------------------------------
+
+
+def _golden_registry():
+    r = MetricsRegistry()
+    r.counter("serving/requests").inc(3)
+    r.gauge("serving/queue_depth").set(2.5)
+    h = r.histogram("serving/ttft_ms", bounds=[1.0, 10.0, 100.0])
+    for v in (0.5, 5.0, 50.0, 5000.0):
+        h.observe(v)
+    return r
+
+
+def test_prometheus_golden():
+    expected = "\n".join([
+        "# TYPE serving_queue_depth gauge",
+        "serving_queue_depth 2.5",
+        "# TYPE serving_requests_total counter",
+        "serving_requests_total 3",
+        "# TYPE serving_ttft_ms histogram",
+        'serving_ttft_ms_bucket{le="1"} 1',
+        'serving_ttft_ms_bucket{le="10"} 2',
+        'serving_ttft_ms_bucket{le="100"} 3',
+        'serving_ttft_ms_bucket{le="+Inf"} 4',
+        "serving_ttft_ms_sum 5055.5",
+        "serving_ttft_ms_count 4",
+    ]) + "\n"
+    assert prometheus_text(_golden_registry()) == expected
+
+
+def test_prometheus_file_exporter_atomic(tmp_path):
+    path = tmp_path / "m.prom"
+    exp = PrometheusFileExporter(path)
+    exp.export(_golden_registry())
+    assert path.read_text() == prometheus_text(_golden_registry())
+    assert not (tmp_path / "m.prom.tmp").exists()
+
+
+def test_jsonl_exporter_golden_roundtrip(tmp_path):
+    path = tmp_path / "m.jsonl"
+    exp = JsonlExporter(path)
+    reg = _golden_registry()
+    exp.export(reg, step=7)
+    exp.export(reg, step=8)
+    exp.close()
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) == 2
+    rec = json.loads(lines[-1])
+    assert rec["step"] == 8
+    assert rec["metrics"] == reg.snapshot()
+
+
+def test_dstpu_metrics_cli_json_roundtrip(tmp_path, capsys):
+    reg = _golden_registry()
+    JsonlExporter(tmp_path / "serving.jsonl").export(reg, step=11)
+    # dir resolution + --json round-trips the exact snapshot
+    assert metrics_main([str(tmp_path), "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["step"] == 11 and rec["metrics"] == reg.snapshot()
+    # table mode renders every metric name
+    assert metrics_main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    for name in reg.snapshot():
+        assert name in out
+    # missing log -> nonzero exit
+    assert metrics_main([str(tmp_path / "nope")]) == 1
+
+
+# ----------------------------------------------------------------------
+# monitor bridge + never-die
+# ----------------------------------------------------------------------
+
+
+def test_monitor_bridge_flattens_and_never_dies(tmp_path):
+    events = []
+    good = types.SimpleNamespace(
+        enabled=True, write_events=lambda evs: events.extend(evs))
+    reg = _golden_registry()
+    MonitorBridge(good).export(reg, step=5)
+    tags = {t for t, _v, _s in events}
+    assert ("serving/ttft_ms/p50" in tags and "serving/ttft_ms/p99" in tags
+            and "serving/ttft_ms/count" in tags)
+    assert ("serving/queue_depth", 2.5, 5) in events
+    # a monitor that throws (dropped wandb network) must not crash the caller
+    def boom(_evs):
+        raise OSError("network down")
+    bad = types.SimpleNamespace(enabled=True, write_events=boom)
+    MonitorBridge(bad).export(reg, step=6)     # does not raise
+
+
+def test_write_events_safe_aliases():
+    from deepspeed_tpu.monitor import monitor as M
+    assert M.write_recovery_events is M.write_events_safe
+    assert M.write_serving_events is M.write_events_safe
+    M.write_events_safe(None, [("a", 1.0, 0)])          # no monitor: no-op
+    def boom(_evs):
+        raise RuntimeError("die")
+    M.write_events_safe(types.SimpleNamespace(enabled=True,
+                                              write_events=boom),
+                        [("a", 1.0, 0)])                # guarded
+
+
+def test_csv_monitor_caches_handles(tmp_path):
+    from deepspeed_tpu.monitor.monitor import CsvMonitor
+    cfg = types.SimpleNamespace(enabled=True, output_path=str(tmp_path),
+                                job_name="job")
+    m = CsvMonitor(cfg)
+    m.write_events([("Train/loss", 1.0, 0), ("Train/lr", 0.1, 0)])
+    m.write_events([("Train/loss", 0.5, 1)])
+    assert set(m._files) == {"Train/loss", "Train/lr"}   # one handle per tag
+    f_loss = m._files["Train/loss"][0]
+    m.write_events([("Train/loss", 0.25, 2)])
+    assert m._files["Train/loss"][0] is f_loss           # handle reused
+    rows = (tmp_path / "job" / "Train_loss.csv").read_text().strip() \
+        .splitlines()
+    assert len(rows) == 4 and rows[0].startswith("step")  # header + 3 rows
+    m.close()
+    assert f_loss.closed and m._files == {}
+    m.close()                                            # idempotent
+
+
+def test_record_events_routes_ms_to_histograms(tmp_path):
+    t = Telemetry(TelemetryConfig(enabled=True, output_path=str(tmp_path),
+                                  prometheus=False, jsonl=False))
+    for ms in (10.0, 20.0, 40.0):
+        t.record_events([("Checkpoint/save_ms", ms, 1),
+                         ("Checkpoint/bytes", 1024.0, 1)])
+    snap = t.registry.snapshot()
+    assert snap["Checkpoint/save_ms"]["type"] == "histogram"
+    assert snap["Checkpoint/save_ms"]["count"] == 3
+    assert snap["Checkpoint/bytes"] == {"type": "gauge", "value": 1024.0}
+
+
+def test_ckpt_saver_emit_routes_through_telemetry(tmp_path):
+    from deepspeed_tpu.checkpoint.saver import _emit_ckpt_events
+    telem = Telemetry(TelemetryConfig(enabled=True,
+                                      output_path=str(tmp_path),
+                                      prometheus=False, jsonl=False))
+    fake_engine = types.SimpleNamespace(monitor=None, telemetry=telem)
+    _emit_ckpt_events(fake_engine, [("Checkpoint/save_ms", 12.5, 3)])
+    assert telem.registry.snapshot()["Checkpoint/save_ms"]["count"] == 1
+    # engines without a telemetry attribute (hybrid/inference) stay safe
+    _emit_ckpt_events(types.SimpleNamespace(monitor=None),
+                      [("Checkpoint/save_ms", 1.0, 0)])
+
+
+# ----------------------------------------------------------------------
+# spans + nvtx guard
+# ----------------------------------------------------------------------
+
+
+def test_span_chrome_trace_sink(tmp_path):
+    t = Telemetry(TelemetryConfig(enabled=True, output_path=str(tmp_path),
+                                  prometheus=False, jsonl=False,
+                                  chrome_trace=True), subsystem="sched")
+    with t.span("serving/admit"):
+        pass
+    with t.span("serving/decode_window"):
+        pass
+    t.close()
+    body = (tmp_path / "sched.trace.json").read_text()
+    assert body.startswith("[")
+    events = [json.loads(ln.rstrip(",")) for ln in
+              body.strip().splitlines()[1:]]
+    assert [e["name"] for e in events] == ["serving/admit",
+                                           "serving/decode_window"]
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in events)
+
+
+def test_disabled_telemetry_is_total_noop(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    t = Telemetry(TelemetryConfig(output_path="telemetry"))   # enabled=False
+    assert not t.enabled
+    t.observe("x_ms", 1.0)
+    t.inc("c")
+    t.set_gauge("g", 1.0)
+    t.record_events([("a_ms", 1.0, 0)])
+    with t.span("region"):
+        pass
+    t.maybe_export(1)
+    t.close()
+    assert t.registry.snapshot() == {}
+    assert list(tmp_path.iterdir()) == []                 # nothing written
+    assert Telemetry(None).enabled is False               # no config at all
+
+
+def test_registry_only_config_writes_no_dir(tmp_path):
+    # the bench lanes' configuration: enabled, every file sink off — the
+    # registry records but no output directory may appear
+    out = tmp_path / "tel"
+    t = Telemetry(TelemetryConfig(enabled=True, output_path=str(out),
+                                  prometheus=False, jsonl=False,
+                                  monitor_bridge=False))
+    t.observe("x_ms", 1.0)
+    t.export(step=1)
+    t.close()
+    assert not out.exists()
+
+
+def test_close_flushes_final_export(tmp_path):
+    # a run shorter than export_interval must still land in the files
+    t = Telemetry(TelemetryConfig(enabled=True, output_path=str(tmp_path),
+                                  export_interval=1000), subsystem="m")
+    t.observe("lat_ms", 5.0)
+    t.maybe_export(3)                       # 3 % 1000 != 0: nothing yet
+    assert not (tmp_path / "m.jsonl").exists()
+    t.close()
+    rec = load_latest(tmp_path / "m.jsonl")
+    assert rec["metrics"]["lat_ms"]["count"] == 1
+    t.close()                               # idempotent
+
+
+def test_chrome_trace_fresh_file_per_run(tmp_path):
+    from deepspeed_tpu.telemetry.spans import ChromeTraceSink, span
+    path = tmp_path / "t.trace.json"
+    for run in range(2):
+        sink = ChromeTraceSink(path)
+        with span(f"run{run}", sink=sink):
+            pass
+        sink.close()
+    body = path.read_text()
+    # the second sink truncated: one run, one timeline, no stale events
+    assert '"run1"' in body and '"run0"' not in body
+
+
+def test_nvtx_hard_noop_without_profiler(monkeypatch):
+    from deepspeed_tpu.utils import nvtx
+    monkeypatch.setattr(nvtx, "_TraceAnnotation", None)
+    assert nvtx.range_push("r") is None
+    nvtx.range_pop()                                      # empty stack: no-op
+    with nvtx.annotate("region"):
+        pass
+
+    @nvtx.instrument_w_nvtx
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+
+
+# ----------------------------------------------------------------------
+# ServingEngine: TTFT/TPOT on a mixed trace
+# ----------------------------------------------------------------------
+
+
+def test_serving_latency_histograms_mixed_trace(tmp_path):
+    engine = _mk_serving_engine(tmp_path, export_interval=4)
+    serving = engine.serving(max_slots=4, max_context=128)
+    rng = np.random.default_rng(0)
+    shapes = [(5, 4), (30, 8), (17, 3), (50, 6), (9, 5), (23, 7)]
+    reqs = [Request(uid=i, tokens=rng.integers(0, 256, (L,)).astype(np.int32),
+                    max_new_tokens=n, stop_on_eos=False)
+            for i, (L, n) in enumerate(shapes)]
+    done = serving.run(reqs)
+    assert len(done) == len(reqs)
+
+    # monotone per-request timestamps: arrival -> admission -> first token
+    # (strictly after admission: prefill must run first) -> finish
+    for r in done.values():
+        t = r.timing
+        assert t["arrival"] <= t["admit"] < t["first_token"] <= t["finish"]
+
+    lat = serving.latency_snapshot()
+    assert set(lat) == {"ttft_ms", "tpot_ms", "queue_wait_ms", "e2e_ms"}
+    assert lat["ttft_ms"]["count"] == len(reqs)
+    assert lat["e2e_ms"]["count"] == len(reqs)
+    assert lat["queue_wait_ms"]["count"] == len(reqs)
+    # every request here generates > 1 token, so each lands one TPOT sample
+    assert lat["tpot_ms"]["count"] == len(reqs)
+    assert 0 < lat["ttft_ms"]["p50"] <= lat["ttft_ms"]["p99"]
+    assert 0 < lat["tpot_ms"]["p50"] <= lat["tpot_ms"]["p99"]
+    assert lat["queue_wait_ms"]["min"] >= 0
+    # TTFT covers at least the queue wait for every request
+    assert lat["e2e_ms"]["max"] >= lat["ttft_ms"]["min"]
+    assert "latency" in serving.stats()
+
+    # gauges settle at drained values; the export interval produced files
+    snap = serving.telemetry.registry.snapshot()
+    assert snap["serving/queue_depth"]["value"] == 0
+    assert snap["serving/active_slots"]["value"] == 0
+    assert (tmp_path / "serving.jsonl").exists()
+    assert (tmp_path / "serving.prom").exists()
+    assert load_latest(tmp_path)["metrics"].keys() == snap.keys()
+
+
+def test_serving_disabled_default_unchanged(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    engine = _mk_serving_engine(tmp_path, telemetry=False)
+    serving = engine.serving(max_slots=2, max_context=128)
+    rng = np.random.default_rng(0)
+    reqs = [Request(uid=i, tokens=rng.integers(0, 256, (9,)).astype(np.int32),
+                    max_new_tokens=3, stop_on_eos=False) for i in range(3)]
+    done = serving.run(reqs)
+    # contract: compile_stats unchanged, results carry no timing, stats()
+    # grows no latency block, and NO files appear anywhere
+    assert serving.compile_stats() == {"decode_step": 1, "prefill_step": 1}
+    assert all(r.timing is None for r in done.values())
+    assert "latency" not in serving.stats()
+    assert serving.latency_snapshot() == {}
+    assert list(tmp_path.iterdir()) == []
+
+
+# ----------------------------------------------------------------------
+# train lane: MFU accounting
+# ----------------------------------------------------------------------
+
+
+def test_train_step_telemetry_mfu(tmp_path):
+    _mk_mesh(data=-1)
+    model = make_gpt_model(cfg=TINY, name="tiny")
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 10**9,
+        "telemetry": {"enabled": True, "output_path": str(tmp_path),
+                      "export_interval": 1}})
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 256, (engine.train_batch_size(), 33)) \
+        .astype(np.int32)
+    batch = {"tokens": toks[:, :-1], "labels": toks[:, 1:]}
+    steps = 3
+    for _ in range(steps):
+        engine.train_batch(batch)
+
+    snap = engine.telemetry.registry.snapshot()
+    mfu = snap["train/mfu"]["value"]
+    assert 0.0 < mfu <= 1.0                   # achieved MFU is a fraction
+    assert snap["train/step_time_ms"]["count"] == steps
+    assert snap["train/step_time_ms"]["p50"] > 0
+    assert snap["train/tokens_per_sec"]["value"] > 0
+    assert snap["train/tflops_per_chip"]["value"] > 0
+    # program flops measured exactly once, reused across steps
+    assert engine._program_flops is not None and engine._program_flops > 0
+    rec = load_latest(tmp_path / "train.jsonl")
+    assert rec is not None and "train/mfu" in rec["metrics"]
+
+
+def test_train_peak_flops_override(tmp_path):
+    t = Telemetry(TelemetryConfig(enabled=True, output_path=str(tmp_path),
+                                  prometheus=False, jsonl=False,
+                                  peak_tflops=100.0))
+    assert t.peak_flops() == pytest.approx(100e12)
+    t2 = Telemetry(TelemetryConfig(enabled=True, output_path=str(tmp_path),
+                                   prometheus=False, jsonl=False))
+    assert t2.peak_flops() > 0                # auto table fallback
