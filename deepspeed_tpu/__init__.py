@@ -14,6 +14,7 @@ from deepspeed_tpu.config.core import TpuTrainConfig
 from deepspeed_tpu.runtime.engine import Engine, initialize
 from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
 from deepspeed_tpu.inference.scheduler import Request, ServingEngine
+from deepspeed_tpu.serving import ServingRouter
 from deepspeed_tpu import comm
 from deepspeed_tpu import zero
 from deepspeed_tpu.utils.logging import logger, log_dist

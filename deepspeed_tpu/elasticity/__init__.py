@@ -9,3 +9,7 @@ from deepspeed_tpu.elasticity.elastic_agent import (
     AgentSpec,
     MembershipChanged,
 )
+from deepspeed_tpu.elasticity.restart_policy import (
+    RestartBudget,
+    RestartPolicy,
+)

@@ -21,13 +21,13 @@ NaNs (bad_state) — the latter restarting forever would burn the pod on a bug.
 """
 
 import inspect
-import random
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
 from deepspeed_tpu.elasticity.elasticity import (compute_elastic_config,
                                                  ElasticityIncompatibleWorldSize)
+from deepspeed_tpu.elasticity.restart_policy import RestartBudget, RestartPolicy
 from deepspeed_tpu.runtime.sentinel import BadStateError
 from deepspeed_tpu.utils.logging import logger
 
@@ -90,11 +90,35 @@ class ElasticAgent:
 
     def __init__(self, spec: AgentSpec):
         self.spec = spec
-        self.restarts = 0
-        self.restart_causes: Dict[str, int] = {c: 0 for c in RestartCause.ALL}
-        self.last_cause: Optional[str] = None
+        # budget/backoff live in the shared RestartBudget (restart_policy.py);
+        # the agent keeps its historical surface (`restarts`,
+        # `restart_causes`, `last_cause`) as views onto it
+        self.budget = RestartBudget(RestartPolicy(
+            max_restarts=spec.max_restarts,
+            base_backoff_s=spec.restart_backoff_s,
+            backoff_factor=spec.backoff_factor,
+            max_backoff_s=spec.max_backoff_s,
+            jitter=spec.backoff_jitter,
+            per_cause=dict(spec.max_restarts_per_cause)))
+        self.budget.causes.update({c: 0 for c in RestartCause.ALL})
         self.last_resume_tag: Optional[str] = None
         self._run_fn_takes_tag = self._accepts_resume_tag(spec.run_fn)
+
+    @property
+    def restarts(self) -> int:
+        return self.budget.restarts
+
+    @restarts.setter
+    def restarts(self, n: int):
+        self.budget.restarts = n
+
+    @property
+    def restart_causes(self) -> Dict[str, int]:
+        return self.budget.causes
+
+    @property
+    def last_cause(self) -> Optional[str]:
+        return self.budget.last_cause
 
     @staticmethod
     def _accepts_resume_tag(fn):
@@ -133,27 +157,19 @@ class ElasticAgent:
         return tag
 
     def _backoff_delay(self):
-        base = self.spec.restart_backoff_s
-        if base <= 0:
-            return 0.0
-        delay = min(base * (self.spec.backoff_factor ** max(self.restarts - 1, 0)),
-                    self.spec.max_backoff_s)
-        return delay * (1.0 + self.spec.backoff_jitter * random.random())
+        return self.budget.next_delay()
 
     def _consume_restart(self, cause):
-        self.restarts += 1
-        self.last_cause = cause
-        self.restart_causes[cause] = self.restart_causes.get(cause, 0) + 1
+        ok = self.budget.consume(cause)
         self._emit_restart_events()
-        budget = self.spec.max_restarts_per_cause.get(cause)
-        if budget is not None and self.restart_causes[cause] > budget:
-            logger.error(f"elastic agent: restart budget for cause "
-                         f"'{cause}' exhausted ({budget})")
-            return False
-        if self.restarts > self.spec.max_restarts:
-            logger.error("elastic agent: global restart budget exhausted")
-            return False
-        return True
+        if not ok:
+            cap = self.spec.max_restarts_per_cause.get(cause)
+            if cap is not None and self.restart_causes[cause] > cap:
+                logger.error(f"elastic agent: restart budget for cause "
+                             f"'{cause}' exhausted ({cap})")
+            else:
+                logger.error("elastic agent: global restart budget exhausted")
+        return ok
 
     def _emit_restart_events(self):
         from deepspeed_tpu.monitor.monitor import write_recovery_events

@@ -37,6 +37,7 @@ would otherwise fail. Caching therefore never reduces usable capacity.
 import collections
 from typing import List, Optional
 
+import jax
 import jax.numpy as jnp
 
 TRASH_BLOCK = 0  # physical block 0: write sink for inactive slots
@@ -196,6 +197,59 @@ def blocks_needed(prompt_len: int, padded_prompt: int, max_new: int,
     max_written_pos for the write-extent reasoning)."""
     return max_written_pos(prompt_len, padded_prompt, max_new,
                            window) // block_size + 1
+
+
+def _transplant_jit(src_pool, src_idx, dst_pool, dst_idx):
+    def copy_leaf(dst_leaf, src_leaf):
+        return dst_leaf.at[:, dst_idx].set(
+            jnp.take(src_leaf, src_idx, axis=1))
+    return jax.tree_util.tree_map(copy_leaf, dst_pool, src_pool)
+
+
+# destination donated: XLA aliases the scatter in place instead of copying
+# the whole (potentially multi-GB) pool per handoff; the caller re-binds
+# `engine.pool` to the result, exactly like the serving step programs
+_transplant_jit = jax.jit(_transplant_jit, donate_argnums=(2,))
+
+
+def transplant_blocks(src_pool, src_blocks, dst_pool, dst_blocks,
+                      pad_to: Optional[int] = None):
+    """Copy physical KV blocks across two pools — the prefill->decode
+    handoff primitive (`deepspeed_tpu/serving/`): a slot prefilled on one
+    engine replica moves into another replica's pool by copying just its
+    blocks and rebuilding the block table there.
+
+    The paged layout makes this a block-indexed gather: every pool leaf is
+    ``[L, num_blocks, ...]`` (axis 1 is the physical-block axis — the
+    `init_paged_kv_pool` contract), so the copy is one `take` along axis 1
+    per leaf scattered into the destination's block slots, jitted with the
+    destination DONATED so the update aliases in place. `pad_to` pins the
+    index width (pad entries copy trash->trash, whose content is garbage
+    by contract): pass the destination's table width so every handoff
+    shares ONE compiled copy program instead of one per block count.
+
+    Returns the updated destination pool (the caller re-binds
+    `engine.pool`; the old buffer is donated/dead). Both pools must share
+    leaf structure, block size, and dtype; the trash block is never a
+    legal source or destination for REAL entries.
+    """
+    assert len(src_blocks) == len(dst_blocks), \
+        f"transplant width mismatch: {len(src_blocks)} vs {len(dst_blocks)}"
+    assert TRASH_BLOCK not in src_blocks and TRASH_BLOCK not in dst_blocks, \
+        "transplant of the trash block"
+    for d, s in zip(jax.tree_util.tree_leaves(dst_pool),
+                    jax.tree_util.tree_leaves(src_pool)):
+        if d.dtype != s.dtype:
+            raise ValueError(f"pool dtype mismatch: {d.dtype} vs {s.dtype}")
+    if not src_blocks:
+        return dst_pool
+    src_blocks, dst_blocks = list(src_blocks), list(dst_blocks)
+    if pad_to is not None and pad_to > len(src_blocks):
+        pad = pad_to - len(src_blocks)
+        src_blocks += [TRASH_BLOCK] * pad
+        dst_blocks += [TRASH_BLOCK] * pad
+    return _transplant_jit(src_pool, jnp.asarray(src_blocks, jnp.int32),
+                           dst_pool, jnp.asarray(dst_blocks, jnp.int32))
 
 
 def gather_block_kv(pool_k_l, pool_v_l, block_tables):

@@ -93,6 +93,19 @@ class PrefixCache:
             blocks.append(b)
         return blocks
 
+    def match_len(self, hashes: Sequence[bytes]) -> int:
+        """Read-only longest-prefix LENGTH (in blocks) — the router's
+        affinity score (`deepspeed_tpu/serving/router.py`). Unlike `match`
+        it builds no block list and, like `match`, touches no refcounts and
+        moves nothing on the reclaimable LRU, so scoring N replicas per
+        request is free of side effects on every cache it probes."""
+        n = 0
+        for h in hashes:
+            if h not in self._by_hash:
+                break
+            n += 1
+        return n
+
     # ------------------------------------------------------------------
     # registration / eviction
     # ------------------------------------------------------------------

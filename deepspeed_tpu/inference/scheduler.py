@@ -45,9 +45,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.inference.kv_cache import (BlockAllocator, TRASH_BLOCK,
-                                              blocks_needed, max_written_pos)
+                                              blocks_needed, max_written_pos,
+                                              transplant_blocks)
 from deepspeed_tpu.telemetry import Telemetry
 from deepspeed_tpu.utils.logging import log_dist
+
+
+class InadmissibleRequestError(ValueError):
+    """The request can NEVER be admitted by this engine — the prompt plus
+    its generation budget exceeds `max_context`, or it needs more KV blocks
+    than the whole pool holds. Raised at submit() so an impossible request
+    fails fast instead of wedging the FIFO head forever; the serving router
+    catches it per replica to find one whose limits do fit."""
 
 
 @dataclasses.dataclass
@@ -66,7 +75,9 @@ class CompletedRequest:
     uid: Any
     prompt_len: int
     tokens: np.ndarray        # generated tokens; the EOS (if emitted) is kept
-    finish_reason: str        # "eos" | "length"
+    finish_reason: str        # "eos" | "length" | "cancelled" (withdrawn via
+                              # cancel() before finishing; router TTL/shedding
+                              # surfaces as this too)
     cached_prefix_tokens: int = 0  # prompt tokens whose KV came from the
                               # prefix cache (0 when caching is off/missed)
     timing: Optional[Dict[str, float]] = None  # telemetry only: monotonic
@@ -74,13 +85,14 @@ class CompletedRequest:
                               # (None when telemetry is disabled)
 
 
-_FREE, _PREFILL, _DECODE = 0, 1, 2
+_FREE, _PREFILL, _DECODE, _HANDOFF = 0, 1, 2, 3
 
 
 class _Slot:
     __slots__ = ("idx", "state", "uid", "prompt", "prompt_len", "padded_len",
                  "max_new", "eos", "blocks", "cursor", "pos", "emitted",
-                 "hashes", "reg", "cached", "t_arrive", "t_admit", "t_first")
+                 "hashes", "reg", "cached", "prefill_only",
+                 "t_arrive", "t_admit", "t_first")
 
     def __init__(self, idx):
         self.idx = idx
@@ -97,6 +109,8 @@ class _Slot:
         self.hashes = None      # prefix-cache hash chain (full prompt blocks)
         self.reg = 0            # blocks [0, reg) already registered/cached
         self.cached = 0         # blocks mapped from the cache at admission
+        self.prefill_only = False  # disaggregated serving: park in _HANDOFF
+                                # after the last chunk instead of decoding
         self.t_arrive = self.t_admit = self.t_first = None  # telemetry stamps
 
 
@@ -185,6 +199,9 @@ class ServingEngine:
         self.prefix_hit_tokens = 0
         self.tokens_generated = 0
         self.peak_active = 0
+        self.cancelled = 0                  # requests withdrawn via cancel()
+        self.handoffs_out = 0               # slots exported to a decode engine
+        self.handoffs_in = 0                # slots adopted from a prefill engine
 
         pool_mb = sum(x.size * x.dtype.itemsize
                       for x in jax.tree_util.tree_leaves(self.pool)) / 2**20
@@ -254,39 +271,75 @@ class ServingEngine:
     # request lifecycle
     # ------------------------------------------------------------------
 
-    def submit(self, request: Request):
-        """Queue a request. Raises if it can NEVER be admitted (it exceeds
-        the engine's max_context table width or the whole pool); a request
-        that merely doesn't fit *right now* waits in the queue
-        (admission backpressure). The prompt copy and sizing math happen
-        once, here — the admission loop re-reads the precomputed record
-        every step while backpressured."""
+    def check_admissible(self, prompt_len: int, max_new: int,
+                         prefill_only: bool = False, uid: Any = "?",
+                         padded_prompt: int = None) -> int:
+        """Sizing validation shared by submit() and the serving router's
+        replica scoring: raises `InadmissibleRequestError` when the request
+        can NEVER fit this engine (max_context table width, whole-pool
+        block budget), else returns the blocks it will occupy. A
+        `prefill_only` request never decodes here (its slot hands off to a
+        decode replica), so only the padded prompt counts — no decode-write
+        or window-rounding tail. `padded_prompt` overrides this engine's
+        own chunk-grid padding: a handoff TARGET adopts a slot padded on
+        the PREFILL replica's grid, so the router validates decode
+        replicas against that width, not their own."""
+        prompt_len = int(prompt_len)
+        max_new = int(max_new)
+        padded = (int(padded_prompt) if padded_prompt else
+                  -(-prompt_len // self.chunk) * self.chunk)
+        if prompt_len < 1:
+            raise InadmissibleRequestError(f"request {uid}: empty prompt")
+        if max_new < 1:
+            raise InadmissibleRequestError(
+                f"request {uid}: max_new_tokens < 1")
+        eff_new = 1 if prefill_only else max_new
+        eff_window = 1 if prefill_only else self.window
+        need = blocks_needed(prompt_len, padded, eff_new, self.block_size,
+                             window=eff_window)
+        if max_written_pos(prompt_len, padded, eff_new,
+                           eff_window) >= self.max_context:
+            raise InadmissibleRequestError(
+                f"request {uid}: prompt {prompt_len} + max_new "
+                f"{max_new} (window {eff_window}) exceeds max_context "
+                f"{self.max_context} (raise serving.max_context)")
+        if need > self.allocator.capacity:
+            raise InadmissibleRequestError(
+                f"request {uid}: needs {need} KV blocks, pool has "
+                f"{self.allocator.capacity} (raise serving.num_kv_blocks)")
+        return need
+
+    def submit(self, request: Request, prefill_only: bool = False,
+               hashes: Optional[List[bytes]] = None):
+        """Queue a request. Raises `InadmissibleRequestError` if it can
+        NEVER be admitted (it exceeds the engine's max_context table width
+        or the whole pool); a request that merely doesn't fit *right now*
+        waits in the queue (admission backpressure). The prompt copy and
+        sizing math happen once, here — the admission loop re-reads the
+        precomputed record every step while backpressured.
+
+        `prefill_only=True` is the disaggregated-serving entry: the slot
+        runs chunked prefill, samples its first token, then parks in a
+        handoff state (`export_handoff` / `adopt_handoff`) instead of
+        decoding — the router transplants its blocks into a decode
+        replica. `hashes` hands in a precomputed chain (the router hashes
+        once per request for affinity scoring; chains are
+        fingerprint-identical across a pool, so re-hashing per dispatch —
+        and again per failover re-dispatch — would be pure waste)."""
         prompt = np.asarray(request.tokens, np.int32).reshape(-1)
         prompt_len = int(prompt.shape[0])
         padded = -(-prompt_len // self.chunk) * self.chunk
-        max_new = int(request.max_new_tokens)
-        need = blocks_needed(prompt_len, padded, max_new, self.block_size,
-                             window=self.window)
-        if prompt_len < 1:
-            raise ValueError(f"request {request.uid}: empty prompt")
-        if max_new < 1:
-            raise ValueError(f"request {request.uid}: max_new_tokens < 1")
-        if max_written_pos(prompt_len, padded, max_new,
-                           self.window) >= self.max_context:
-            raise ValueError(
-                f"request {request.uid}: prompt {prompt_len} + max_new "
-                f"{max_new} (window {self.window}) exceeds max_context "
-                f"{self.max_context} (raise serving.max_context)")
-        if need > self.allocator.capacity:
-            raise ValueError(
-                f"request {request.uid}: needs {need} KV blocks, pool has "
-                f"{self.allocator.capacity} (raise serving.num_kv_blocks)")
+        need = self.check_admissible(prompt_len, request.max_new_tokens,
+                                     prefill_only=prefill_only,
+                                     uid=request.uid)
         # hash once at submit; the admission loop re-matches the chain every
         # step while backpressured (cache contents change between steps)
-        hashes = (self.prefix_cache.hash_chain(prompt)
-                  if self.prefix_cache is not None else None)
+        if self.prefix_cache is None:
+            hashes = None
+        elif hashes is None:
+            hashes = self.prefix_cache.hash_chain(prompt)
         self.queue.append((request, prompt, prompt_len, padded, need, hashes,
-                           time.monotonic()))
+                           time.monotonic(), prefill_only))
 
     def _resolve_eos(self, req: Request):
         if not req.stop_on_eos:
@@ -302,7 +355,7 @@ class ServingEngine:
         free = [s for s in self.slots if s.state == _FREE]
         while self.queue and free:
             (req, prompt, prompt_len, padded, need, hashes,
-             t_arrive) = self.queue[0]
+             t_arrive, prefill_only) = self.queue[0]
             hit = []
             if hashes:
                 # longest-prefix match, capped so at least the final prompt
@@ -357,6 +410,7 @@ class ServingEngine:
             slot.cached = len(hit)
             slot.pos = prompt_len
             slot.emitted = []
+            slot.prefill_only = prefill_only
             slot.t_arrive = t_arrive
             if self.telemetry.enabled:
                 slot.t_admit = time.monotonic()
@@ -418,6 +472,181 @@ class ServingEngine:
             finished.append(self._retire(slot, "length"))
 
     # ------------------------------------------------------------------
+    # cancellation + queue extraction (router TTL / failover build on these)
+    # ------------------------------------------------------------------
+
+    def cancel(self, uid, queued_only: bool = False) -> Optional[CompletedRequest]:
+        """Withdraw a request wherever it lives. A queued request is removed
+        before it ever touches a slot; an active one retires immediately —
+        its blocks freed/decref'd the same call, exactly like an EOS
+        retirement. Returns a `CompletedRequest` with
+        ``finish_reason="cancelled"`` (whatever tokens were already emitted
+        are kept), or None when `uid` is unknown — or still unstarted-only
+        under `queued_only=True`, the router-TTL mode that must never kill a
+        request already generating."""
+        for i, rec in enumerate(self.queue):
+            if rec[0].uid == uid:
+                del self.queue[i]
+                self.cancelled += 1
+                return CompletedRequest(uid=uid, prompt_len=rec[2],
+                                        tokens=np.zeros((0,), np.int32),
+                                        finish_reason="cancelled")
+        if queued_only:
+            return None
+        for slot in self.slots:
+            if slot.state != _FREE and slot.uid == uid:
+                self.cancelled += 1
+                return self._retire(slot, "cancelled")
+        return None
+
+    def drain_queued(self) -> List[Request]:
+        """Extract every queued-but-unstarted request, emptying the queue —
+        the router's failover path: a quarantined replica's waiting requests
+        are re-submitted elsewhere verbatim (they never touched this
+        engine's pool, so nothing needs freeing)."""
+        out = [rec[0] for rec in self.queue]
+        self.queue.clear()
+        return out
+
+    def active_uids(self) -> List[Any]:
+        """Uids currently occupying slots (prefilling, decoding, or parked
+        for handoff) — in-flight work that dies with the engine."""
+        return [s.uid for s in self.slots if s.state != _FREE]
+
+    # ------------------------------------------------------------------
+    # router surface: affinity scoring + load signals
+    # ------------------------------------------------------------------
+
+    def hash_chain(self, prompt) -> Optional[List[bytes]]:
+        """The prompt's chained block hashes (None when caching is off) —
+        computed once by the router and matched against every replica."""
+        if self.prefix_cache is None:
+            return None
+        return self.prefix_cache.hash_chain(
+            np.asarray(prompt, np.int32).reshape(-1))
+
+    def prefix_affinity(self, hashes) -> int:
+        """Longest registered prefix (in blocks) this engine already holds
+        for a prompt's hash chain — the router's affinity score. Read-only:
+        no refcounts move, no LRU entry is touched. 0 when caching is off."""
+        if self.prefix_cache is None or not hashes:
+            return 0
+        return self.prefix_cache.match_len(hashes)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    @property
+    def has_free_slot(self) -> bool:
+        return any(s.state == _FREE for s in self.slots)
+
+    # ------------------------------------------------------------------
+    # disaggregated prefill/decode: block handoff between engines
+    # ------------------------------------------------------------------
+
+    def handoff_ready(self) -> List[Any]:
+        """Uids of prefill-only slots whose prefill finished: their blocks
+        hold the full prompt KV and their first sampled token is emitted —
+        ready for `export_handoff` into a decode engine."""
+        return [s.uid for s in self.slots if s.state == _HANDOFF]
+
+    def export_handoff(self, uid) -> Dict[str, Any]:
+        """Snapshot a handoff-parked slot for transplant. The blocks stay
+        OWNED by this engine (refcounts untouched) until `release_handoff`
+        — the copy must complete before the source can be reclaimed, the
+        same protocol as the checkpoint saver's tmp->rename commit."""
+        slot = self._handoff_slot(uid)
+        # blocks the prefill cursor actually wrote: the padded prompt only
+        # (a prefill-only slot never decodes here, so no window tail)
+        n_used = (slot.padded_len - 1) // self.block_size + 1
+        return {"uid": slot.uid, "prompt": slot.prompt,
+                "prompt_len": slot.prompt_len, "padded_len": slot.padded_len,
+                "max_new": slot.max_new, "eos": slot.eos,
+                "emitted": list(slot.emitted), "pos": slot.pos,
+                "blocks": list(slot.blocks[:n_used]),
+                "cached": slot.cached, "t_arrive": slot.t_arrive,
+                "t_admit": slot.t_admit, "t_first": slot.t_first}
+
+    def adopt_handoff(self, state: Dict[str, Any], src_pool) -> bool:
+        """Adopt a prefilled slot exported by another engine: allocate the
+        full-lifetime blocks here, gather the prompt's KV blocks out of
+        `src_pool` into them (`transplant_blocks` — a block-indexed copy,
+        axis 1 of the pool layout), and seed a _DECODE slot that continues
+        from the first sampled token. Returns False when this engine has no
+        free slot or blocks RIGHT NOW (the router retries later — source
+        blocks are still held); raises `InadmissibleRequestError` when the
+        request can never fit here."""
+        need = blocks_needed(state["prompt_len"], state["padded_len"],
+                             state["max_new"], self.block_size,
+                             window=self.window)
+        if max_written_pos(state["prompt_len"], state["padded_len"],
+                           state["max_new"], self.window) >= self.max_context:
+            raise InadmissibleRequestError(
+                f"request {state['uid']}: handoff target max_context "
+                f"{self.max_context} too small (prompt {state['prompt_len']}"
+                f" + max_new {state['max_new']}, window {self.window})")
+        if need > self.allocator.capacity:
+            raise InadmissibleRequestError(
+                f"request {state['uid']}: handoff needs {need} KV blocks, "
+                f"decode pool has {self.allocator.capacity}")
+        free = [s for s in self.slots if s.state == _FREE]
+        if not free:
+            return False
+        blocks = self.allocator.alloc(need)
+        if blocks is None:
+            return False
+        n_src = len(state["blocks"])
+        try:
+            self.pool = transplant_blocks(src_pool, state["blocks"],
+                                          self.pool, blocks[:n_src],
+                                          pad_to=self.nb)
+        except Exception:
+            self.allocator.free(blocks)    # don't leak the reservation
+            raise
+        slot = free[-1]
+        slot.state = _DECODE
+        slot.uid = state["uid"]
+        slot.prompt = state["prompt"]
+        slot.prompt_len = state["prompt_len"]
+        slot.padded_len = state["padded_len"]
+        slot.max_new = state["max_new"]
+        slot.eos = state["eos"]
+        slot.blocks = blocks
+        slot.cursor = state["padded_len"]
+        slot.pos = state["pos"]
+        slot.emitted = list(state["emitted"])
+        slot.hashes = None          # adopted blocks stay private: this pool
+        slot.reg = 0                # never registers them (the prefill
+        slot.cached = state["cached"]  # replica's cache owns the prefix)
+        # carry the PREFILL replica's stamps: TTFT/TPOT must measure from
+        # the real first token, not from adoption time (a parked slot would
+        # otherwise report an inflated, decode-attributed TTFT)
+        slot.t_arrive = state["t_arrive"]
+        slot.t_admit = state.get("t_admit")
+        slot.t_first = state.get("t_first")
+        self.tables[slot.idx, :] = TRASH_BLOCK
+        self.tables[slot.idx, :len(blocks)] = blocks
+        self.handoffs_in += 1
+        return True
+
+    def release_handoff(self, uid):
+        """Free the source side of a completed transplant: decref the
+        slot's blocks (registered prefix blocks park reclaimable and stay
+        matchable for affinity) and recycle the slot."""
+        slot = self._handoff_slot(uid)
+        self.allocator.free(slot.blocks[::-1])
+        self.tables[slot.idx, :] = TRASH_BLOCK
+        slot.reset()
+        self.handoffs_out += 1
+
+    def _handoff_slot(self, uid) -> _Slot:
+        for s in self.slots:
+            if s.state == _HANDOFF and s.uid == uid:
+                return s
+        raise KeyError(f"no handoff-ready slot for request {uid!r}")
+
+    # ------------------------------------------------------------------
     # the engine step: admit -> prefill chunk(s) -> decode all slots
     # ------------------------------------------------------------------
 
@@ -465,7 +694,11 @@ class ServingEngine:
                                                    slot.blocks[i])
                     slot.reg = max(slot.reg, hi)
                 if final:
-                    slot.state = _DECODE
+                    # a prefill-only slot parks for handoff instead of
+                    # decoding; _emit may still retire it right here when
+                    # the first sampled token is EOS or max_new == 1 — the
+                    # router then sees a normal completion from this engine
+                    slot.state = _HANDOFF if slot.prefill_only else _DECODE
                     self._emit(slot, int(np.asarray(tok)[0]), finished)
 
         # decode: ONE fixed-shape call for every slot; non-decoding slots
@@ -546,6 +779,9 @@ class ServingEngine:
                "prefill_chunks": self.prefill_chunks,
                "tokens_generated": self.tokens_generated,
                "peak_active": self.peak_active,
+               "cancelled": self.cancelled,
+               "handoffs_in": self.handoffs_in,
+               "handoffs_out": self.handoffs_out,
                "queued": len(self.queue), "active": self.num_active,
                "free_blocks": self.allocator.num_free,
                "reclaimable_blocks": self.allocator.num_reclaimable,

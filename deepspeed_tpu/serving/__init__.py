@@ -1,0 +1,30 @@
+"""Distributed serving: a router over a pool of ServingEngine replicas.
+
+PRs 3-5 built ONE continuous-batching engine on ONE mesh; this package is
+the front-end layer that spreads production traffic over N data-parallel
+engine replicas (SURVEY §2.5/§3.4, §7 step 7 — InferenceEngine replicas over
+AutoTP shards):
+
+  * `ServingRouter` (`router.py`) — scores replicas per request on
+    prefix-cache AFFINITY (the PR 4 chained block hash is the affinity key),
+    LOAD (queue depth, active slots, free+reclaimable blocks) and HEALTH
+    (throwing replicas are quarantined, their work re-routed, restarts paced
+    by the shared `elasticity/restart_policy.py` budget); admission is
+    backpressure-aware (bounded global queue, shed-or-block, per-request
+    TTL);
+  * `ReplicaHandle` / `InProcessReplica` (`replica.py`) — the small protocol
+    the router drives, so a process- or host-separated backend can plug in
+    later without touching the routing logic;
+  * disaggregated prefill/decode — replicas tagged `role="prefill"` run
+    chunked prefill only and hand each slot's KV blocks to a
+    `role="decode"` replica (`kv_cache.transplant_blocks`), so long
+    prefills stop stalling decode TPOT.
+
+See docs/inference.md "Distributed serving".
+"""
+
+from deepspeed_tpu.serving.replica import InProcessReplica, ReplicaHandle
+from deepspeed_tpu.serving.router import RouterConfig, ServingRouter
+
+__all__ = ["ServingRouter", "RouterConfig", "ReplicaHandle",
+           "InProcessReplica"]

@@ -1,0 +1,216 @@
+"""Replica handles: the protocol the serving router drives.
+
+The router never touches a `ServingEngine` directly — it speaks this small
+surface, so the in-process pool built here (N engines in one process, the
+CPU-harness and single-host-pod case) can later be swapped for a
+process-separated or RPC backend replica-by-replica without changing one
+line of routing logic. Everything the router needs is here: submit/step/
+cancel, queue extraction for failover, the read-only affinity probe, load
+signals (queue depth / active slots / available blocks — the same
+quantities the PR 5 gauges export), and the prefill->decode handoff verbs.
+"""
+
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.inference.scheduler import (CompletedRequest, Request,
+                                               ServingEngine)
+
+
+class ReplicaHandle:
+    """Abstract replica surface. Implementations wrap one serving engine
+    (or a remote proxy to one). `replica_id` must be unique in a pool;
+    `role` is "mixed" (prefill+decode, the default), "prefill" or
+    "decode" (disaggregated serving)."""
+
+    replica_id: str = "?"
+    role: str = "mixed"
+
+    # -- request lifecycle ------------------------------------------------
+    def submit(self, request: Request, prefill_only: bool = False,
+               hashes=None):
+        raise NotImplementedError
+
+    def step(self) -> List[CompletedRequest]:
+        raise NotImplementedError
+
+    def cancel(self, uid, queued_only: bool = False) -> Optional[CompletedRequest]:
+        raise NotImplementedError
+
+    def drain_queued(self) -> List[Request]:
+        raise NotImplementedError
+
+    # -- routing signals --------------------------------------------------
+    def check_admissible(self, prompt_len: int, max_new: int,
+                         prefill_only: bool = False, uid: Any = "?",
+                         padded_prompt: int = None) -> int:
+        raise NotImplementedError
+
+    def progress(self) -> int:
+        """Monotone work counter (tokens + chunks + adoptions): the router's
+        cheap liveness probe — must not build a full stats()/telemetry
+        snapshot."""
+        raise NotImplementedError
+
+    @property
+    def prefill_chunk(self) -> int:
+        raise NotImplementedError
+
+    def affinity(self, hashes) -> int:
+        raise NotImplementedError
+
+    def hash_chain(self, prompt) -> Optional[List[bytes]]:
+        raise NotImplementedError
+
+    @property
+    def queue_depth(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def num_active(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def available_blocks(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def has_free_slot(self) -> bool:
+        raise NotImplementedError
+
+    # -- disaggregated handoff -------------------------------------------
+    def handoff_ready(self) -> List[Any]:
+        raise NotImplementedError
+
+    def export_handoff(self, uid) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def receive_handoff(self, state: Dict[str, Any], src_pool) -> bool:
+        raise NotImplementedError
+
+    def release_handoff(self, uid):
+        raise NotImplementedError
+
+    # -- health -----------------------------------------------------------
+    def restart(self):
+        raise NotImplementedError
+
+    @property
+    def can_restart(self) -> bool:
+        raise NotImplementedError
+
+    def stats(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def compile_stats(self) -> Dict[str, int]:
+        raise NotImplementedError
+
+
+class InProcessReplica(ReplicaHandle):
+    """A `ServingEngine` living in this process.
+
+    `engine` is the live engine; `factory` (optional, a zero-arg callable
+    returning a fresh `ServingEngine`) is what `restart()` uses to rebuild
+    after a quarantine — without one, a failed replica stays dead and the
+    pool shrinks (the router's restart budget then never fires for it). A
+    rebuilt engine recompiles its two step programs and starts with a cold
+    pool/prefix cache; affinity re-warms organically.
+    """
+
+    def __init__(self, engine: ServingEngine = None, factory=None,
+                 replica_id: str = "r0", role: str = "mixed"):
+        assert role in ("mixed", "prefill", "decode"), \
+            f"unknown replica role {role!r}"
+        if engine is None:
+            if factory is None:
+                raise ValueError("InProcessReplica needs an engine or a factory")
+            engine = factory()
+        self.engine = engine
+        self._factory = factory
+        self.replica_id = str(replica_id)
+        self.role = role
+
+    # -- request lifecycle ------------------------------------------------
+    def submit(self, request, prefill_only=False, hashes=None):
+        self.engine.submit(request, prefill_only=prefill_only, hashes=hashes)
+
+    def step(self):
+        return self.engine.step()
+
+    def cancel(self, uid, queued_only=False):
+        return self.engine.cancel(uid, queued_only=queued_only)
+
+    def drain_queued(self):
+        return self.engine.drain_queued()
+
+    # -- routing signals --------------------------------------------------
+    def check_admissible(self, prompt_len, max_new, prefill_only=False,
+                         uid="?", padded_prompt=None):
+        return self.engine.check_admissible(prompt_len, max_new,
+                                            prefill_only=prefill_only,
+                                            uid=uid,
+                                            padded_prompt=padded_prompt)
+
+    def progress(self):
+        e = self.engine
+        return e.tokens_generated + e.prefill_chunks + e.handoffs_in
+
+    @property
+    def prefill_chunk(self):
+        return self.engine.chunk
+
+    def affinity(self, hashes):
+        return self.engine.prefix_affinity(hashes)
+
+    def hash_chain(self, prompt):
+        return self.engine.hash_chain(prompt)
+
+    @property
+    def queue_depth(self):
+        return self.engine.queue_depth
+
+    @property
+    def num_active(self):
+        return self.engine.num_active
+
+    @property
+    def available_blocks(self):
+        return self.engine.allocator.available
+
+    @property
+    def has_free_slot(self):
+        return self.engine.has_free_slot
+
+    # -- disaggregated handoff -------------------------------------------
+    def handoff_ready(self):
+        return self.engine.handoff_ready()
+
+    def export_handoff(self, uid):
+        return self.engine.export_handoff(uid)
+
+    def receive_handoff(self, state, src_pool):
+        return self.engine.adopt_handoff(state, src_pool)
+
+    def release_handoff(self, uid):
+        self.engine.release_handoff(uid)
+
+    @property
+    def pool(self):
+        """The engine's paged KV pool — the handoff source buffer."""
+        return self.engine.pool
+
+    # -- health -----------------------------------------------------------
+    def restart(self):
+        if self._factory is None:
+            raise RuntimeError(
+                f"replica {self.replica_id}: no factory to rebuild from")
+        self.engine = self._factory()
+
+    @property
+    def can_restart(self):
+        return self._factory is not None
+
+    def stats(self):
+        return self.engine.stats()
+
+    def compile_stats(self):
+        return self.engine.compile_stats()

@@ -1,0 +1,697 @@
+"""ServingRouter: prefix-affinity routing, backpressure admission, failover.
+
+One `ServingEngine` (PR 3) is iteration-level scheduling on one mesh; this
+router is the layer above — it owns N engine replicas behind `ReplicaHandle`
+and decides, per request, WHERE to run it:
+
+  * **affinity** — the PR 4 prefix cache made KV blocks content-addressed
+    (chained block hashes seeded with the model's `cache_fingerprint`).
+    That chain is exactly a routing key: hash the prompt once, probe each
+    replica's cache read-only (`PrefixCache.match_len`), and prefer the
+    replica that already HOLDS the longest registered prefix — a shared
+    system prompt then prefills once per POOL, not once per replica;
+  * **load** — queue depth, active slots and free+reclaimable blocks (the
+    same quantities the PR 5 gauges export) push back: a saturated replica
+    loses to a cold one even against affinity (a counted "load spill");
+  * **health** — a replica whose step() throws (or that an operator kills)
+    is quarantined: its queued-but-unstarted requests are extracted and
+    its in-flight ones re-submitted from scratch elsewhere (greedy decoding
+    makes the rerun token-identical), and restarts are paced by the shared
+    `elasticity/restart_policy.py` budget — the same backoff/budget
+    machinery that supervises training restarts.
+
+Admission is backpressure-aware end to end: the router's own queue is
+BOUNDED (`max_pending`) with a shed-or-block policy, each request may carry
+a TTL that cancels it if still queued past deadline (built on
+`ServingEngine.cancel`), and dispatch into a replica defers while that
+replica's queue is deep — the request waits at the router where TTL and
+failover can still reach it cheaply.
+
+Disaggregated prefill/decode rides the same pool: replicas tagged
+`role="prefill"` run chunked prefill only; when a slot's prefill finishes,
+the router transplants its KV blocks into a `role="decode"`/`"mixed"`
+replica (`kv_cache.transplant_blocks` — a block-indexed gather) and decode
+continues there, so a long arriving prompt never stalls decode TPOT.
+
+Scoring formula (policy "affinity"):
+
+    score(r) = affinity_blocks(r) * affinity_weight
+               - (queue_depth(r) + active_slots(r)) * load_penalty
+               - block_penalty * [blocks_needed > available_blocks(r)]
+
+highest score wins; ties break toward the replica with the least pending
+work, then rotation order. `affinity_hits` counts dispatches whose winner
+held a non-zero prefix; `load_spills` counts dispatches where some OTHER
+replica held a strictly longer prefix but lost on load/saturation.
+"""
+
+import collections
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.elasticity.restart_policy import RestartBudget, RestartPolicy
+from deepspeed_tpu.inference.scheduler import (CompletedRequest,
+                                               InadmissibleRequestError,
+                                               Request, ServingEngine)
+from deepspeed_tpu.serving.replica import InProcessReplica, ReplicaHandle
+from deepspeed_tpu.telemetry import Telemetry
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    """Router policy knobs (replica-pool shape lives on the replicas)."""
+    max_pending: int = 256        # bounded ROUTER queue (dispatched requests
+                                  # don't count — each replica's own FIFO
+                                  # carries those)
+    admission_policy: str = "block"  # queue full: "block" drives the pool
+                                  # until room frees; "shed" completes the
+                                  # newcomer immediately with reason
+                                  # "cancelled" (counted as `shed`)
+    default_ttl_s: Optional[float] = None  # per-request deadline while
+                                  # QUEUED (router queue or replica queue);
+                                  # never kills a generating request
+    routing_policy: str = "affinity"  # "affinity" (scored) | "round_robin"
+    affinity_weight: float = 4.0  # score per matched prefix BLOCK
+    load_penalty: float = 1.0     # score per queued/active request
+    block_penalty: float = 8.0    # flat penalty when the replica cannot
+                                  # allocate the request's blocks right now
+    max_replica_queue: int = 8    # dispatch defers while the target's queue
+                                  # is this deep (router-side backpressure)
+    max_replica_restarts: int = 1  # per-replica quarantine restart budget
+    restart_backoff_s: float = 0.0  # base backoff before a replica restart
+    restart_backoff_factor: float = 2.0
+    restart_max_backoff_s: float = 60.0
+
+
+@dataclasses.dataclass
+class _Pending:
+    """Router-side record of one live (incomplete) request."""
+    request: Request
+    prompt_len: int
+    hashes: Optional[List[bytes]]
+    t_submit: float
+    deadline: Optional[float]
+    replica: Optional[str] = None   # None while queued at the router
+
+
+class ServingRouter:
+    """A pool of serving-engine replicas behind one submit/step/run front.
+
+    Build it from live engines (each wrapped into an `InProcessReplica`),
+    handles, or factories::
+
+        router = ServingRouter(replicas=[engine.serving(), engine.serving()],
+                               default_ttl_s=30)   # RouterConfig kwargs
+        router.submit(Request(uid=0, tokens=prompt, max_new_tokens=64))
+        while router.in_flight:
+            for done in router.step():
+                ...
+        # or batch-style: results = router.run(requests)  # {uid: Completed}
+
+    Replicas must serve the SAME model (enforced via `cache_fingerprint`
+    when prefix caching is on — affinity across different models would
+    transplant wrong KV) and share `kv_block_size` when disaggregated.
+    """
+
+    def __init__(self, replicas: Sequence = (), config: RouterConfig = None,
+                 telemetry_config=None, clock: Callable[[], float] = None,
+                 **overrides):
+        if config is None:
+            config = RouterConfig(**overrides)
+        elif overrides:
+            config = dataclasses.replace(config, **overrides)
+        self.config = config
+        assert config.admission_policy in ("block", "shed"), \
+            f"unknown admission_policy {config.admission_policy!r}"
+        assert config.routing_policy in ("affinity", "round_robin"), \
+            f"unknown routing_policy {config.routing_policy!r}"
+        self._clock = clock if clock is not None else time.monotonic
+        self.replicas: Dict[str, ReplicaHandle] = {}
+        self._quarantined: Dict[str, float] = {}   # rid -> earliest restart
+        self._dead: set = set()                    # budget exhausted
+        self._budgets: Dict[str, RestartBudget] = {}
+        self._restart_policy = RestartPolicy(
+            max_restarts=config.max_replica_restarts,
+            base_backoff_s=config.restart_backoff_s,
+            backoff_factor=config.restart_backoff_factor,
+            max_backoff_s=config.restart_max_backoff_s,
+            jitter=0.0)
+
+        self.queue = collections.deque()           # uids waiting at the router
+        self._pending: Dict[Any, _Pending] = {}    # every incomplete uid
+        self._done: set = set()
+        self._finished_buf: List[CompletedRequest] = []
+        self._rr = 0                               # rotation cursor
+        # anticipated affinity: hash chains DISPATCHED to a replica, before
+        # its prefill has registered the blocks. Without this, a whole wave
+        # of shared-prefix requests arriving in one step would scatter (no
+        # replica holds the prefix *yet*), and every replica would prefill
+        # the prefix once. Bounded LRU per replica; a stale entry (evicted
+        # at the replica) only costs a suboptimal route, never correctness.
+        self._anticipated: Dict[str, collections.OrderedDict] = {}
+        self._anticipated_cap = 4096
+        self.steps = 0
+        self.counters = {k: 0 for k in (
+            "submitted", "completed", "affinity_hits", "load_spills",
+            "reroutes", "ttl_cancelled", "shed", "replica_failures",
+            "replica_restarts", "handoffs")}
+        # rid -> router-level TTFT ms, a bounded sliding window (the full
+        # distribution lives in the telemetry histogram; this stays O(1))
+        self._ttft: Dict[str, collections.deque] = {}
+        self._ttft_window = 2048
+
+        self.telemetry = Telemetry(telemetry_config, subsystem="router")
+
+        for r in replicas:
+            self.add_replica(r)
+
+    # ------------------------------------------------------------------
+    # pool assembly
+    # ------------------------------------------------------------------
+
+    def add_replica(self, replica, role: str = None,
+                    replica_id: str = None, factory=None) -> ReplicaHandle:
+        """Add a replica: a `ReplicaHandle`, a live `ServingEngine` (wrapped
+        into an `InProcessReplica`), or a zero-arg factory returning one.
+        `factory` doubles as the restart recipe after a quarantine. `role`
+        (default "mixed") overrides an existing handle's role too when
+        given explicitly."""
+        if isinstance(replica, ReplicaHandle):
+            handle = replica
+            if replica_id is not None:
+                handle.replica_id = str(replica_id)
+            if role is not None:
+                assert role in ("mixed", "prefill", "decode"), \
+                    f"unknown replica role {role!r}"
+                handle.role = role
+        else:
+            rid = replica_id if replica_id is not None \
+                else f"r{len(self.replicas)}"
+            if isinstance(replica, ServingEngine):
+                handle = InProcessReplica(engine=replica, factory=factory,
+                                          replica_id=rid,
+                                          role=role or "mixed")
+            elif callable(replica):
+                handle = InProcessReplica(factory=replica, replica_id=rid,
+                                          role=role or "mixed")
+            else:
+                raise TypeError(f"cannot build a replica from {replica!r}")
+        rid = handle.replica_id
+        if rid in self.replicas:
+            raise ValueError(f"duplicate replica id {rid!r}")
+        self._check_pool_compat(handle)
+        self.replicas[rid] = handle
+        self._budgets[rid] = RestartBudget(self._restart_policy)
+        self._ttft[rid] = collections.deque(maxlen=self._ttft_window)
+        self._anticipated[rid] = collections.OrderedDict()
+        log_dist(f"serving router: +replica {rid} role={handle.role} "
+                 f"(pool: {len(self.replicas)})", ranks=[0])
+        return handle
+
+    def _check_pool_compat(self, handle):
+        """Same model (cache fingerprint) across the pool, same block size
+        when blocks can move between pools (disaggregated handoff)."""
+        if not isinstance(handle, InProcessReplica) or not self.replicas:
+            return
+        others = [r for r in self.replicas.values()
+                  if isinstance(r, InProcessReplica)]
+        if not others:
+            return
+        a, b = others[0].engine, handle.engine
+        fa = a.engine.model_spec.cache_fingerprint or a.engine.model_spec.name
+        fb = b.engine.model_spec.cache_fingerprint or b.engine.model_spec.name
+        if fa != fb:
+            raise ValueError(
+                f"replica {handle.replica_id} serves a different model "
+                f"({fb!r} vs {fa!r}): affinity routing and KV handoff "
+                f"require one model per pool")
+        if a.block_size != b.block_size:
+            raise ValueError(
+                f"replica {handle.replica_id}: kv_block_size {b.block_size} "
+                f"!= pool's {a.block_size} (blocks must transplant 1:1)")
+        da = str(a.config.kv_cache_dtype)
+        db = str(b.config.kv_cache_dtype)
+        if da != db:
+            raise ValueError(
+                f"replica {handle.replica_id}: kv_cache_dtype {db} != "
+                f"pool's {da} (transplanted blocks must be byte-identical)")
+
+    @property
+    def disaggregated(self) -> bool:
+        return any(r.role == "prefill" for r in self.replicas.values())
+
+    def _healthy(self, roles=None) -> List[ReplicaHandle]:
+        out = []
+        for rid, r in self.replicas.items():
+            if rid in self._quarantined or rid in self._dead:
+                continue
+            if roles is not None and r.role not in roles:
+                continue
+            out.append(r)
+        return out
+
+    def _entry_roles(self):
+        """Roles new requests dispatch to."""
+        return ("prefill",) if self.disaggregated else ("mixed",)
+
+    def _decode_roles(self):
+        return ("decode", "mixed")
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+
+    def submit(self, request: Request,
+               ttl_s: Optional[float] = None) -> Optional[CompletedRequest]:
+        """Admit a request into the pool. Returns None when accepted; under
+        admission_policy="shed" with a full router queue, returns the shed
+        `CompletedRequest` (reason "cancelled") instead. Raises
+        `InadmissibleRequestError` when NO replica's limits can ever fit
+        the request."""
+        if request.uid in self._pending or request.uid in self._done:
+            raise ValueError(f"duplicate request uid {request.uid!r}")
+        prompt_len = int(np.asarray(request.tokens).reshape(-1).shape[0])
+        self._validate(request, prompt_len)
+        now = self._clock()
+        if len(self.queue) >= self.config.max_pending:
+            if self.config.admission_policy == "shed":
+                self._count("shed")
+                done = CompletedRequest(uid=request.uid,
+                                        prompt_len=prompt_len,
+                                        tokens=np.zeros((0,), np.int32),
+                                        finish_reason="cancelled")
+                self._done.add(request.uid)
+                return done
+            # "block": drive the pool until the queue drains below the cap;
+            # finished requests land in the buffer the next step() returns
+            while len(self.queue) >= self.config.max_pending:
+                before = self._progress_mark()
+                self._finished_buf.extend(self._step_inner())
+                if self._progress_mark() == before:
+                    self._await_restart_or_raise(
+                        "router admission blocked with no possible progress "
+                        f"(queue={len(self.queue)}, live replicas="
+                        f"{len(self._healthy())})")
+                    continue
+        ttl = ttl_s if ttl_s is not None else self.config.default_ttl_s
+        hashes = None
+        for rep in self._healthy(self._entry_roles()):
+            hashes = rep.hash_chain(request.tokens)
+            break
+        self._pending[request.uid] = _Pending(
+            request=request, prompt_len=prompt_len, hashes=hashes,
+            t_submit=now, deadline=(now + ttl) if ttl is not None else None)
+        self.queue.append(request.uid)
+        self._count("submitted")
+        return None
+
+    def _validate(self, request, prompt_len):
+        """At least one replica on each leg must be able to EVER fit the
+        request; otherwise fail fast at the router instead of wedging.
+        The decode leg is checked against the WORST-CASE prefill-side
+        padding: a handoff target adopts a slot padded on the prefill
+        replica's chunk grid, so validating it against its own (possibly
+        finer) grid would admit requests no target can ever adopt."""
+        legs = [(self._entry_roles(), self.disaggregated, None)]
+        if self.disaggregated:
+            chunks = [r.prefill_chunk for r in self._healthy(("prefill",))]
+            padded = max(-(-prompt_len // c) * c for c in chunks) \
+                if chunks else None
+            legs.append((self._decode_roles(), False, padded))
+        for roles, prefill_only, padded in legs:
+            reps = self._healthy(roles)
+            if not reps:
+                raise RuntimeError(
+                    f"router has no healthy replica for roles {roles} "
+                    f"(pool={list(self.replicas)}, dead={sorted(self._dead)})")
+            last_err = None
+            for rep in reps:
+                try:
+                    rep.check_admissible(prompt_len, request.max_new_tokens,
+                                         prefill_only=prefill_only,
+                                         uid=request.uid,
+                                         padded_prompt=padded)
+                    last_err = None
+                    break
+                except InadmissibleRequestError as e:
+                    last_err = e
+            if last_err is not None:
+                raise last_err
+
+    # ------------------------------------------------------------------
+    # routing
+    # ------------------------------------------------------------------
+
+    def _affinity(self, rep: ReplicaHandle, hashes) -> int:
+        """Blocks of the chain this replica already holds OR was already
+        asked to build: max of the replica's registered prefix (read-only
+        cache probe) and the router's anticipated chain for it."""
+        if not hashes:
+            return 0
+        registered = rep.affinity(hashes)
+        seen = self._anticipated[rep.replica_id]
+        anticipated = 0
+        for h in hashes:
+            if h not in seen:
+                break
+            anticipated += 1
+        return max(registered, anticipated)
+
+    def _note_dispatch(self, rid, hashes):
+        if not hashes:
+            return
+        seen = self._anticipated[rid]
+        for h in hashes:
+            if h in seen:
+                seen.move_to_end(h)
+            else:
+                seen[h] = None
+        while len(seen) > self._anticipated_cap:
+            seen.popitem(last=False)
+
+    def _choose(self, rec: _Pending):
+        """Pick a dispatch target for a queued request, or None when every
+        eligible replica is saturated (the request waits at the router).
+        Returns (handle, affinity_blocks, spilled)."""
+        cfg = self.config
+        eligible = self._healthy(self._entry_roles())
+        if not eligible:
+            return None, 0, False
+        max_q = max(1, cfg.max_replica_queue)
+        scored = []       # (handle, affinity, score, pending, saturated)
+        for rep in eligible:
+            try:
+                need = rep.check_admissible(
+                    rec.prompt_len, rec.request.max_new_tokens,
+                    prefill_only=self.disaggregated, uid=rec.request.uid)
+            except InadmissibleRequestError:
+                continue
+            aff = self._affinity(rep, rec.hashes)
+            pending = rep.queue_depth + rep.num_active
+            score = (aff * cfg.affinity_weight - pending * cfg.load_penalty -
+                     (cfg.block_penalty if need > rep.available_blocks else 0))
+            scored.append((rep, aff, score, pending,
+                           rep.queue_depth >= max_q))
+        if not scored:
+            return None, 0, False
+        open_ = [s for s in scored if not s[4]]
+        if not open_:
+            return None, 0, False
+        if cfg.routing_policy == "round_robin":
+            chosen = open_[self._rr % len(open_)]
+            self._rr += 1
+        else:
+            order = {id(s): i for i, s in enumerate(open_)}
+            chosen = min(open_, key=lambda s: (-s[2], s[3],
+                                               (order[id(s)] - self._rr)
+                                               % len(open_)))
+            self._rr += 1
+        best_aff = max(s[1] for s in scored)
+        return chosen[0], chosen[1], chosen[1] < best_aff
+
+    def _dispatch(self):
+        """Drain the router queue head-first into replicas. Strict FIFO:
+        the head not fitting anywhere right now keeps everything behind it
+        queued (same no-starvation rule as the engine's own admission)."""
+        while self.queue:
+            uid = self.queue[0]
+            rec = self._pending[uid]
+            rep, aff, spilled = self._choose(rec)
+            if rep is None:
+                break
+            self.queue.popleft()
+            rep.submit(rec.request, prefill_only=self.disaggregated,
+                       hashes=rec.hashes)
+            rec.replica = rep.replica_id
+            self._note_dispatch(rep.replica_id, rec.hashes)
+            if self.config.routing_policy == "affinity":
+                if aff > 0:
+                    self._count("affinity_hits")
+                if spilled:
+                    self._count("load_spills")
+
+    # ------------------------------------------------------------------
+    # TTL + completion + failover
+    # ------------------------------------------------------------------
+
+    def _sweep_ttl(self, now, finished):
+        expired = [uid for uid, rec in self._pending.items()
+                   if rec.deadline is not None and now >= rec.deadline]
+        for uid in expired:
+            rec = self._pending[uid]
+            if rec.replica is None:
+                self.queue.remove(uid)
+                done = CompletedRequest(uid=uid, prompt_len=rec.prompt_len,
+                                        tokens=np.zeros((0,), np.int32),
+                                        finish_reason="cancelled")
+            else:
+                # only queued-but-unstarted dies; a generating request runs on
+                done = self.replicas[rec.replica].cancel(uid, queued_only=True)
+                if done is None:
+                    continue
+            self._count("ttl_cancelled")
+            self._complete(done, finished)
+
+    def _complete(self, done: CompletedRequest, finished):
+        if done.uid in self._done:
+            logger.warning(f"router: dropping duplicate completion for "
+                           f"{done.uid!r}")
+            return
+        rec = self._pending.pop(done.uid, None)
+        self._done.add(done.uid)
+        self._count("completed")
+        if rec is not None and rec.replica is not None:
+            if done.timing and done.timing.get("first_token"):
+                # ROUTER-level TTFT: first token relative to router arrival
+                # (engine TTFT + router queue wait), tagged by replica
+                ttft_ms = (done.timing["first_token"] - rec.t_submit) * 1e3
+                self._ttft[rec.replica].append(ttft_ms)
+                self.telemetry.observe(
+                    f"router/replica/{rec.replica}/ttft_ms", ttft_ms)
+        finished.append(done)
+
+    def _quarantine(self, rid, reason):
+        """Replica failed (step raised, or an operator killed it): pull its
+        queued requests out, re-route EVERYTHING incomplete it owned (an
+        in-flight request restarts from scratch — greedy decode makes the
+        rerun token-identical), and schedule a restart if the budget
+        allows."""
+        rep = self.replicas[rid]
+        self._count("replica_failures")
+        logger.warning(f"router: quarantining replica {rid} ({reason!r})")
+        try:
+            rep.drain_queued()          # engine queue state is re-owned here
+        except Exception:
+            pass                        # a truly dead backend may not answer
+        requeue = [uid for uid, rec in self._pending.items()
+                   if rec.replica == rid]
+        for uid in requeue:
+            self._pending[uid].replica = None
+        self.queue.extendleft(reversed(requeue))
+        self._count("reroutes", len(requeue))
+        self._anticipated[rid].clear()   # its pool (and cache) is gone
+        budget = self._budgets[rid]
+        if rep.can_restart and budget.consume("crash"):
+            self._quarantined[rid] = self._clock() + budget.next_delay()
+        else:
+            self._dead.add(rid)
+            logger.error(f"router: replica {rid} is out of restart budget; "
+                         f"pool shrinks to {len(self._healthy())}")
+
+    def _maybe_restart(self, now):
+        for rid, t in list(self._quarantined.items()):
+            if now < t:
+                continue
+            del self._quarantined[rid]
+            try:
+                self.replicas[rid].restart()
+                self._count("replica_restarts")
+                log_dist(f"router: replica {rid} restarted "
+                         f"(#{self._budgets[rid].restarts})", ranks=[0])
+            except Exception as e:
+                self._quarantine(rid, e)
+
+    def kill_replica(self, rid):
+        """Operator/test hook: fail a replica NOW (fault injection, drain
+        for maintenance). Everything it owned re-routes; restart follows
+        the per-replica budget like any crash."""
+        if rid not in self.replicas:
+            raise KeyError(f"unknown replica {rid!r}")
+        if rid in self._dead or rid in self._quarantined:
+            return
+        self._quarantine(rid, "killed")
+
+    # ------------------------------------------------------------------
+    # disaggregated handoff
+    # ------------------------------------------------------------------
+
+    def _do_handoffs(self):
+        """Move prefill-complete slots into decode replicas: allocate at the
+        target, transplant the blocks, release the source. A target without
+        room right now leaves the slot parked (prefill-side backpressure)."""
+        targets = self._healthy(self._decode_roles())
+        for prep in self._healthy(("prefill",)):
+            for uid in prep.handoff_ready():
+                rec = self._pending.get(uid)
+                if rec is None:        # cancelled while parked
+                    prep.release_handoff(uid)
+                    continue
+                cands = sorted(targets, key=lambda r: (not r.has_free_slot,
+                                                       r.queue_depth +
+                                                       r.num_active))
+                state = prep.export_handoff(uid)
+                for drep in cands:
+                    try:
+                        ok = drep.receive_handoff(state, prep.pool)
+                    except InadmissibleRequestError:
+                        # THIS target can never fit it; submit-time
+                        # validation guarantees some decode replica can
+                        continue
+                    if ok:
+                        prep.release_handoff(uid)
+                        rec.replica = drep.replica_id
+                        self._count("handoffs")
+                        break
+
+    # ------------------------------------------------------------------
+    # the router step
+    # ------------------------------------------------------------------
+
+    def _step_inner(self) -> List[CompletedRequest]:
+        finished: List[CompletedRequest] = []
+        now = self._clock()
+        self.steps += 1
+        self._sweep_ttl(now, finished)
+        self._maybe_restart(now)
+        self._dispatch()
+        for rid in list(self.replicas):
+            if rid in self._quarantined or rid in self._dead:
+                continue
+            rep = self.replicas[rid]
+            try:
+                for done in rep.step():
+                    self._complete(done, finished)
+            except Exception as e:
+                self._quarantine(rid, e)
+        if self.disaggregated:
+            self._do_handoffs()
+        if self.telemetry.enabled:
+            self.telemetry.set_gauge("router/queue_depth", len(self.queue))
+            self.telemetry.set_gauge("router/in_flight", len(self._pending))
+            self.telemetry.set_gauge("router/live_replicas",
+                                     len(self._healthy()))
+            self.telemetry.maybe_export(self.steps)
+        return finished
+
+    def step(self) -> List[CompletedRequest]:
+        """One router iteration: TTL sweep -> restarts -> dispatch -> step
+        every live replica -> handoffs. Returns every request that finished
+        since the last call (including ones finished inside a blocking
+        submit)."""
+        out = self._finished_buf
+        self._finished_buf = []
+        out.extend(self._step_inner())
+        return out
+
+    @property
+    def in_flight(self) -> int:
+        """Incomplete requests anywhere in the pool (router queue +
+        dispatched)."""
+        return len(self._pending)
+
+    def _await_restart_or_raise(self, msg):
+        """Stalled with a replica restart pending backoff: sleep until the
+        clock reaches it. An INJECTED clock that never advances would spin
+        forever here, so a non-moving clock raises instead of hanging."""
+        if not self._quarantined:
+            raise RuntimeError(msg)
+        t0 = self._clock()
+        time.sleep(0.005)
+        if self._clock() <= t0:
+            raise RuntimeError(
+                msg + " (a replica restart is scheduled but the injected "
+                "clock never advances — advance it or use backoff 0)")
+
+    def _progress_mark(self):
+        live = self._healthy()
+        work = sum(r.progress() for r in live)
+        return (len(self.queue), len(self._pending), len(self._done), work,
+                len(live), len(self._quarantined))
+
+    def run(self, requests: Sequence[Request],
+            ttl_s: Optional[float] = None) -> Dict[Any, CompletedRequest]:
+        """Submit a batch and drain the pool. Shed/TTL-cancelled requests
+        appear in the result with ``finish_reason="cancelled"``."""
+        out: Dict[Any, CompletedRequest] = {}
+        for r in requests:
+            shed = self.submit(r, ttl_s=ttl_s)
+            if shed is not None:
+                out[shed.uid] = shed
+        while self.in_flight or self._finished_buf:
+            before = self._progress_mark()
+            for done in self.step():
+                out[done.uid] = done
+            if self._progress_mark() == before:
+                self._await_restart_or_raise(
+                    f"router made no progress: queue={len(self.queue)} "
+                    f"in_flight={self.in_flight} "
+                    f"live={len(self._healthy())} dead={sorted(self._dead)}")
+        if self.telemetry.enabled:
+            self.telemetry.export(self.steps)
+        return out
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+
+    def _count(self, name, n=1):
+        self.counters[name] += n
+        self.telemetry.inc(f"router/{name}", n)
+
+    @staticmethod
+    def _percentile(values, q):
+        if not values:
+            return None
+        v = sorted(values)
+        return float(v[min(len(v) - 1, int(q * len(v)))])
+
+    def replica_ttft(self, rid) -> Dict[str, float]:
+        """Router-level TTFT percentiles for one replica (ms), over the
+        last `_ttft_window` completions. Populated only when the replicas
+        run with telemetry enabled (the engine stamps first-token
+        times)."""
+        v = list(self._ttft.get(rid, ()))
+        return {"count": len(v),
+                "p50": self._percentile(v, 0.50),
+                "p99": self._percentile(v, 0.99)}
+
+    def stats(self) -> Dict[str, Any]:
+        """RouterStats: routing-decision counters, queue depth, and a
+        per-replica block (role/health/load/TTFT + the engine's own
+        stats())."""
+        reps = {}
+        for rid, rep in self.replicas.items():
+            health = ("dead" if rid in self._dead else
+                      "quarantined" if rid in self._quarantined else "up")
+            entry = {"role": rep.role, "health": health,
+                     "restarts": self._budgets[rid].restarts,
+                     "ttft_ms": self.replica_ttft(rid)}
+            if health == "up":
+                entry.update(queue=rep.queue_depth, active=rep.num_active,
+                             available_blocks=rep.available_blocks,
+                             engine=rep.stats())
+            reps[rid] = entry
+        return {"steps": self.steps, "queue_depth": len(self.queue),
+                "in_flight": len(self._pending),
+                "counters": dict(self.counters),
+                "disaggregated": self.disaggregated,
+                "replicas": reps}
+
+    def total_prefill_chunks(self) -> int:
+        """Prefill chunks executed across live replicas — the quantity
+        affinity routing minimizes on shared-prefix traffic."""
+        return sum(r.stats()["prefill_chunks"] for r in self._healthy())

@@ -57,6 +57,13 @@ def pytest_configure(config):
                    "CPU-harness-safe, rides in tier-1; run it alone with "
                    "pytest -m prefix_cache)")
     config.addinivalue_line(
+        "markers", "router: distributed serving router suite "
+                   "(tests/test_router.py — multi-replica engine pool, "
+                   "prefix-affinity routing, TTL/backpressure admission, "
+                   "replica failover, prefill/decode handoff) — fast and "
+                   "CPU-harness-safe, rides in tier-1; run it alone with "
+                   "pytest -m router)")
+    config.addinivalue_line(
         "markers", "telemetry: unified telemetry suite "
                    "(tests/test_telemetry.py — metrics registry, TTFT/TPOT "
                    "histograms, MFU accounting, exporters, dstpu_metrics) — "

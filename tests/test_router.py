@@ -1,0 +1,423 @@
+"""Distributed serving router (deepspeed_tpu/serving/): multi-replica pool,
+prefix-affinity routing, backpressure admission, TTL cancellation, replica
+failover, and the disaggregated prefill->decode block handoff — plus the
+engine-side satellites it builds on (ServingEngine.cancel, submit-time
+rejection, the reusable restart budget).
+
+Everything here rides the `router` marker (tier-1; run alone with
+`pytest -m router`).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.config.core import MeshConfig
+from deepspeed_tpu.elasticity.restart_policy import RestartBudget, RestartPolicy
+from deepspeed_tpu.inference.engine import init_inference
+from deepspeed_tpu.inference.scheduler import (InadmissibleRequestError,
+                                               Request)
+from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_decode_model
+from deepspeed_tpu.serving import InProcessReplica, ServingRouter
+
+pytestmark = pytest.mark.router
+
+TINY = GPTConfig(n_layer=2, n_head=4, d_model=64, max_seq_len=256,
+                 vocab_size=256, dtype=jnp.float32, remat=False)
+BS = 16  # kv_block_size == prefill_chunk for every engine below
+
+
+@pytest.fixture(scope="module")
+def engine():
+    """One shared InferenceEngine: every replica is engine.serving() on the
+    same params — exactly the data-parallel replica pool shape."""
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    mesh_mod.init_mesh(MeshConfig(data=1, tensor=1, sequence=1, expert=1,
+                                  pipe=1))
+    spec = make_gpt_decode_model(cfg=TINY, name="tiny")
+    return init_inference(model=spec, config={
+        "dtype": "float32", "kv_cache_dtype": "float32", "greedy": True,
+        "kv_block_size": BS, "max_out_tokens": 64})
+
+
+def _replica(engine, **over):
+    kw = dict(max_slots=2, max_context=96, prefill_chunk=BS,
+              enable_prefix_caching=True)
+    kw.update(over)
+    return engine.serving(**kw)
+
+
+def _shared_prefix_trace(rng, n, prefix_blocks=2, vocab=TINY.vocab_size):
+    """Ragged prompts all starting with the same `prefix_blocks` full
+    blocks (the shared-system-prompt workload affinity routing targets)."""
+    prefix = rng.integers(0, vocab, (prefix_blocks * BS,)).astype(np.int32)
+    tails = rng.integers(2, 14, (n,))
+    return [np.concatenate([prefix,
+                            rng.integers(0, vocab, (t,)).astype(np.int32)])
+            for t in tails]
+
+
+def _refs(engine, prompts, news):
+    return [engine.generate(p[None], max_new_tokens=n, stop_on_eos=False)[0]
+            for p, n in zip(prompts, news)]
+
+
+# ----------------------------------------------------------------------
+# restart budget (elasticity/restart_policy.py — extracted from the agent)
+# ----------------------------------------------------------------------
+
+
+def test_restart_budget_exhaustion_global_and_per_cause():
+    b = RestartBudget(RestartPolicy(max_restarts=3,
+                                    per_cause={"bad_state": 1}))
+    assert b.consume("crash") and b.consume("bad_state")
+    assert not b.exhausted
+    assert b.consume("crash")                 # 3rd: still within global
+    assert not b.consume("crash")             # 4th: global budget exhausted
+    assert b.exhausted and b.restarts == 4
+    b2 = RestartBudget(RestartPolicy(max_restarts=10,
+                                     per_cause={"bad_state": 1}))
+    assert b2.consume("bad_state")
+    assert not b2.consume("bad_state")        # per-cause cap beats global
+    assert b2.causes == {"bad_state": 2} and b2.last_cause == "bad_state"
+
+
+def test_restart_backoff_monotone_and_capped():
+    b = RestartBudget(RestartPolicy(base_backoff_s=1.0, backoff_factor=2.0,
+                                    max_backoff_s=5.0, jitter=0.0))
+    delays = []
+    for r in (1, 2, 3, 4, 5):
+        b.restarts = r
+        delays.append(b.next_delay())
+    assert delays == sorted(delays)           # monotone nondecreasing
+    assert delays[:3] == [1.0, 2.0, 4.0]
+    assert delays[3] == delays[4] == 5.0      # capped
+    # jitter only ever ADDS (proportionally, bounded)
+    bj = RestartBudget(RestartPolicy(base_backoff_s=1.0, jitter=0.5))
+    bj.restarts = 1
+    assert 1.0 <= bj.next_delay() <= 1.5
+    assert RestartBudget(RestartPolicy(base_backoff_s=0.0)).next_delay() == 0.0
+
+
+# ----------------------------------------------------------------------
+# engine satellites: cancel() + submit-time rejection
+# ----------------------------------------------------------------------
+
+
+def test_engine_cancel_queued_and_active(engine):
+    serving = _replica(engine, max_slots=1, enable_prefix_caching=False)
+    rng = np.random.default_rng(0)
+    p = rng.integers(0, TINY.vocab_size, (6,)).astype(np.int32)
+    serving.submit(Request(uid="a", tokens=p, max_new_tokens=20,
+                           stop_on_eos=False))
+    serving.submit(Request(uid="b", tokens=p, max_new_tokens=4,
+                           stop_on_eos=False))
+    serving.step()                          # "a" occupies the slot
+    # queued request withdraws cleanly, before ever touching a slot
+    done_b = serving.cancel("b")
+    assert done_b.finish_reason == "cancelled" and len(done_b.tokens) == 0
+    assert serving.queue_depth == 0
+    # queued_only never kills a generating request
+    assert serving.cancel("a", queued_only=True) is None
+    done_a = serving.cancel("a")            # active: retires immediately
+    assert done_a.finish_reason == "cancelled"
+    assert 0 < len(done_a.tokens) < 20      # keeps what was emitted
+    assert serving.allocator.num_free == serving.allocator.capacity, \
+        "cancel leaked blocks"
+    assert serving.cancel("nope") is None
+    assert serving.stats()["cancelled"] == 2
+    # the slot is reusable after a cancel
+    out = serving.run([Request(uid="c", tokens=p, max_new_tokens=3,
+                               stop_on_eos=False)])
+    ref = engine.generate(p[None], max_new_tokens=3, stop_on_eos=False)
+    np.testing.assert_array_equal(out["c"].tokens, ref[0])
+
+
+def test_submit_rejects_impossible_requests_incl_window_rounding(engine):
+    # the window-rounding edge: same request fits at window=1 but its
+    # blindly-written decode tail crosses max_context at window=16
+    rng = np.random.default_rng(1)
+    p = rng.integers(0, TINY.vocab_size, (20,)).astype(np.int32)
+    ok = _replica(engine, max_context=32, enable_prefix_caching=False)
+    ok.submit(Request(uid=0, tokens=p, max_new_tokens=6))   # fits
+    windowed = _replica(engine, max_context=32, decode_steps_per_sync=16,
+                        enable_prefix_caching=False)
+    with pytest.raises(InadmissibleRequestError, match="max_context"):
+        windowed.submit(Request(uid=1, tokens=p, max_new_tokens=6))
+    small_pool = _replica(engine, max_slots=1, num_kv_blocks=2,
+                          enable_prefix_caching=False)
+    with pytest.raises(InadmissibleRequestError, match="KV blocks"):
+        small_pool.submit(Request(uid=2, tokens=list(range(40)),
+                                  max_new_tokens=8))
+    # InadmissibleRequestError IS a ValueError: pre-existing callers keep
+    # catching it without change
+    assert issubclass(InadmissibleRequestError, ValueError)
+
+
+# ----------------------------------------------------------------------
+# router: parity, affinity, spill, TTL, shed, failover, handoff
+# ----------------------------------------------------------------------
+
+
+def test_router_greedy_parity_on_ragged_trace(engine):
+    """2 replicas, ragged mixed-length trace: every request's output is
+    token-identical to the single-engine static generate() reference."""
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, TINY.vocab_size, (L,)).astype(np.int32)
+               for L in (5, 11, 3, 8, 30, 2, 17)]
+    news = [3 + i % 5 for i in range(len(prompts))]
+    router = ServingRouter(replicas=[_replica(engine), _replica(engine)])
+    res = router.run([Request(uid=i, tokens=p, max_new_tokens=n,
+                              stop_on_eos=False)
+                      for i, (p, n) in enumerate(zip(prompts, news))])
+    assert sorted(res) == list(range(len(prompts)))
+    for i, ref in enumerate(_refs(engine, prompts, news)):
+        np.testing.assert_array_equal(res[i].tokens, ref)
+    assert router.counters["completed"] == len(prompts)
+    for rid, rep in router.replicas.items():
+        cs = rep.compile_stats()
+        assert all(v <= 1 for v in cs.values()), (rid, cs)
+
+
+def test_router_affinity_beats_round_robin_on_shared_prefix(engine):
+    """THE routing claim: on a shared-system-prompt wave, affinity routing
+    executes strictly fewer total prefill chunks than round-robin (the
+    prefix prefills once per POOL, not once per replica), with identical
+    greedy tokens and one compile per program per engine."""
+    rng = np.random.default_rng(3)
+    prompts = _shared_prefix_trace(rng, 6)
+    news = [4] * len(prompts)
+    refs = _refs(engine, prompts, news)
+
+    def run(policy):
+        router = ServingRouter(replicas=[_replica(engine), _replica(engine)],
+                               routing_policy=policy)
+        res = router.run([Request(uid=i, tokens=p, max_new_tokens=4,
+                                  stop_on_eos=False)
+                          for i, p in enumerate(prompts)])
+        for i, ref in enumerate(refs):
+            np.testing.assert_array_equal(res[i].tokens, ref), (policy, i)
+        return router
+
+    aff = run("affinity")
+    rr = run("round_robin")
+    assert aff.total_prefill_chunks() < rr.total_prefill_chunks(), \
+        (aff.total_prefill_chunks(), rr.total_prefill_chunks())
+    assert aff.counters["affinity_hits"] > 0
+    for router in (aff, rr):
+        for rid, rep in router.replicas.items():
+            assert all(v <= 1 for v in rep.compile_stats().values())
+
+
+def test_router_load_spill_under_saturated_replica(engine):
+    """Affinity prefers the warm replica, but a saturated queue there
+    spills the request to the cold one — counted, and still completing
+    with correct tokens."""
+    rng = np.random.default_rng(4)
+    prompts = _shared_prefix_trace(rng, 5)
+    router = ServingRouter(replicas=[_replica(engine, max_slots=1),
+                                     _replica(engine, max_slots=1)],
+                           max_replica_queue=1)
+    res = router.run([Request(uid=i, tokens=p, max_new_tokens=4,
+                              stop_on_eos=False)
+                      for i, p in enumerate(prompts)])
+    assert sorted(res) == list(range(len(prompts)))
+    assert router.counters["load_spills"] > 0, router.counters
+    for i, ref in enumerate(_refs(engine, prompts, [4] * len(prompts))):
+        np.testing.assert_array_equal(res[i].tokens, ref)
+    # the spill actually spread load: both replicas prefilled something
+    chunks = [rep.stats()["prefill_chunks"]
+              for rep in router.replicas.values()]
+    assert all(c > 0 for c in chunks), chunks
+
+
+def test_router_ttl_cancels_queued_requests(engine):
+    """Requests still QUEUED past their deadline are cancelled — at the
+    router queue and inside a replica's own queue — while a generating
+    request is never TTL-killed."""
+    t = {"now": 0.0}
+    rng = np.random.default_rng(5)
+    p = rng.integers(0, TINY.vocab_size, (6,)).astype(np.int32)
+    router = ServingRouter(
+        replicas=[_replica(engine, max_slots=1,
+                           enable_prefix_caching=False)],
+        max_replica_queue=1, default_ttl_s=5.0, clock=lambda: t["now"])
+    for uid in ("gen", "engine_queued", "router_queued"):
+        router.submit(Request(uid=uid, tokens=p, max_new_tokens=24,
+                              stop_on_eos=False))
+    done = {}
+    for _ in range(2):                    # "gen" starts generating
+        for d in router.step():
+            done[d.uid] = d
+    rec = router._pending["engine_queued"]
+    assert rec.replica is not None        # sits in the replica's FIFO
+    assert router._pending["router_queued"].replica is None
+    t["now"] = 6.0                        # past every deadline
+    while router.in_flight:
+        for d in router.step():
+            done[d.uid] = d
+    assert done["engine_queued"].finish_reason == "cancelled"
+    assert done["router_queued"].finish_reason == "cancelled"
+    assert router.counters["ttl_cancelled"] == 2
+    # the generating request survived TTL and ran to its full budget
+    assert done["gen"].finish_reason == "length"
+    ref = engine.generate(p[None], max_new_tokens=24, stop_on_eos=False)
+    np.testing.assert_array_equal(done["gen"].tokens, ref[0])
+
+
+def test_router_bounded_admission_shed(engine):
+    """admission_policy="shed": a full router queue completes newcomers
+    immediately as cancelled instead of growing without bound."""
+    rng = np.random.default_rng(6)
+    p = rng.integers(0, TINY.vocab_size, (6,)).astype(np.int32)
+    router = ServingRouter(
+        replicas=[_replica(engine, max_slots=1,
+                           enable_prefix_caching=False)],
+        max_replica_queue=1, max_pending=2, admission_policy="shed")
+    shed = []
+    for i in range(6):
+        out = router.submit(Request(uid=i, tokens=p, max_new_tokens=8,
+                                    stop_on_eos=False))
+        if out is not None:
+            shed.append(out)
+    assert len(shed) >= 1 and all(s.finish_reason == "cancelled"
+                                  for s in shed)
+    assert router.counters["shed"] == len(shed)
+    res = {}
+    while router.in_flight:
+        for d in router.step():
+            res[d.uid] = d
+    # accepted + shed covers every uid exactly once: nothing lost
+    assert sorted(list(res) + [s.uid for s in shed]) == list(range(6))
+    with pytest.raises(ValueError, match="duplicate"):
+        router.submit(Request(uid=0, tokens=p, max_new_tokens=2))
+
+
+def test_router_replica_failure_reroutes_and_completes(engine):
+    """Kill a replica mid-trace: its queued AND in-flight requests re-route
+    to the survivor, the whole trace completes exactly once each, tokens
+    stay identical to the single-engine reference."""
+    rng = np.random.default_rng(7)
+    prompts = _shared_prefix_trace(rng, 6)
+    news = [6] * len(prompts)
+    router = ServingRouter(replicas=[_replica(engine), _replica(engine)])
+    for i, p in enumerate(prompts):
+        router.submit(Request(uid=i, tokens=p, max_new_tokens=6,
+                              stop_on_eos=False))
+    res = {}
+    for _ in range(2):
+        for d in router.step():
+            res[d.uid] = d
+    victim = next(rec.replica for rec in router._pending.values()
+                  if rec.replica is not None)
+    router.kill_replica(victim)
+    while router.in_flight:
+        for d in router.step():
+            res[d.uid] = d
+    assert sorted(res) == list(range(len(prompts)))       # none lost
+    assert router.counters["completed"] == len(prompts)   # none duplicated
+    assert router.counters["replica_failures"] == 1
+    assert router.counters["reroutes"] > 0
+    for i, ref in enumerate(_refs(engine, prompts, news)):
+        np.testing.assert_array_equal(res[i].tokens, ref)
+    assert router.stats()["replicas"][victim]["health"] == "dead"
+
+
+def test_router_replica_restart_budget(engine):
+    """A factory-backed replica rebuilds after quarantine (budget permits
+    exactly `max_replica_restarts`); the next failure leaves it dead."""
+    rng = np.random.default_rng(8)
+    p = rng.integers(0, TINY.vocab_size, (5,)).astype(np.int32)
+
+    def factory():
+        return _replica(engine, enable_prefix_caching=False)
+
+    router = ServingRouter(max_replica_restarts=1, restart_backoff_s=0.0)
+    router.add_replica(InProcessReplica(factory=factory, replica_id="r0"))
+    router.kill_replica("r0")
+    router.step()                       # backoff 0: restart fires now
+    assert router.counters["replica_restarts"] == 1
+    assert router.stats()["replicas"]["r0"]["health"] == "up"
+    res = router.run([Request(uid="x", tokens=p, max_new_tokens=3,
+                              stop_on_eos=False)])
+    ref = engine.generate(p[None], max_new_tokens=3, stop_on_eos=False)
+    np.testing.assert_array_equal(res["x"].tokens, ref[0])
+    router.kill_replica("r0")
+    router.step()
+    assert router.stats()["replicas"]["r0"]["health"] == "dead"
+    with pytest.raises(RuntimeError, match="no healthy replica"):
+        router.submit(Request(uid="y", tokens=p, max_new_tokens=2))
+
+
+def test_router_rejects_impossible_request_across_pool(engine):
+    router = ServingRouter(replicas=[
+        _replica(engine, max_context=32, enable_prefix_caching=False)])
+    with pytest.raises(InadmissibleRequestError, match="max_context"):
+        router.submit(Request(uid=0, tokens=list(range(30)),
+                              max_new_tokens=16))
+    assert router.in_flight == 0
+
+
+def test_disaggregated_prefill_decode_handoff_parity(engine):
+    """Stretch path: prefill replicas run chunked prefill only, then their
+    slots' KV blocks transplant into the decode replica's pool
+    (block-indexed gather) and decode continues there — token-identical to
+    a mixed single engine, with the phases PHYSICALLY separated."""
+    rng = np.random.default_rng(9)
+    prompts = _shared_prefix_trace(rng, 4)
+    news = [5] * len(prompts)
+    pre = _replica(engine, enable_prefix_caching=True)
+    dec = _replica(engine, enable_prefix_caching=False)
+    router = ServingRouter()
+    router.add_replica(pre, role="prefill")
+    router.add_replica(dec, role="decode")
+    assert router.disaggregated
+    res = router.run([Request(uid=i, tokens=p, max_new_tokens=5,
+                              stop_on_eos=False)
+                      for i, p in enumerate(prompts)])
+    for i, ref in enumerate(_refs(engine, prompts, news)):
+        np.testing.assert_array_equal(res[i].tokens, ref)
+    assert router.counters["handoffs"] == len(prompts)
+    # the separation is real: decode replica never prefilled, prefill
+    # replica never decoded
+    assert dec.stats()["prefill_chunks"] == 0
+    assert pre.stats()["decode_steps"] == 0
+    assert pre.stats()["handoffs_out"] == len(prompts)
+    assert dec.stats()["handoffs_in"] == len(prompts)
+    # both pools drained clean: no leaked blocks on either side
+    assert pre.allocator.num_free + pre.allocator.num_reclaimable \
+        == pre.allocator.capacity
+    assert dec.allocator.num_free == dec.allocator.capacity
+
+
+def test_disaggregated_handoff_across_chunk_grids(engine):
+    """The decode leg validates against the PREFILL replica's chunk-grid
+    padding: a coarser prefill grid can pad a prompt past what the decode
+    replica's own grid would — such a request must be rejected at submit
+    (not parked in _HANDOFF forever), and a roomier decode replica must
+    adopt across the grid mismatch with exact tokens."""
+    rng = np.random.default_rng(10)
+    p = rng.integers(0, TINY.vocab_size, (17,)).astype(np.int32)
+
+    def build(decode_ctx):
+        router = ServingRouter()
+        router.add_replica(_replica(engine, prefill_chunk=64, max_context=96,
+                                    enable_prefix_caching=False),
+                           role="prefill")
+        router.add_replica(_replica(engine, prefill_chunk=BS,
+                                    max_context=decode_ctx,
+                                    enable_prefix_caching=False),
+                           role="decode")
+        return router
+
+    # decode max_context 48 fits the prompt on ITS grid (padded 32) but not
+    # the prefill replica's 64-padded slot — reject at submit, don't wedge
+    with pytest.raises(InadmissibleRequestError, match="max_context"):
+        build(48).submit(Request(uid=0, tokens=p, max_new_tokens=4,
+                                 stop_on_eos=False))
+    res = build(96).run([Request(uid=0, tokens=p, max_new_tokens=4,
+                                 stop_on_eos=False)])
+    ref = engine.generate(p[None], max_new_tokens=4, stop_on_eos=False)
+    np.testing.assert_array_equal(res[0].tokens, ref[0])
