@@ -612,6 +612,109 @@ def run_prefix_cache_lane():
     return result
 
 
+def run_spec_decode_lane():
+    """SPEC-DECODE lane (BENCH_SERVING gate): the same ragged trace through
+    one serving engine with the drafter OFF vs the n-gram prompt-lookup
+    drafter ON (`serving.spec_decode`), on a REPETITIVE-prompt workload —
+    the regime prompt lookup targets (models repeat/copy on repetitive or
+    extractive text; greedy decode of the bench model settles into exactly
+    such cycles). vs_baseline is ngram-on/off aggregate tokens/s on
+    identical work; the mechanism numbers ride in extra:
+    accepted-tokens/step (per sequence per model step — 1.0 would mean
+    spec decode bought nothing), acceptance rate, verify-vs-decode step
+    counts, and TTFT/TPOT percentiles per mode from the PR 5 latency
+    snapshot (TPOT is per-token and burst-interpolated, so the verify
+    step's multi-token emissions are measured honestly). Output parity
+    between the modes is asserted, not assumed."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.inference.engine import init_inference
+    from deepspeed_tpu.inference.scheduler import Request
+    from deepspeed_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                          make_gpt_decode_model)
+
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    n_req = int(os.environ.get("BENCH_SPEC_REQUESTS", "8"))
+    slots = int(os.environ.get("BENCH_SPEC_SLOTS", "4"))
+    draft_k = int(os.environ.get("BENCH_SPEC_DRAFT_K", "4"))
+    # leaner than the serving lane's model: this lane pays the trace twice
+    # (off + on) and spec decode's win is per-STEP, not per-flop
+    cfg = GPTConfig(n_layer=4, n_head=8, n_kv_head=4, d_model=512,
+                    max_seq_len=1024, vocab_size=50304, remat=False,
+                    use_rotary=True)
+    params = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16), init_gpt_params(cfg, seed=0))
+    spec = make_gpt_decode_model(cfg=cfg, params=params)
+    engine = init_inference(model=spec, config={
+        "dtype": "bfloat16", "kv_cache_dtype": "bfloat16", "greedy": True,
+        "kv_block_size": 128, "max_out_tokens": 1024,
+        "telemetry": {"enabled": True, "prometheus": False, "jsonl": False,
+                      "monitor_bridge": False}})
+    rng = np.random.default_rng(0)
+    # repetitive prompts: a short pattern tiled to prompt length (few-shot
+    # templates / log lines / extraction inputs — the prompt-lookup shape)
+    prompts, news = [], []
+    for _ in range(n_req):
+        pat = rng.integers(0, cfg.vocab_size, (int(rng.integers(4, 12)),))
+        reps = -(-int(rng.integers(48, 128)) // len(pat))
+        prompts.append(np.tile(pat, reps).astype(np.int32))
+        news.append(int(rng.integers(32, 64)))
+
+    def mode(spec_decode):
+        serving = engine.serving(max_slots=slots, max_context=512,
+                                 prefill_chunk=128, spec_decode=spec_decode)
+        reqs = [Request(uid=i, tokens=p, max_new_tokens=n, stop_on_eos=False)
+                for i, (p, n) in enumerate(zip(prompts, news))]
+        t0 = time.perf_counter()
+        res = serving.run(reqs)
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in res.values())
+        return serving, res, toks / dt, dt
+
+    base_srv, base_res, base_tps, base_wall = mode({"drafter": "off"})
+    spec_srv, spec_res, spec_tps, spec_wall = mode(
+        {"drafter": "ngram", "draft_k": draft_k})
+    # parity on the bf16 lane is a FRACTION, not an exact match: the C=1
+    # decode einsum and the C=k+1 verify einsum can differ in the last bf16
+    # ulp, and a near-tie argmax then flips a token (the fp32 tier-1 suite
+    # pins exact token identity; this guards against real logic breakage)
+    matched = total = 0
+    for uid in base_res:
+        a, b = base_res[uid].tokens, spec_res[uid].tokens
+        total += len(a)
+        matched += int((a[:len(b)] == b[:len(a)]).sum())
+    parity = matched / max(1, total)
+    assert parity > 0.9, f"spec decode diverged from greedy: {parity:.3f}"
+    st = spec_srv.stats()["spec_decode"]
+
+    result = {
+        "metric": "gpt_serving_spec_decode_ngram_tokens_per_sec",
+        "value": round(spec_tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(spec_tps / base_tps, 4),
+        "extra": {
+            "baseline_tokens_per_sec": round(base_tps, 1),
+            "baseline_wall_s": round(base_wall, 2),
+            "spec_wall_s": round(spec_wall, 2),
+            "requests": n_req, "slots": slots, "draft_k": draft_k,
+            "greedy_parity_fraction": round(parity, 4),
+            "accepted_tokens_per_step": round(
+                st["accepted_tokens_per_step"], 3),
+            "acceptance_rate": round(st["acceptance_rate"], 4),
+            "verify_steps": st["verify_steps"],
+            "baseline_decode_steps": base_srv.stats()["decode_steps"],
+            "latency_spec": _latency_extra(spec_srv),
+            "latency_baseline": _latency_extra(base_srv),
+            "compiles": spec_srv.compile_stats(),
+        },
+    }
+    print(json.dumps(result))
+    return result
+
+
 def run_router_lane():
     """ROUTER lane (BENCH_SERVING gate): the distributed serving front-end
     (deepspeed_tpu/serving/) — N=2 engine replicas behind a
@@ -804,6 +907,9 @@ def main():
     if env("BENCH_PREFIX_CHILD") == "1":  # prefix-cache sub-lane child
         run_prefix_cache_lane()
         return
+    if env("BENCH_SPEC_CHILD") == "1":    # spec-decode sub-lane child
+        run_spec_decode_lane()
+        return
     if env("BENCH_ROUTER_CHILD") == "1":  # serving-router sub-lane child
         run_router_lane()
         return
@@ -936,6 +1042,18 @@ def main():
             BENCH_PREFIX_LEN=env("BENCH_PREFIX_LEN", "512"))
         if prefix_cache is not None:
             print(json.dumps(prefix_cache))
+
+    # spec-decode lane (same gate): n-gram drafter on vs off on a
+    # repetitive-prompt trace — tokens/s, accepted-tokens/step, TTFT/TPOT
+    spec_decode = None
+    if env("BENCH_SERVING", "1") == "1" and "BENCH_MODEL" not in os.environ:
+        spec_decode = sub_lane(
+            "spec_decode", BENCH_SPEC_CHILD="1",
+            BENCH_SPEC_REQUESTS=env("BENCH_SPEC_REQUESTS", "8"),
+            BENCH_SPEC_SLOTS=env("BENCH_SPEC_SLOTS", "4"),
+            BENCH_SPEC_DRAFT_K=env("BENCH_SPEC_DRAFT_K", "4"))
+        if spec_decode is not None:
+            print(json.dumps(spec_decode))
 
     # router lane (same gate): 2-replica prefix-affinity pool vs 1 engine
     # on a ragged mixed-prefix trace — affinity hit-rate + per-replica TTFT

@@ -20,6 +20,40 @@ class TensorParallelConfig(ConfigModel):
 
 
 @dataclass
+class SpecDecodeConfig(ConfigModel):
+    """Speculative decoding over the paged pool (`inference/spec_decode.py`).
+
+    When enabled, the serving scheduler replaces the per-token decode step
+    (and the decode window) with a draft+verify loop: a DRAFTER proposes
+    `draft_k` tokens per active slot, one fixed-shape jitted VERIFY call
+    scores all of them for all `max_slots` at once (the chunked-prefill
+    machinery at positions pos..pos+k), and the longest agreeing prefix is
+    accepted plus one bonus token from the first disagreeing logit row —
+    1..k+1 tokens per model step instead of exactly 1. Rejection is an O(1)
+    rewind of the slot's length cursor: blocks past it are overwritten by
+    later writes, never freed or reallocated, and the block table is
+    untouched. Greedy output is token-identical to non-speculative serving.
+    """
+    drafter: str = "off"          # "off" | "ngram" | "model". "ngram" is the
+                                  # model-free prompt-lookup drafter (match
+                                  # the newest generated tokens against the
+                                  # slot's own prompt+output history, propose
+                                  # the continuation — ideal for the cache-
+                                  # heavy shared-prefix workloads prefix
+                                  # caching serves); "model" drives a second,
+                                  # smaller DecodeModelSpec passed to
+                                  # `engine.serving(draft_spec=...)`
+    draft_k: int = 4              # draft tokens proposed+verified per step —
+                                  # a compile-stability knob: pins the verify
+                                  # program's [max_slots, draft_k+1] shape.
+                                  # Size against the measured acceptance
+                                  # rate: the verify step always pays k+1
+                                  # positions of compute, accepted or not
+    ngram_max: int = 4            # longest suffix n-gram the prompt-lookup
+    ngram_min: int = 1            # drafter tries to match (tried max..min)
+
+
+@dataclass
 class ServingConfig(ConfigModel):
     """Continuous-batching serving engine (`inference/scheduler.py`).
 
@@ -61,6 +95,10 @@ class ServingConfig(ConfigModel):
                                   # prefills once. Token-identical greedy
                                   # output, zero new compiles; costs only
                                   # host-side hashing at submit
+    spec_decode: SpecDecodeConfig = field(default_factory=SpecDecodeConfig)
+                                  # speculative decoding (drafter/draft_k —
+                                  # see SpecDecodeConfig); replaces the
+                                  # decode window when on
     prefix_cache_policy: str = "lru"  # what happens to a cached block when
                                   # its last reader retires: "lru" parks it
                                   # on the reclaimable list (evicted oldest-

@@ -39,25 +39,42 @@ def sample_logits(logits, rng, greedy=True, temperature=1.0, top_k=0,
     if greedy or rng is None:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / jnp.maximum(temperature, 1e-6)
-    if top_k and top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p is not None and top_p < 1.0:
-        # nucleus sampling (Holtzman et al.): keep the smallest head of the
-        # sorted distribution whose cumulative probability reaches top_p.
-        # The exclusive cumsum (cum - probs) keeps the argmax even when its
-        # own probability already exceeds top_p; ties at the cutoff logit
-        # are all kept (harmless: they carry equal probability).
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        keep = jnp.cumsum(probs, axis=-1) - probs < top_p
-        # top-1 survives unconditionally, including top_p <= 0 (a common
-        # spelling of "argmax"): an all-False keep would mask EVERY token
-        # and categorical over all -inf degenerates to token id 0
-        keep = keep.at[..., 0].set(True)
-        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
-                         keepdims=True)
-        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    want_k = bool(top_k) and top_k > 0
+    want_p = top_p is not None and top_p < 1.0
+    if want_k or want_p:
+        # ONE sort pass for both filters: lax.top_k's descending head is the
+        # kth-value source for the top-k cut AND the sorted prefix the
+        # nucleus cumsum walks. (The old path paid two full-vocab jnp.sorts —
+        # one for kth, one for the nucleus — and the nucleus only ever reads
+        # the head anyway: past the kept set the cumulative mass is 1, so no
+        # tail entry can pass the `< top_p` test.)
+        k_eff = min(int(top_k), logits.shape[-1]) if want_k \
+            else logits.shape[-1]
+        head = jax.lax.top_k(logits, k_eff)[0]
+        if want_k:
+            logits = jnp.where(logits < head[..., -1:], -jnp.inf, logits)
+        if want_p:
+            # nucleus sampling (Holtzman et al.): keep the smallest head of
+            # the sorted distribution whose cumulative probability reaches
+            # top_p. With top-k active, softmax over the k-entry head equals
+            # the softmax of the filtered distribution whenever the kth
+            # value is unique — logits tied EXACTLY at the kth value survive
+            # the `< kth` filter but fall outside the head, so their mass is
+            # missing from this cumsum (the old two-sort path counted it).
+            # Tied logits carry equal probability, so either cutoff is a
+            # valid nucleus rule; exact ties are measure-zero for real model
+            # logits. The exclusive cumsum (cum - probs) keeps the argmax
+            # even when its own probability already exceeds top_p; ties at
+            # the cutoff logit are all kept (harmless: equal probability).
+            probs = jax.nn.softmax(head, axis=-1)
+            keep = jnp.cumsum(probs, axis=-1) - probs < top_p
+            # top-1 survives unconditionally, including top_p <= 0 (a common
+            # spelling of "argmax"): an all-False keep would mask EVERY token
+            # and categorical over all -inf degenerates to token id 0
+            keep = keep.at[..., 0].set(True)
+            cutoff = jnp.min(jnp.where(keep, head, jnp.inf), axis=-1,
+                             keepdims=True)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -81,8 +98,17 @@ class DecodeModelSpec:
     #   decode_paged_fn(params, token[B], pos[B], pool, block_tables[B,nb])
     #       -> (logits[B,V], pool)
     #   init_paged_pool(num_blocks, block_size, dtype) -> pool pytree
+    #   verify_paged_fn(params, tokens[B,C], pos[B], pool, block_tables[B,nb])
+    #       -> (logits[B,C,V], pool)
+    #     speculative-decoding verify: writes ALL C tokens' k/v at absolute
+    #     positions pos..pos+C-1 (token [b,0] is the slot's last emitted
+    #     token at its cursor, [b,1:] are draft tokens) and returns the
+    #     logits at EVERY position — row i scores the draft at i+1, the
+    #     first disagreeing row supplies the bonus token. Same chunked-
+    #     prefill machinery as prefill_paged_fn, at an arbitrary cursor.
     prefill_paged_fn: Optional[Callable] = None
     decode_paged_fn: Optional[Callable] = None
+    verify_paged_fn: Optional[Callable] = None
     init_paged_pool: Optional[Callable] = None
     # cache-identity fingerprint for the prefix cache's hash chain
     # (inference/prefix_cache.py): every arch field that changes the KV
@@ -307,9 +333,11 @@ class InferenceEngine:
         """Continuous-batching serving engine over this engine's params:
         persistent paged KV pool + request scheduler (inference/scheduler.py).
         `overrides` patch `config.serving` fields (max_slots, max_context,
-        num_kv_blocks, prefill_chunk, prefill_chunks_per_step). The
-        scheduler also reads this config's `telemetry` block: when enabled
-        it records TTFT/TPOT/queue-wait/e2e histograms and pool gauges
+        num_kv_blocks, prefill_chunk, prefill_chunks_per_step, spec_decode
+        — pass a dict for the nested speculative-decoding block, plus
+        `draft_spec=` for its draft-model drafter). The scheduler also
+        reads this config's `telemetry` block: when enabled it records
+        TTFT/TPOT/queue-wait/e2e histograms and pool gauges
         (docs/profiling.md "Telemetry")."""
         from deepspeed_tpu.inference.scheduler import ServingEngine
         return ServingEngine(self, **overrides)

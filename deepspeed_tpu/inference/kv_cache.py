@@ -170,7 +170,7 @@ class BlockAllocator:
 
 
 def max_written_pos(prompt_len: int, padded_prompt: int, max_new: int,
-                    window: int = 1) -> int:
+                    window: int = 1, spec_k: int = 0) -> int:
     """Highest cache position a request ever WRITES — the single source of
     truth for pool sizing (blocks_needed) AND admission validation (the
     scheduler's table-width check); two copies of this math drifting apart
@@ -184,19 +184,29 @@ def max_written_pos(prompt_len: int, padded_prompt: int, max_new: int,
     windows blindly, so the max_new-1 decode writes round UP to a window
     multiple (the tail of the last window is garbage the scheduler
     discards — but it was written).
+
+    Speculative decoding (`spec_k` > 0 draft tokens per verify step —
+    replaces the decode window): every verify call writes the k/v of its
+    input token AND all k drafts, positions pos..pos+k, and a slot still
+    verifies while one token short of its budget, so the write extent grows
+    by the k-token draft overhang past the last real decode write. A max_new=1
+    request never verifies (its only token comes from prefill logits), so
+    the overhang only applies when there are decode writes at all.
     """
     decode_writes = max_new - 1
-    if window > 1 and decode_writes > 0:
+    if spec_k > 0 and decode_writes > 0:
+        decode_writes += spec_k
+    elif window > 1 and decode_writes > 0:
         decode_writes = -(-decode_writes // window) * window
     return max(padded_prompt - 1, prompt_len - 1 + decode_writes)
 
 
 def blocks_needed(prompt_len: int, padded_prompt: int, max_new: int,
-                  block_size: int, window: int = 1) -> int:
+                  block_size: int, window: int = 1, spec_k: int = 0) -> int:
     """Physical blocks a request occupies for its whole lifetime (see
     max_written_pos for the write-extent reasoning)."""
     return max_written_pos(prompt_len, padded_prompt, max_new,
-                           window) // block_size + 1
+                           window, spec_k) // block_size + 1
 
 
 def _transplant_jit(src_pool, src_idx, dst_pool, dst_idx):

@@ -33,6 +33,16 @@ blocks are mapped into the new slot's table with a refcount bump, and the
 chunked-prefill cursor starts at the cached boundary — a shared system
 prompt prefills once per engine, not once per request. Only host-side state
 changes; the two compiled programs and their shapes are untouched.
+
+Speculative decoding (`serving.spec_decode`, `inference/spec_decode.py`)
+swaps the decode step for a draft+verify loop: a drafter (model-free n-gram
+prompt lookup, or a second smaller model) proposes `draft_k` tokens per
+slot, ONE fixed-shape jitted verify call scores them for all slots at once
+(chunked prefill at positions pos..pos+k), and the longest agreeing prefix
+plus a bonus token is emitted — 1..k+1 tokens per model step. Rejection is
+an O(1) rewind of the slot's length cursor: the rejected tokens' k/v sits
+past the cursor where later writes overwrite it, and the block table never
+moves.
 """
 
 import collections
@@ -44,9 +54,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.inference.engine import sample_logits
 from deepspeed_tpu.inference.kv_cache import (BlockAllocator, TRASH_BLOCK,
                                               blocks_needed, max_written_pos,
                                               transplant_blocks)
+from deepspeed_tpu.inference.spec_decode import accept_greedy, make_drafter
 from deepspeed_tpu.telemetry import Telemetry
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -92,7 +104,7 @@ class _Slot:
     __slots__ = ("idx", "state", "uid", "prompt", "prompt_len", "padded_len",
                  "max_new", "eos", "blocks", "cursor", "pos", "emitted",
                  "hashes", "reg", "cached", "prefill_only",
-                 "t_arrive", "t_admit", "t_first")
+                 "t_arrive", "t_admit", "t_first", "t_prev")
 
     def __init__(self, idx):
         self.idx = idx
@@ -112,6 +124,7 @@ class _Slot:
         self.prefill_only = False  # disaggregated serving: park in _HANDOFF
                                 # after the last chunk instead of decoding
         self.t_arrive = self.t_admit = self.t_first = None  # telemetry stamps
+        self.t_prev = None      # last emission sync (TPOT interpolation anchor)
 
 
 class ServingEngine:
@@ -129,7 +142,7 @@ class ServingEngine:
         # or, batch-style: results = serving.run(requests)
     """
 
-    def __init__(self, engine, **overrides):
+    def __init__(self, engine, draft_spec=None, clock=None, **overrides):
         spec = engine.model_spec
         missing = [n for n in ("prefill_paged_fn", "decode_paged_fn",
                                "init_paged_pool") if getattr(spec, n) is None]
@@ -141,7 +154,15 @@ class ServingEngine:
         self.engine = engine
         self.config = engine.config
         scfg = dataclasses.replace(engine.config.serving, **overrides)
+        if isinstance(scfg.spec_decode, dict):
+            # `serving(spec_decode={"drafter": "ngram", ...})` overrides
+            from deepspeed_tpu.inference.config import SpecDecodeConfig
+            scfg = dataclasses.replace(
+                scfg, spec_decode=SpecDecodeConfig.from_dict(scfg.spec_decode))
         self.serving_config = scfg
+        # injectable clock (tests pin TTFT/TPOT interpolation with it; the
+        # router injects its own for TTL — this one stamps request timing)
+        self._clock = clock if clock is not None else time.monotonic
 
         bs = int(getattr(engine.config, "kv_block_size", 0) or 0)
         if bs <= 0:
@@ -154,6 +175,15 @@ class ServingEngine:
         self.chunk = int(scfg.prefill_chunk or bs)
         self.prefill_budget = max(1, int(scfg.prefill_chunks_per_step))
         self.window = max(1, int(scfg.decode_steps_per_sync))
+        # speculative decoding: the verify step REPLACES the decode step
+        # (and its window) when a drafter is configured
+        self.spec_on = str(scfg.spec_decode.drafter or "off") != "off"
+        self.draft_k = int(scfg.spec_decode.draft_k) if self.spec_on else 0
+        if self.spec_on and spec.verify_paged_fn is None:
+            raise ValueError(
+                f"model spec '{spec.name}' has no verify_paged_fn — "
+                f"speculative decoding needs the k-token paged verify "
+                f"contract (make_gpt_decode_model provides it)")
         num_blocks = int(scfg.num_kv_blocks or
                          (self.max_slots * self.nb + 1))
 
@@ -183,12 +213,30 @@ class ServingEngine:
         self._rng = jax.random.PRNGKey(0)
         self._build_step_fns()
 
+        # drafter AFTER pool/allocator: the draft-model drafter mirrors the
+        # pool geometry and shares the block tables (spec_decode.py)
+        if draft_spec is not None and scfg.spec_decode.drafter != "model":
+            raise ValueError(
+                f"draft_spec was passed but spec_decode.drafter is "
+                f"{scfg.spec_decode.drafter!r} — only the 'model' drafter "
+                f"consumes it (did you mean spec_decode="
+                f"{{'drafter': 'model', ...}}?)")
+        self.drafter = make_drafter(self, scfg.spec_decode,
+                                    draft_spec=draft_spec) \
+            if self.spec_on else None
+
         # telemetry (deepspeed_tpu/telemetry/): TTFT/TPOT/queue-wait/e2e
         # histograms + queue/slot/pool gauges + per-phase spans. Disabled by
         # default — then every record site below is a single attribute check
         # and NOTHING is written anywhere.
         self.telemetry = Telemetry(getattr(engine.config, "telemetry", None),
                                    subsystem="serving")
+        if self.telemetry.enabled and self.spec_on:
+            # acceptance rates live in [0, 1] — the default log-scale ms
+            # buckets would smear them into one decade; pin linear bounds
+            self.telemetry.registry.histogram(
+                "serving/spec_accept_rate",
+                bounds=[i / 20 for i in range(1, 21)])
 
         # observability
         self.steps = 0
@@ -202,6 +250,14 @@ class ServingEngine:
         self.cancelled = 0                  # requests withdrawn via cancel()
         self.handoffs_out = 0               # slots exported to a decode engine
         self.handoffs_in = 0                # slots adopted from a prefill engine
+        self.verify_calls = 0               # spec decode: jitted verify steps
+        self.verify_slot_steps = 0          # per-slot verify participations —
+                                            # the denominator of the per-
+                                            # sequence tokens/step multiple
+        self.drafted_tokens = 0             # real (non-padding) proposals scored
+        self.accepted_tokens = 0            # drafts that matched the target
+        self.spec_emitted_tokens = 0        # tokens emitted by verify steps
+                                            # (accepted + one bonus each)
 
         pool_mb = sum(x.size * x.dtype.itemsize
                       for x in jax.tree_util.tree_leaves(self.pool)) / 2**20
@@ -219,8 +275,6 @@ class ServingEngine:
         cfg = self.engine.config
         decode_paged = self.engine._fn_transform(spec.decode_paged_fn)
         prefill_paged = self.engine._fn_transform(spec.prefill_paged_fn)
-
-        from deepspeed_tpu.inference.engine import sample_logits
 
         def sample(logits, rng):
             return sample_logits(logits, rng, greedy=cfg.greedy,
@@ -261,6 +315,31 @@ class ServingEngine:
         self._decode_step = jax.jit(decode_step, donate_argnums=(3,))
         self._prefill_step = jax.jit(prefill_step, donate_argnums=(4,))
 
+        self._verify_step = None
+        if self.spec_on:
+            verify_paged = self.engine._fn_transform(spec.verify_paged_fn)
+            K1 = self.draft_k + 1
+
+            def verify_step(params, toks, pos, pool, tables, rng):
+                """Fixed-shape verify: score the k drafts of every slot in
+                ONE call — tokens [S, k+1] (col 0 = last emitted token at
+                the cursor, cols 1..k = drafts), positions pos..pos+k per
+                row, all k+1 tokens' k/v written through the tables along
+                the way. Returns the SAMPLED token per position [S, k+1]:
+                under greedy config that is the argmax — the exact-match
+                acceptance target; under stochastic sampling it is the
+                target model's own draw, so exact-match acceptance is the
+                conservative sample-and-match scheme (output distribution
+                preserved; the true rejection-sampling upgrade would
+                return per-position probabilities here instead)."""
+                logits, pool = verify_paged(params, toks, pos, pool, tables)
+                S, V = logits.shape[0], logits.shape[-1]
+                tgt = sample(logits.reshape(S * K1, V),
+                             rng).reshape(S, K1)
+                return tgt, pool
+
+            self._verify_step = jax.jit(verify_step, donate_argnums=(3,))
+
     def _next_rng(self):
         if self.config.greedy:
             return self._rng                        # unused by the sampler
@@ -295,14 +374,19 @@ class ServingEngine:
                 f"request {uid}: max_new_tokens < 1")
         eff_new = 1 if prefill_only else max_new
         eff_window = 1 if prefill_only else self.window
+        # a verify step always writes its full k-draft overhang, so spec
+        # decode sizes past the window math (which it replaces); a
+        # prefill-only slot never verifies here
+        eff_spec = 0 if prefill_only else self.draft_k
         need = blocks_needed(prompt_len, padded, eff_new, self.block_size,
-                             window=eff_window)
-        if max_written_pos(prompt_len, padded, eff_new,
-                           eff_window) >= self.max_context:
+                             window=eff_window, spec_k=eff_spec)
+        if max_written_pos(prompt_len, padded, eff_new, eff_window,
+                           eff_spec) >= self.max_context:
             raise InadmissibleRequestError(
                 f"request {uid}: prompt {prompt_len} + max_new "
-                f"{max_new} (window {eff_window}) exceeds max_context "
-                f"{self.max_context} (raise serving.max_context)")
+                f"{max_new} (window {eff_window}, draft_k {eff_spec}) "
+                f"exceeds max_context {self.max_context} "
+                f"(raise serving.max_context)")
         if need > self.allocator.capacity:
             raise InadmissibleRequestError(
                 f"request {uid}: needs {need} KV blocks, pool has "
@@ -339,7 +423,7 @@ class ServingEngine:
         elif hashes is None:
             hashes = self.prefix_cache.hash_chain(prompt)
         self.queue.append((request, prompt, prompt_len, padded, need, hashes,
-                           time.monotonic(), prefill_only))
+                           self._clock(), prefill_only))
 
     def _resolve_eos(self, req: Request):
         if not req.stop_on_eos:
@@ -413,7 +497,7 @@ class ServingEngine:
             slot.prefill_only = prefill_only
             slot.t_arrive = t_arrive
             if self.telemetry.enabled:
-                slot.t_admit = time.monotonic()
+                slot.t_admit = self._clock()
                 self.telemetry.observe("serving/queue_wait_ms",
                                        (slot.t_admit - t_arrive) * 1e3)
             self.tables[slot.idx, :] = TRASH_BLOCK
@@ -434,19 +518,17 @@ class ServingEngine:
         # first unregistered hash — evicting a head strands its whole tail)
         self.allocator.free(slot.blocks[::-1])
         self.tables[slot.idx, :] = TRASH_BLOCK
+        if self.drafter is not None:
+            self.drafter.retire(slot)       # stateful drafters drop slot state
         timing = None
         if self.telemetry.enabled and slot.t_admit is not None:
-            t_finish = time.monotonic()
-            n = len(slot.emitted)
+            t_finish = self._clock()
             self.telemetry.observe("serving/e2e_ms",
                                    (t_finish - slot.t_arrive) * 1e3)
-            if n > 1 and slot.t_first is not None:
-                # time-per-output-token over the DECODE phase only (vLLM's
-                # TPOT definition): first token is TTFT's, the remaining
-                # n-1 amortize the window/step cadence
-                self.telemetry.observe(
-                    "serving/tpot_ms",
-                    (t_finish - slot.t_first) / (n - 1) * 1e3)
+            # TPOT (serving/tpot_ms) is recorded per emission burst in
+            # _observe_tpot — per-token interpolation that stays honest
+            # when a decode window or an accepted draft emits several
+            # tokens in one sync — not as a per-request mean here
             timing = {"arrival": slot.t_arrive, "admit": slot.t_admit,
                       "first_token": slot.t_first, "finish": t_finish}
         done = CompletedRequest(uid=slot.uid, prompt_len=slot.prompt_len,
@@ -463,13 +545,31 @@ class ServingEngine:
         self.tokens_generated += 1
         if self.telemetry.enabled and len(slot.emitted) == 1 \
                 and slot.t_arrive is not None:
-            slot.t_first = time.monotonic()
+            slot.t_first = slot.t_prev = self._clock()
             self.telemetry.observe("serving/ttft_ms",
                                    (slot.t_first - slot.t_arrive) * 1e3)
         if slot.eos is not None and int(tok) == slot.eos:
             finished.append(self._retire(slot, "eos"))
         elif len(slot.emitted) >= slot.max_new:
             finished.append(self._retire(slot, "length"))
+
+    def _observe_tpot(self, slot, anchor, j):
+        """Per-token TPOT with intra-burst interpolation: a decode sync
+        that emits `j` tokens for a slot since `anchor` (the previous
+        emission sync) interpolates the j timestamps evenly across the
+        interval — j samples of dt/j each — so `serving/tpot_ms` stays
+        honest whether a step emits exactly one token, a K-token decode
+        window, or 1..k+1 tokens from a verify step's accepted draft. (A
+        single per-request mean would hide the burst cadence; dividing
+        wall time by steps instead of tokens would overstate it.)"""
+        if not self.telemetry.enabled or anchor is None or j <= 0:
+            return
+        t_now = self._clock()
+        per_tok = (t_now - anchor) / j * 1e3
+        for _ in range(j):
+            self.telemetry.observe("serving/tpot_ms", per_tok)
+        if slot.state != _FREE:            # retired slots were reset already
+            slot.t_prev = t_now
 
     # ------------------------------------------------------------------
     # cancellation + queue extraction (router TTL / failover build on these)
@@ -579,9 +679,10 @@ class ServingEngine:
         request can never fit here."""
         need = blocks_needed(state["prompt_len"], state["padded_len"],
                              state["max_new"], self.block_size,
-                             window=self.window)
+                             window=self.window, spec_k=self.draft_k)
         if max_written_pos(state["prompt_len"], state["padded_len"],
-                           state["max_new"], self.window) >= self.max_context:
+                           state["max_new"], self.window,
+                           self.draft_k) >= self.max_context:
             raise InadmissibleRequestError(
                 f"request {state['uid']}: handoff target max_context "
                 f"{self.max_context} too small (prompt {state['prompt_len']}"
@@ -625,6 +726,7 @@ class ServingEngine:
         slot.t_arrive = state["t_arrive"]
         slot.t_admit = state.get("t_admit")
         slot.t_first = state.get("t_first")
+        slot.t_prev = slot.t_first         # TPOT interpolation re-anchors here
         self.tables[slot.idx, :] = TRASH_BLOCK
         self.tables[slot.idx, :len(blocks)] = blocks
         self.handoffs_in += 1
@@ -637,6 +739,8 @@ class ServingEngine:
         slot = self._handoff_slot(uid)
         self.allocator.free(slot.blocks[::-1])
         self.tables[slot.idx, :] = TRASH_BLOCK
+        if self.drafter is not None:
+            self.drafter.retire(slot)
         slot.reset()
         self.handoffs_out += 1
 
@@ -645,6 +749,64 @@ class ServingEngine:
             if s.state == _HANDOFF and s.uid == uid:
                 return s
         raise KeyError(f"no handoff-ready slot for request {uid!r}")
+
+    # ------------------------------------------------------------------
+    # speculative decoding: draft -> one fixed-shape verify -> accept+rewind
+    # ------------------------------------------------------------------
+
+    def _verify_decode(self, dec, tok, pos, tables, finished):
+        """Draft+verify replacing the decode step: the drafter proposes up
+        to `draft_k` tokens per slot, ONE jitted verify call scores drafts
+        for ALL slots (writing their k/v at pos..pos+k through the tables),
+        and each slot emits its longest agreeing prefix plus the bonus
+        token from the first disagreeing row — 1..k+1 tokens per model
+        step. Rejection is the O(1) rollback the paged layout buys: the
+        cursor advances only past accepted tokens, the rejected tokens'
+        k/v sits beyond it (overwritten by the next verify's writes, never
+        attended — the causal mask stops at the cursor), and the slot's
+        blocks and table rows do not move."""
+        with self.telemetry.span("serving/draft"):
+            drafts, dlens = self.drafter.propose(dec, tok, pos, tables)
+        toks = np.concatenate([tok[:, None], drafts], axis=1)
+        with self.telemetry.span("serving/verify"):
+            tgt, self.pool = self._verify_step(self.engine.params, toks,
+                                               pos, self.pool, tables,
+                                               self._next_rng())
+            tgt = np.asarray(jax.device_get(tgt))       # [S, draft_k+1]
+        self.verify_calls += 1
+        self.decode_steps += 1
+        for s in dec:
+            dlen = int(dlens[s.idx])
+            n, emitted = accept_greedy(drafts[s.idx], tgt[s.idx], dlen)
+            # O(1) rollback/advance: the cursor moves past the accepted
+            # prefix + bonus only; everything else written this step is
+            # dead weight the next verify overwrites
+            s.pos += n + 1
+            self.verify_slot_steps += 1
+            self.drafted_tokens += dlen
+            self.accepted_tokens += n
+            if self.telemetry.enabled:
+                if dlen:
+                    self.telemetry.observe("serving/spec_accept_rate",
+                                           n / dlen)
+                self.telemetry.inc("serving/spec_accepted_tokens", n)
+                self.telemetry.inc("serving/spec_drafted_tokens", dlen)
+            anchor, j = s.t_prev, 0
+            for t in emitted:
+                # EOS inside an accepted draft retires the slot right here,
+                # at the EOS position — the accepted tail past it (and the
+                # bonus) is discarded exactly like a window tail
+                self._emit(s, t, finished)
+                j += 1
+                if s.state == _FREE:
+                    break
+            # j, not len(emitted): an EOS or max_new retirement mid-burst
+            # truncates the accepted tail — only tokens that actually
+            # reached the output count toward the tokens/step multiple
+            self.spec_emitted_tokens += j
+            self._observe_tpot(s, anchor, j)
+        if self.telemetry.enabled:
+            self.telemetry.inc("serving/spec_verify_steps")
 
     # ------------------------------------------------------------------
     # the engine step: admit -> prefill chunk(s) -> decode all slots
@@ -677,6 +839,14 @@ class ServingEngine:
                         params, chunk, np.asarray([start], np.int32),
                         np.asarray([last], np.int32), self.pool,
                         self.tables[slot.idx][None], self._next_rng())
+                if self.drafter is not None:
+                    # a stateful drafter (the draft model) shadows the chunk
+                    # into its own pool through the same table — the draft
+                    # cache is warm the moment this slot starts verifying
+                    self.drafter.prefill_chunk(
+                        slot, chunk, np.asarray([start], np.int32),
+                        np.asarray([last], np.int32),
+                        self.tables[slot.idx][None])
                 slot.cursor = start + self.chunk
                 budget -= 1
                 self.prefill_chunks += 1
@@ -705,7 +875,8 @@ class ServingEngine:
         # ride along against the trash block. With window > 1 the call
         # emits a whole window per slot; a slot finishing mid-window
         # discards the tail (already written to its own blocks — the
-        # blocks_needed window padding covers it)
+        # blocks_needed window padding covers it). With spec decode on,
+        # the verify step replaces this call entirely.
         dec = [s for s in self.slots if s.state == _DECODE]
         if dec:
             self.peak_active = max(self.peak_active, len(dec))
@@ -716,18 +887,24 @@ class ServingEngine:
                 tok[s.idx] = s.emitted[-1]
                 pos[s.idx] = s.pos
                 tables[s.idx] = self.tables[s.idx]
-            with self.telemetry.span("serving/decode_window"):
-                nxt, self.pool = self._decode_step(params, tok, pos,
-                                                   self.pool, tables,
-                                                   self._next_rng())
-                nxt = np.asarray(jax.device_get(nxt))   # [S, window]
-            self.decode_steps += 1
-            for s in dec:
-                s.pos += self.window
-                for t in nxt[s.idx]:
-                    self._emit(s, int(t), finished)
-                    if s.state == _FREE:                # retired mid-window
-                        break
+            if self.spec_on:
+                self._verify_decode(dec, tok, pos, tables, finished)
+            else:
+                with self.telemetry.span("serving/decode_window"):
+                    nxt, self.pool = self._decode_step(params, tok, pos,
+                                                       self.pool, tables,
+                                                       self._next_rng())
+                    nxt = np.asarray(jax.device_get(nxt))   # [S, window]
+                self.decode_steps += 1
+                for s in dec:
+                    s.pos += self.window
+                    anchor, j = s.t_prev, 0
+                    for t in nxt[s.idx]:
+                        self._emit(s, int(t), finished)
+                        j += 1
+                        if s.state == _FREE:            # retired mid-window
+                            break
+                    self._observe_tpot(s, anchor, j)
 
         if self.telemetry.enabled:
             self.telemetry.set_gauge("serving/queue_depth", len(self.queue))
@@ -768,11 +945,16 @@ class ServingEngine:
         return out
 
     def compile_stats(self) -> Dict[str, int]:
-        """Compiled-program counts of the two persistent step functions —
-        the serving promise is that these stay at 1 each for the engine's
-        lifetime, across any mix of request shapes."""
-        return {"decode_step": int(self._decode_step._cache_size()),
-                "prefill_step": int(self._prefill_step._cache_size())}
+        """Compiled-program counts of the persistent step functions — the
+        serving promise is that these stay at 1 each for the engine's
+        lifetime, across any mix of request shapes (the verify and draft
+        programs appear, and join the promise, when spec decode is on)."""
+        out = {"decode_step": int(self._decode_step._cache_size()),
+               "prefill_step": int(self._prefill_step._cache_size())}
+        if self.spec_on:
+            out["verify_step"] = int(self._verify_step._cache_size())
+            out.update(self.drafter.compile_stats())
+        return out
 
     def stats(self) -> Dict[str, Any]:
         out = {"steps": self.steps, "decode_steps": self.decode_steps,
@@ -787,6 +969,23 @@ class ServingEngine:
                "reclaimable_blocks": self.allocator.num_reclaimable,
                "available_blocks": self.allocator.available,
                "compiles": self.compile_stats()}
+        if self.spec_on:
+            out["spec_decode"] = {
+                "drafter": self.drafter.name,
+                "draft_k": self.draft_k,
+                "verify_steps": self.verify_calls,
+                "drafted_tokens": self.drafted_tokens,
+                "accepted_tokens": self.accepted_tokens,
+                "emitted_tokens": self.spec_emitted_tokens,
+                # accepted/proposed (the drafter's hit rate) and tokens
+                # emitted per SEQUENCE per model step (the throughput
+                # multiple: 1.0 = spec decode is pure overhead, draft_k+1
+                # is the ceiling; the denominator is per-slot verify
+                # participations, so batching doesn't inflate it)
+                "acceptance_rate": (self.accepted_tokens /
+                                    max(1, self.drafted_tokens)),
+                "accepted_tokens_per_step": (self.spec_emitted_tokens /
+                                             max(1, self.verify_slot_steps))}
         if self.prefix_cache is not None:
             out["prefix_cache"] = {
                 "hit_blocks": self.prefix_hit_blocks,

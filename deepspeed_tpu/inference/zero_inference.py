@@ -27,6 +27,7 @@ import numpy as np
 
 from deepspeed_tpu.comm import mesh as mesh_mod
 from deepspeed_tpu.inference.config import TpuInferenceConfig
+from deepspeed_tpu.inference.engine import sample_logits
 from deepspeed_tpu.runtime.param_swap import LayerParamStore, LayerStreamer
 from deepspeed_tpu.utils.logging import log_dist
 from deepspeed_tpu.utils.tree import tree_cast
@@ -151,7 +152,6 @@ class ZeroInferenceEngine:
 
     def _sample(self, logits, rng):
         """Config-driven sampling — the SAME rule as the resident engine."""
-        from deepspeed_tpu.inference.engine import sample_logits
         return sample_logits(logits, rng, greedy=self.config.greedy,
                              temperature=self.config.temperature,
                              top_k=self.config.top_k,

@@ -1043,6 +1043,20 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
         logits = _lm_head(params, x, cfg)[:, 0]
         return logits, pool
 
+    def verify_paged_fn(params, tokens, pos, pool, block_tables):
+        """Speculative-decoding verify: score C tokens per row in ONE pass
+        at an arbitrary cursor. Identical machinery to a prefill chunk —
+        `_paged_attend`'s absolute-position causal mask already lets row b's
+        positions start anywhere — but the logits of EVERY position come
+        back, not just the last: row i's argmax is the greedy ground truth
+        for draft i+1 and the bonus token at the first disagreement."""
+        B, C = tokens.shape
+        positions = pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None]
+        x = _embed(params, tokens, positions, cfg)
+        x, pool = _scan_paged(params, x, pool, block_tables, positions)
+        logits = _lm_head(params, x, cfg)
+        return logits, pool
+
     def init_paged_pool(num_blocks, block_size, dtype=jnp.bfloat16):
         return init_paged_kv_pool(cfg, num_blocks, block_size, dtype)
 
@@ -1050,6 +1064,7 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
                            init_cache=init_cache, params=params, name=name,
                            prefill_paged_fn=prefill_paged_fn,
                            decode_paged_fn=decode_paged_fn,
+                           verify_paged_fn=verify_paged_fn,
                            init_paged_pool=init_paged_pool,
                            cache_fingerprint=gpt_cache_identity(cfg, name))
 

@@ -13,12 +13,17 @@ functions). `HybridEngine` = training Engine + a decode path compiled against
 the live params, with the reference's `generate()` surface.
 """
 
+import dataclasses
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+# one sampling rule across the framework (hoisted: this used to be a local
+# import inside _build_generate — the serving scheduler, the spill engine
+# and this rollout all share the exact same sampler)
+from deepspeed_tpu.inference.engine import sample_logits
 from deepspeed_tpu.runtime.engine import Engine, ModelSpec
 from deepspeed_tpu.utils.logging import logger, log_dist
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
@@ -40,15 +45,27 @@ class HybridEngine(Engine):
         self._decode_spec = decode_spec
         self._generate_fn = None
 
+    def as_draft_spec(self):
+        """This engine's decode spec bound to the CURRENT training params —
+        the reusable draft-model path: the RLHF actor (or any model this
+        engine trains) can draft for a bigger serving target via
+        ``target.serving(draft_spec=hybrid.as_draft_spec(),
+        spec_decode={"drafter": "model"})``, and conversely a small frozen
+        copy of the actor speeds up the rollout itself when rollouts run
+        through a ServingEngine. Params are live sharded arrays, so
+        "binding" is a dataclass field swap — no gather, no copy."""
+        assert self._decode_spec is not None, \
+            "HybridEngine needs a DecodeModelSpec (set_decode_spec)"
+        return dataclasses.replace(self._decode_spec,
+                                   params=self.state.params)
+
     def _build_generate(self, max_new, greedy, temperature, top_k, top_p):
         spec = self._decode_spec
         assert spec is not None, "HybridEngine needs a DecodeModelSpec (set_decode_spec)"
         # one sampling rule across the framework: the inference engines'
-        # sample_logits (greedy / temperature / top-k) — the RLHF rollout
-        # path must not grow a second, weaker sampler (reference
-        # `hybrid_engine.py:174` generates through its inference module)
-        from deepspeed_tpu.inference.engine import sample_logits
-
+        # sample_logits (module-level import) — the RLHF rollout path must
+        # not grow a second, weaker sampler (reference `hybrid_engine.py:174`
+        # generates through its inference module)
         def sample(logits, rng):
             return sample_logits(logits, None if greedy else rng, greedy=greedy,
                                  temperature=temperature, top_k=top_k,

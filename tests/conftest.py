@@ -64,6 +64,13 @@ def pytest_configure(config):
                    "CPU-harness-safe, rides in tier-1; run it alone with "
                    "pytest -m router)")
     config.addinivalue_line(
+        "markers", "spec_decode: speculative-decoding suite "
+                   "(tests/test_spec_decode.py — n-gram + draft-model "
+                   "drafters, fixed-shape batched verify, O(1) cursor "
+                   "rollback on the paged pool) — fast and "
+                   "CPU-harness-safe, rides in tier-1; run it alone with "
+                   "pytest -m spec_decode)")
+    config.addinivalue_line(
         "markers", "telemetry: unified telemetry suite "
                    "(tests/test_telemetry.py — metrics registry, TTFT/TPOT "
                    "histograms, MFU accounting, exporters, dstpu_metrics) — "

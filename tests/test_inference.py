@@ -388,6 +388,54 @@ def test_top_p_nucleus_sampling_distribution():
     assert set(np.unique(both)) <= {0, 1, 2} and 3 not in both
 
 
+def test_sample_logits_single_sort_parity_with_two_sort_reference():
+    """Satellite regression: the top-k/top-p filter now runs ONE
+    `lax.top_k` whose sorted head feeds both the kth-value cut and the
+    nucleus cumsum (the old path paid two full-vocab `jnp.sort`s). The
+    surviving distribution — and therefore the exact draw for any key —
+    must match the two-sort reference for every filter combination."""
+    from deepspeed_tpu.inference.engine import sample_logits
+
+    def reference_filtered(logits, temperature=1.0, top_k=0, top_p=1.0):
+        # the pre-rewrite implementation, kept verbatim as the oracle
+        logits = logits / jnp.maximum(temperature, 1e-6)
+        if top_k and top_k > 0:
+            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p is not None and top_p < 1.0:
+            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            keep = jnp.cumsum(probs, axis=-1) - probs < top_p
+            keep = keep.at[..., 0].set(True)
+            cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+                             axis=-1, keepdims=True)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        return logits
+
+    rng = np.random.default_rng(11)
+    logits = jnp.asarray(rng.normal(size=(4, 257)) * 3.0, jnp.float32)
+    cases = [dict(top_k=16), dict(top_p=0.7), dict(top_k=16, top_p=0.7),
+             dict(top_k=1), dict(top_p=0.0), dict(top_k=8, top_p=0.95),
+             dict(top_k=257, top_p=0.5), dict(temperature=0.3, top_k=5,
+                                              top_p=0.8)]
+    for kw in cases:
+        ref = reference_filtered(logits, **kw)
+        for i in range(6):
+            key = jax.random.PRNGKey(i)
+            got = sample_logits(logits, key, greedy=False, **kw)
+            want = jax.random.categorical(key, ref, axis=-1)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want),
+                                          err_msg=str(kw))
+    # statistical sanity on the surviving SUPPORT: the one-sort filter
+    # masks exactly the tokens the reference masks
+    for kw in cases:
+        ref_mask = np.isfinite(np.asarray(reference_filtered(logits, **kw)))
+        probe = sample_logits(logits, jax.random.PRNGKey(0), greedy=False,
+                              **kw)
+        for b, tok in enumerate(np.asarray(probe)):
+            assert ref_mask[b, tok], (kw, b, tok)
+
+
 def test_generate_top_p_threaded_through_engines():
     """cfg.top_p must reach the resident generate loop and the serving
     scheduler: top_p ~ 0 collapses sampling to greedy, so a sampled run at

@@ -385,8 +385,10 @@ def test_serving_latency_histograms_mixed_trace(tmp_path):
     assert lat["ttft_ms"]["count"] == len(reqs)
     assert lat["e2e_ms"]["count"] == len(reqs)
     assert lat["queue_wait_ms"]["count"] == len(reqs)
-    # every request here generates > 1 token, so each lands one TPOT sample
-    assert lat["tpot_ms"]["count"] == len(reqs)
+    # TPOT is per-TOKEN (interpolated inside each emission burst, so decode
+    # windows and accepted drafts stay honest): one sample per decode-phase
+    # token — every generated token except each request's first
+    assert lat["tpot_ms"]["count"] == sum(n for _, n in shapes) - len(reqs)
     assert 0 < lat["ttft_ms"]["p50"] <= lat["ttft_ms"]["p99"]
     assert 0 < lat["tpot_ms"]["p50"] <= lat["tpot_ms"]["p99"]
     assert lat["queue_wait_ms"]["min"] >= 0
