@@ -507,6 +507,10 @@ def run_serving_lane(steps=1, warmup=1):
             # aggregate tokens/s hides the tail — these do not
             "latency": _latency_extra(serving),
             "compiles": serving.compile_stats(),
+            # compile-watchdog verdict: recompiles after warmup on the
+            # persistent step programs (the contract is 0 — a nonzero here
+            # names a shape regression before any p99 does)
+            "recompiles": serving.telemetry.watchdog.recompiles,
             # the recompile tax, counted: generate programs static batching
             # built for this one trace (one per batch shape x max_new
             # bucket) vs the serving engine's lifetime total of two

@@ -262,6 +262,14 @@ class TelemetryConfig(ConfigModel):
                                      # exact program flops — an extra one-time
                                      # compile); False = analytic 6N model
                                      # flops (the PaLM MFU convention, free)
+    tracing: bool = False            # request-scoped span trees:
+                                     # <subsystem>.trace.jsonl (dstpu_trace)
+                                     # + a flow-linked chrome trace (Perfetto)
+    flight_recorder: bool = False    # bounded ring of scheduling events,
+                                     # dumped to <subsystem>.flightrec.*.json
+                                     # on replica failure / sentinel trip /
+                                     # dump signal
+    flight_recorder_events: int = 256  # ring capacity (last-N events kept)
 
 
 @dataclass

@@ -104,7 +104,7 @@ class _Slot:
     __slots__ = ("idx", "state", "uid", "prompt", "prompt_len", "padded_len",
                  "max_new", "eos", "blocks", "cursor", "pos", "emitted",
                  "hashes", "reg", "cached", "prefill_only",
-                 "t_arrive", "t_admit", "t_first", "t_prev")
+                 "t_arrive", "t_admit", "t_first", "t_prev", "trace")
 
     def __init__(self, idx):
         self.idx = idx
@@ -125,6 +125,7 @@ class _Slot:
                                 # after the last chunk instead of decoding
         self.t_arrive = self.t_admit = self.t_first = None  # telemetry stamps
         self.t_prev = None      # last emission sync (TPOT interpolation anchor)
+        self.trace = None       # TraceContext (None unless tracing is on)
 
 
 class ServingEngine:
@@ -187,6 +188,27 @@ class ServingEngine:
         num_blocks = int(scfg.num_kv_blocks or
                          (self.max_slots * self.nb + 1))
 
+        # telemetry (deepspeed_tpu/telemetry/): TTFT/TPOT/queue-wait/e2e
+        # histograms + queue/slot/pool gauges + per-phase spans — built
+        # BEFORE the step programs so the compile watchdog can wrap them.
+        # Disabled by default — then every record site below is a single
+        # attribute check and NOTHING is written anywhere.
+        self.telemetry = Telemetry(getattr(engine.config, "telemetry", None),
+                                   subsystem="serving")
+        if self.telemetry.enabled and self.spec_on:
+            # acceptance rates live in [0, 1] — the default log-scale ms
+            # buckets would smear them into one decade; pin linear bounds
+            self.telemetry.registry.histogram(
+                "serving/spec_accept_rate",
+                bounds=[i / 20 for i in range(1, 21)])
+        # request tracing + flight recorder: the engine's own (from its
+        # telemetry config) until a router injects the POOL-shared ones
+        # via attach_observability — then every replica's spans land in
+        # one file under one trace id, on one Perfetto track per replica
+        self.tracer = self.telemetry.tracer
+        self.flightrec = self.telemetry.flightrec
+        self.trace_tid = 0
+
         # place the pool with the engine mesh's (replicated) NamedSharding up
         # front: the step programs RETURN pools with exactly this sharding,
         # so a plain uncommitted jnp.zeros pool would give the very first
@@ -224,19 +246,6 @@ class ServingEngine:
         self.drafter = make_drafter(self, scfg.spec_decode,
                                     draft_spec=draft_spec) \
             if self.spec_on else None
-
-        # telemetry (deepspeed_tpu/telemetry/): TTFT/TPOT/queue-wait/e2e
-        # histograms + queue/slot/pool gauges + per-phase spans. Disabled by
-        # default — then every record site below is a single attribute check
-        # and NOTHING is written anywhere.
-        self.telemetry = Telemetry(getattr(engine.config, "telemetry", None),
-                                   subsystem="serving")
-        if self.telemetry.enabled and self.spec_on:
-            # acceptance rates live in [0, 1] — the default log-scale ms
-            # buckets would smear them into one decade; pin linear bounds
-            self.telemetry.registry.histogram(
-                "serving/spec_accept_rate",
-                bounds=[i / 20 for i in range(1, 21)])
 
         # observability
         self.steps = 0
@@ -311,9 +320,17 @@ class ServingEngine:
             return sample(logits, rng), pool
 
         # the pool is donated: the update is in-place in HBM, the old buffer
-        # is dead the moment the step returns the new one
-        self._decode_step = jax.jit(decode_step, donate_argnums=(3,))
-        self._prefill_step = jax.jit(prefill_step, donate_argnums=(4,))
+        # is dead the moment the step returns the new one. The compile
+        # watchdog (telemetry/flight_recorder.py) wraps each program when
+        # telemetry is on: the serving promise is ONE compile each for the
+        # engine's lifetime, and any cache miss after that warmup is
+        # recorded (program name, shapes, compile_ms) — with telemetry off,
+        # wrap() returns the jitted function untouched.
+        wd = self.telemetry.watchdog
+        self._decode_step = wd.wrap(
+            "decode_step", jax.jit(decode_step, donate_argnums=(3,)))
+        self._prefill_step = wd.wrap(
+            "prefill_step", jax.jit(prefill_step, donate_argnums=(4,)))
 
         self._verify_step = None
         if self.spec_on:
@@ -338,7 +355,8 @@ class ServingEngine:
                              rng).reshape(S, K1)
                 return tgt, pool
 
-            self._verify_step = jax.jit(verify_step, donate_argnums=(3,))
+            self._verify_step = wd.wrap(
+                "verify_step", jax.jit(verify_step, donate_argnums=(3,)))
 
     def _next_rng(self):
         if self.config.greedy:
@@ -393,8 +411,20 @@ class ServingEngine:
                 f"{self.allocator.capacity} (raise serving.num_kv_blocks)")
         return need
 
+    def attach_observability(self, tracer=None, flightrec=None, tid=None):
+        """Router injection point: share the POOL's tracer / flight
+        recorder (so every replica's spans land in one trace file and one
+        black box) and take this engine's Perfetto track id. Standalone
+        engines keep their own from the telemetry config."""
+        if tracer is not None:
+            self.tracer = tracer
+        if flightrec is not None:
+            self.flightrec = flightrec
+        if tid is not None:
+            self.trace_tid = int(tid)
+
     def submit(self, request: Request, prefill_only: bool = False,
-               hashes: Optional[List[bytes]] = None):
+               hashes: Optional[List[bytes]] = None, trace=None):
         """Queue a request. Raises `InadmissibleRequestError` if it can
         NEVER be admitted (it exceeds the engine's max_context table width
         or the whole pool); a request that merely doesn't fit *right now*
@@ -409,7 +439,10 @@ class ServingEngine:
         replica. `hashes` hands in a precomputed chain (the router hashes
         once per request for affinity scoring; chains are
         fingerprint-identical across a pool, so re-hashing per dispatch —
-        and again per failover re-dispatch — would be pure waste)."""
+        and again per failover re-dispatch — would be pure waste).
+        `trace` carries the router's `TraceContext`; a standalone engine
+        with tracing on mints its own here, so the request's whole life is
+        one connected span tree either way."""
         prompt = np.asarray(request.tokens, np.int32).reshape(-1)
         prompt_len = int(prompt.shape[0])
         padded = -(-prompt_len // self.chunk) * self.chunk
@@ -422,8 +455,17 @@ class ServingEngine:
             hashes = None
         elif hashes is None:
             hashes = self.prefix_cache.hash_chain(prompt)
+        t_arrive = self._clock()
+        if self.tracer.enabled:
+            if trace is None:
+                # no router above: this engine owns the trace end to end
+                trace = self.tracer.start(request.uid, t0=t_arrive,
+                                          owner="engine")
+            self.tracer.event(trace, "submit", t_arrive, tid=self.trace_tid,
+                              attrs={"prompt_len": prompt_len,
+                                     "max_new": int(request.max_new_tokens)})
         self.queue.append((request, prompt, prompt_len, padded, need, hashes,
-                           self._clock(), prefill_only))
+                           t_arrive, prefill_only, trace))
 
     def _resolve_eos(self, req: Request):
         if not req.stop_on_eos:
@@ -439,7 +481,7 @@ class ServingEngine:
         free = [s for s in self.slots if s.state == _FREE]
         while self.queue and free:
             (req, prompt, prompt_len, padded, need, hashes,
-             t_arrive, prefill_only) = self.queue[0]
+             t_arrive, prefill_only, trace) = self.queue[0]
             hit = []
             if hashes:
                 # longest-prefix match, capped so at least the final prompt
@@ -462,8 +504,14 @@ class ServingEngine:
                 hit = hit[:m]
                 for b in hit:
                     self.allocator.incref(b)
+            ev0 = self.allocator.evictions
             blocks = self.allocator.alloc(need - len(hit))
             if blocks is None:
+                if self.flightrec.enabled:
+                    self.flightrec.record(
+                        "backpressure", uid=req.uid, need=need - len(hit),
+                        available=self.allocator.available,
+                        queued=len(self.queue))
                 # pool exhausted: FIFO backpressure — the head waits for
                 # retirements to free blocks (no reordering: a stream of
                 # small requests must not starve a big one). Decref the
@@ -500,6 +548,30 @@ class ServingEngine:
                 slot.t_admit = self._clock()
                 self.telemetry.observe("serving/queue_wait_ms",
                                        (slot.t_admit - t_arrive) * 1e3)
+            slot.trace = trace
+            if self.tracer.enabled and trace is not None:
+                # the queue-wait span + an admit mark; flow_end lands the
+                # router's dispatch arrow on THIS replica's Perfetto track
+                t_adm = slot.t_admit if slot.t_admit is not None \
+                    else self._clock()
+                self.tracer.flow_end(trace, t_adm, tid=self.trace_tid)
+                self.tracer.record(trace, "queued", t_arrive,
+                                   max(0.0, t_adm - t_arrive),
+                                   tid=self.trace_tid)
+                self.tracer.event(trace, "admit", t_adm, tid=self.trace_tid,
+                                  attrs={"slot": slot.idx,
+                                         "blocks": len(blocks),
+                                         "cached_blocks": len(hit)})
+            if self.flightrec.enabled:
+                # admission decision: the black box's bread and butter
+                self.flightrec.record("admit", uid=req.uid, slot=slot.idx,
+                                      blocks=len(blocks),
+                                      cached_blocks=len(hit),
+                                      queued=len(self.queue))
+                if self.allocator.evictions > ev0:
+                    self.flightrec.record(
+                        "eviction", uid=req.uid,
+                        evicted=self.allocator.evictions - ev0)
             self.tables[slot.idx, :] = TRASH_BLOCK
             self.tables[slot.idx, :len(blocks)] = blocks
             if hit:
@@ -531,6 +603,20 @@ class ServingEngine:
             # tokens in one sync — not as a per-request mean here
             timing = {"arrival": slot.t_arrive, "admit": slot.t_admit,
                       "first_token": slot.t_first, "finish": t_finish}
+        if self.tracer.enabled and slot.trace is not None:
+            t_end = self._clock()
+            self.tracer.event(slot.trace, "retire", t_end,
+                              tid=self.trace_tid,
+                              attrs={"reason": reason,
+                                     "tokens": len(slot.emitted)})
+            if slot.trace.owner == "engine":
+                # no router above: this engine closes the root (e2e) span
+                self.tracer.finish(slot.trace, t_end, tid=self.trace_tid,
+                                   attrs={"reason": reason})
+        if self.flightrec.enabled:
+            self.flightrec.record("retire", uid=slot.uid, reason=reason,
+                                  tokens=len(slot.emitted),
+                                  freed_blocks=len(slot.blocks))
         done = CompletedRequest(uid=slot.uid, prompt_len=slot.prompt_len,
                                 tokens=np.asarray(slot.emitted, np.int32),
                                 finish_reason=reason,
@@ -588,6 +674,8 @@ class ServingEngine:
             if rec[0].uid == uid:
                 del self.queue[i]
                 self.cancelled += 1
+                if self.flightrec.enabled:
+                    self.flightrec.record("cancel", uid=uid, queued=True)
                 return CompletedRequest(uid=uid, prompt_len=rec[2],
                                         tokens=np.zeros((0,), np.int32),
                                         finish_reason="cancelled")
@@ -666,7 +754,8 @@ class ServingEngine:
                 "emitted": list(slot.emitted), "pos": slot.pos,
                 "blocks": list(slot.blocks[:n_used]),
                 "cached": slot.cached, "t_arrive": slot.t_arrive,
-                "t_admit": slot.t_admit, "t_first": slot.t_first}
+                "t_admit": slot.t_admit, "t_first": slot.t_first,
+                "trace": slot.trace}
 
     def adopt_handoff(self, state: Dict[str, Any], src_pool) -> bool:
         """Adopt a prefilled slot exported by another engine: allocate the
@@ -727,6 +816,7 @@ class ServingEngine:
         slot.t_admit = state.get("t_admit")
         slot.t_first = state.get("t_first")
         slot.t_prev = slot.t_first         # TPOT interpolation re-anchors here
+        slot.trace = state.get("trace")    # decode spans continue the trace
         self.tables[slot.idx, :] = TRASH_BLOCK
         self.tables[slot.idx, :len(blocks)] = blocks
         self.handoffs_in += 1
@@ -765,18 +855,22 @@ class ServingEngine:
         k/v sits beyond it (overwritten by the next verify's writes, never
         attended — the causal mask stops at the cursor), and the slot's
         blocks and table rows do not move."""
-        with self.telemetry.span("serving/draft"):
+        tr_on = self.tracer.enabled
+        with self.telemetry.span("serving/draft", tid=self.trace_tid):
             drafts, dlens = self.drafter.propose(dec, tok, pos, tables)
         toks = np.concatenate([tok[:, None], drafts], axis=1)
-        with self.telemetry.span("serving/verify"):
+        t0 = self._clock() if tr_on else 0.0
+        with self.telemetry.span("serving/verify", tid=self.trace_tid):
             tgt, self.pool = self._verify_step(self.engine.params, toks,
                                                pos, self.pool, tables,
                                                self._next_rng())
             tgt = np.asarray(jax.device_get(tgt))       # [S, draft_k+1]
+        t1 = self._clock() if tr_on else 0.0
         self.verify_calls += 1
         self.decode_steps += 1
         for s in dec:
             dlen = int(dlens[s.idx])
+            ctx, uid = s.trace, s.uid         # _retire resets the slot
             n, emitted = accept_greedy(drafts[s.idx], tgt[s.idx], dlen)
             # O(1) rollback/advance: the cursor moves past the accepted
             # prefix + bonus only; everything else written this step is
@@ -805,6 +899,16 @@ class ServingEngine:
             # reached the output count toward the tokens/step multiple
             self.spec_emitted_tokens += j
             self._observe_tpot(s, anchor, j)
+            if tr_on and ctx is not None:
+                self.tracer.record(ctx, "verify", t0, t1 - t0,
+                                   tid=self.trace_tid,
+                                   attrs={"drafted": dlen, "accepted": n,
+                                          "emitted": j})
+            if self.flightrec.enabled and n < dlen:
+                # spec-decode rollback: the cursor rewound past dlen-n
+                # rejected draft tokens — O(1), but worth the black box
+                self.flightrec.record("rollback", uid=uid,
+                                      rejected=dlen - n, accepted=n)
         if self.telemetry.enabled:
             self.telemetry.inc("serving/spec_verify_steps")
 
@@ -818,7 +922,7 @@ class ServingEngine:
         self.steps += 1
         params = self.engine.params
 
-        with self.telemetry.span("serving/admit"):
+        with self.telemetry.span("serving/admit", tid=self.trace_tid):
             self._admit()
 
         # chunked prefill, bounded per step so arriving prompts cannot stall
@@ -834,11 +938,20 @@ class ServingEngine:
                 chunk[0, :len(seg)] = seg
                 final = start + self.chunk >= slot.padded_len
                 last = (slot.prompt_len - 1 - start) if final else self.chunk - 1
-                with self.telemetry.span("serving/prefill_chunk"):
+                tr_on = self.tracer.enabled and slot.trace is not None
+                t0 = self._clock() if tr_on else 0.0
+                with self.telemetry.span("serving/prefill_chunk",
+                                         tid=self.trace_tid):
                     tok, self.pool = self._prefill_step(
                         params, chunk, np.asarray([start], np.int32),
                         np.asarray([last], np.int32), self.pool,
                         self.tables[slot.idx][None], self._next_rng())
+                if tr_on:
+                    t1 = self._clock()
+                    self.tracer.record(slot.trace, "prefill_chunk", t0,
+                                       t1 - t0, tid=self.trace_tid,
+                                       attrs={"start": start,
+                                              "chunk": self.chunk})
                 if self.drafter is not None:
                     # a stateful drafter (the draft model) shadows the chunk
                     # into its own pool through the same table — the draft
@@ -890,14 +1003,19 @@ class ServingEngine:
             if self.spec_on:
                 self._verify_decode(dec, tok, pos, tables, finished)
             else:
-                with self.telemetry.span("serving/decode_window"):
+                tr_on = self.tracer.enabled
+                t0 = self._clock() if tr_on else 0.0
+                with self.telemetry.span("serving/decode_window",
+                                         tid=self.trace_tid):
                     nxt, self.pool = self._decode_step(params, tok, pos,
                                                        self.pool, tables,
                                                        self._next_rng())
                     nxt = np.asarray(jax.device_get(nxt))   # [S, window]
+                t1 = self._clock() if tr_on else 0.0
                 self.decode_steps += 1
                 for s in dec:
                     s.pos += self.window
+                    ctx = s.trace             # _retire resets the slot
                     anchor, j = s.t_prev, 0
                     for t in nxt[s.idx]:
                         self._emit(s, int(t), finished)
@@ -905,6 +1023,10 @@ class ServingEngine:
                         if s.state == _FREE:            # retired mid-window
                             break
                     self._observe_tpot(s, anchor, j)
+                    if tr_on and ctx is not None:
+                        self.tracer.record(ctx, "decode_window", t0, t1 - t0,
+                                           tid=self.trace_tid,
+                                           attrs={"emitted": j})
 
         if self.telemetry.enabled:
             self.telemetry.set_gauge("serving/queue_depth", len(self.queue))
@@ -995,6 +1117,10 @@ class ServingEngine:
                 "evictions": self.allocator.evictions}
         if self.telemetry.enabled:
             out["latency"] = self.latency_snapshot()
+            # compile watchdog: ONE warmup compile per program is the
+            # contract; any recompile after that is named here (and in the
+            # flight recorder, with the triggering shapes)
+            out["watchdog"] = self.telemetry.watchdog.summary()
         return out
 
     def latency_snapshot(self) -> Dict[str, Dict[str, float]]:

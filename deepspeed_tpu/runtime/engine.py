@@ -296,7 +296,12 @@ class Engine:
         # ---- fault tolerance: bad-state sentinel + rollback bookkeeping
         # (docs/fault_tolerance.md; opt-in via the fault_tolerance block —
         # observing the loss costs a host sync per step)
-        self._sentinel = BadStateSentinel(config.fault_tolerance)
+        self._sentinel = BadStateSentinel(
+            config.fault_tolerance,
+            # every sentinel trip lands in the training black box (no-op
+            # unless telemetry.flight_recorder is on)
+            recorder=self.telemetry.flightrec
+            if self.telemetry.flightrec.enabled else None)
         self._last_ckpt_dir = None     # newest save/load root = rollback target
         self._ckpt_pending = None      # async-save finalizer (checkpoint/saver.py)
         self._ckpt_pending_error = None
@@ -1342,6 +1347,14 @@ class Engine:
         ft = self.config.fault_tolerance
         detail = self._sentinel.describe(cause)
         target = self._last_ckpt_dir
+        # black box FIRST, while the bad state is still in place: the ring
+        # (sentinel trips, recent recompiles) + a training-state snapshot
+        self.telemetry.flightrec.dump(
+            f"bad-state sentinel: {cause}",
+            state={"step": self.global_steps, "cause": cause,
+                   "detail": detail, "rollbacks": self.rollbacks,
+                   "rollback_target": str(target),
+                   "watchdog": self.telemetry.watchdog.summary()})
         if ft.auto_rollback and target is not None \
                 and self.rollbacks < ft.max_rollbacks:
             logger.warning(f"bad state at step {self.global_steps} ({detail}); "

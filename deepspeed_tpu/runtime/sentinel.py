@@ -45,11 +45,15 @@ class BadStateSentinel:
       consecutive steps trips the spike cause. window=0 disables.
     """
 
-    def __init__(self, config=None, *, enabled=None):
+    def __init__(self, config=None, *, enabled=None, recorder=None):
         cfg = config
         g = (lambda name, d: getattr(cfg, name, d)) if cfg is not None \
             else (lambda name, d: d)
         self.enabled = bool(g("enabled", False) if enabled is None else enabled)
+        # optional telemetry FlightRecorder: every trip becomes a black-box
+        # event (duck-typed `.record(kind, **fields)`; None = no recording,
+        # keeping this module stdlib-only and telemetry-agnostic)
+        self.recorder = recorder
         self.nonfinite_budget = int(g("nonfinite_budget", 3))
         self.overflow_budget = int(g("overflow_budget", 50))
         self.loss_spike_window = int(g("loss_spike_window", 0))
@@ -75,13 +79,13 @@ class BadStateSentinel:
             # *streak* is pathological
             self._overflows += 1
             if self.overflow_budget > 0 and self._overflows >= self.overflow_budget:
-                return CAUSE_OVERFLOW
+                return self._trip(CAUSE_OVERFLOW, loss)
             return None
         self._overflows = 0
         if loss is None or not math.isfinite(loss):
             self._nonfinite += 1
             if self.nonfinite_budget > 0 and self._nonfinite >= self.nonfinite_budget:
-                return CAUSE_NONFINITE
+                return self._trip(CAUSE_NONFINITE, loss)
             return None
         self._nonfinite = 0
         if self.loss_spike_window > 0:
@@ -90,11 +94,24 @@ class BadStateSentinel:
                 if med > 0 and loss > self.loss_spike_factor * med:
                     self._spikes += 1
                     if self._spikes >= self.loss_spike_patience:
-                        return CAUSE_LOSS_SPIKE
+                        return self._trip(CAUSE_LOSS_SPIKE, loss)
                     return None  # spike suspects stay out of the baseline
                 self._spikes = 0
             self._history.append(loss)
         return None
+
+    def _trip(self, cause, loss):
+        """A budget just exhausted: file the black-box event (best-effort —
+        a broken recorder must never mask the cause) and hand the cause up
+        for the engine's rollback/restart decision."""
+        if self.recorder is not None:
+            try:
+                self.recorder.record("sentinel_trip", cause=cause,
+                                     loss=None if loss is None else float(loss),
+                                     detail=self.describe(cause))
+            except Exception:
+                pass
+        return cause
 
     def describe(self, cause):
         return {

@@ -27,11 +27,18 @@ class ReplicaHandle:
 
     # -- request lifecycle ------------------------------------------------
     def submit(self, request: Request, prefill_only: bool = False,
-               hashes=None):
+               hashes=None, trace=None):
         raise NotImplementedError
 
     def step(self) -> List[CompletedRequest]:
         raise NotImplementedError
+
+    # -- observability ----------------------------------------------------
+    def attach_observability(self, tracer=None, flightrec=None, tid=None):
+        """Share the router's request tracer / flight recorder with this
+        replica (and hand it its Perfetto track id), so a pool's spans land
+        in ONE trace file and one black box. Default no-op: a remote
+        backend records on its own side and ships spans home out of band."""
 
     def cancel(self, uid, queued_only: bool = False) -> Optional[CompletedRequest]:
         raise NotImplementedError
@@ -130,11 +137,17 @@ class InProcessReplica(ReplicaHandle):
         self.role = role
 
     # -- request lifecycle ------------------------------------------------
-    def submit(self, request, prefill_only=False, hashes=None):
-        self.engine.submit(request, prefill_only=prefill_only, hashes=hashes)
+    def submit(self, request, prefill_only=False, hashes=None, trace=None):
+        self.engine.submit(request, prefill_only=prefill_only, hashes=hashes,
+                           trace=trace)
 
     def step(self):
         return self.engine.step()
+
+    # -- observability ----------------------------------------------------
+    def attach_observability(self, tracer=None, flightrec=None, tid=None):
+        self.engine.attach_observability(tracer=tracer, flightrec=flightrec,
+                                         tid=tid)
 
     def cancel(self, uid, queued_only=False):
         return self.engine.cancel(uid, queued_only=queued_only)

@@ -96,6 +96,8 @@ class _Pending:
     t_submit: float
     deadline: Optional[float]
     replica: Optional[str] = None   # None while queued at the router
+    trace: Any = None               # TraceContext; the router owns the root
+                                    # span and closes it at completion
 
 
 class ServingRouter:
@@ -165,6 +167,18 @@ class ServingRouter:
         self._ttft_window = 2048
 
         self.telemetry = Telemetry(telemetry_config, subsystem="router")
+        # POOL-shared request tracing + flight recorder (telemetry.tracing /
+        # telemetry.flight_recorder flags): the router owns both and injects
+        # them into every replica, so a request that crosses replicas —
+        # dispatch, failover re-route, KV handoff — still lands every span
+        # in ONE file under ONE trace id, with one Perfetto track per
+        # replica (tid 0 = the router itself).
+        self.tracer = self.telemetry.tracer
+        self.flightrec = self.telemetry.flightrec
+        self._tids: Dict[str, int] = {}
+        if self.tracer.enabled:
+            self.tracer.name_process("dstpu serving pool")
+            self.tracer.name_track(0, "router")
 
         for r in replicas:
             self.add_replica(r)
@@ -208,9 +222,23 @@ class ServingRouter:
         self._budgets[rid] = RestartBudget(self._restart_policy)
         self._ttft[rid] = collections.deque(maxlen=self._ttft_window)
         self._anticipated[rid] = collections.OrderedDict()
+        self._tids[rid] = len(self.replicas)       # tid 0 is the router's
+        self._attach_observability(rid)
         log_dist(f"serving router: +replica {rid} role={handle.role} "
                  f"(pool: {len(self.replicas)})", ranks=[0])
         return handle
+
+    def _attach_observability(self, rid):
+        """Inject the pool's tracer/flight recorder into one replica (also
+        re-run after a restart — the rebuilt engine starts detached)."""
+        if not (self.tracer.enabled or self.flightrec.enabled):
+            return
+        self.replicas[rid].attach_observability(
+            tracer=self.tracer if self.tracer.enabled else None,
+            flightrec=self.flightrec if self.flightrec.enabled else None,
+            tid=self._tids[rid])
+        if self.tracer.enabled:
+            self.tracer.name_track(self._tids[rid], f"replica {rid}")
 
     def _check_pool_compat(self, handle):
         """Same model (cache fingerprint) across the pool, same block size
@@ -280,6 +308,9 @@ class ServingRouter:
         if len(self.queue) >= self.config.max_pending:
             if self.config.admission_policy == "shed":
                 self._count("shed")
+                if self.flightrec.enabled:
+                    self.flightrec.record("shed", uid=request.uid,
+                                          queued=len(self.queue))
                 done = CompletedRequest(uid=request.uid,
                                         prompt_len=prompt_len,
                                         tokens=np.zeros((0,), np.int32),
@@ -302,9 +333,15 @@ class ServingRouter:
         for rep in self._healthy(self._entry_roles()):
             hashes = rep.hash_chain(request.tokens)
             break
+        trace = None
+        if self.tracer.enabled:
+            # the router owns the trace: root span = submit -> completion,
+            # closed in _complete (a failover in between stays inside it)
+            trace = self.tracer.start(request.uid, t0=now, owner="router")
         self._pending[request.uid] = _Pending(
             request=request, prompt_len=prompt_len, hashes=hashes,
-            t_submit=now, deadline=(now + ttl) if ttl is not None else None)
+            t_submit=now, deadline=(now + ttl) if ttl is not None else None,
+            trace=trace)
         self.queue.append(request.uid)
         self._count("submitted")
         return None
@@ -376,11 +413,11 @@ class ServingRouter:
     def _choose(self, rec: _Pending):
         """Pick a dispatch target for a queued request, or None when every
         eligible replica is saturated (the request waits at the router).
-        Returns (handle, affinity_blocks, spilled)."""
+        Returns (handle, affinity_blocks, score, spilled)."""
         cfg = self.config
         eligible = self._healthy(self._entry_roles())
         if not eligible:
-            return None, 0, False
+            return None, 0, 0.0, False
         max_q = max(1, cfg.max_replica_queue)
         scored = []       # (handle, affinity, score, pending, saturated)
         for rep in eligible:
@@ -397,10 +434,10 @@ class ServingRouter:
             scored.append((rep, aff, score, pending,
                            rep.queue_depth >= max_q))
         if not scored:
-            return None, 0, False
+            return None, 0, 0.0, False
         open_ = [s for s in scored if not s[4]]
         if not open_:
-            return None, 0, False
+            return None, 0, 0.0, False
         if cfg.routing_policy == "round_robin":
             chosen = open_[self._rr % len(open_)]
             self._rr += 1
@@ -411,7 +448,7 @@ class ServingRouter:
                                                % len(open_)))
             self._rr += 1
         best_aff = max(s[1] for s in scored)
-        return chosen[0], chosen[1], chosen[1] < best_aff
+        return chosen[0], chosen[1], chosen[2], chosen[1] < best_aff
 
     def _dispatch(self):
         """Drain the router queue head-first into replicas. Strict FIFO:
@@ -420,12 +457,31 @@ class ServingRouter:
         while self.queue:
             uid = self.queue[0]
             rec = self._pending[uid]
-            rep, aff, spilled = self._choose(rec)
+            rep, aff, score, spilled = self._choose(rec)
             if rep is None:
                 break
             self.queue.popleft()
+            if self.tracer.enabled and rec.trace is not None:
+                # dispatch is a zero-duration span (not an instant event)
+                # so replica-side spans can NEST under it — a failover's
+                # second dispatch then reads as a sibling subtree, not
+                # interleaved with the first attempt. flow_begin opens the
+                # Perfetto arrow the replica's admit closes on ITS track.
+                t = self._clock()
+                sid = self.tracer.record(
+                    rec.trace, "dispatch", t, 0.0, tid=0,
+                    parent=rec.trace.root_id,
+                    attrs={"replica": rep.replica_id, "affinity": int(aff),
+                           "score": round(float(score), 3)})
+                rec.trace.parent_id = sid
+                self.tracer.flow_begin(rec.trace, t, tid=0)
+            if self.flightrec.enabled:
+                self.flightrec.record(
+                    "dispatch", uid=uid, replica=rep.replica_id,
+                    affinity=int(aff), score=round(float(score), 3),
+                    spilled=bool(spilled))
             rep.submit(rec.request, prefill_only=self.disaggregated,
-                       hashes=rec.hashes)
+                       hashes=rec.hashes, trace=rec.trace)
             rec.replica = rep.replica_id
             self._note_dispatch(rep.replica_id, rec.hashes)
             if self.config.routing_policy == "affinity":
@@ -454,6 +510,9 @@ class ServingRouter:
                 if done is None:
                     continue
             self._count("ttl_cancelled")
+            if self.flightrec.enabled:
+                self.flightrec.record("ttl_cancel", uid=uid,
+                                      replica=rec.replica or "")
             self._complete(done, finished)
 
     def _complete(self, done: CompletedRequest, finished):
@@ -464,6 +523,11 @@ class ServingRouter:
         rec = self._pending.pop(done.uid, None)
         self._done.add(done.uid)
         self._count("completed")
+        if rec is not None and rec.trace is not None:
+            # close the root (whole-request e2e, router queue included)
+            self.tracer.finish(rec.trace, self._clock(), tid=0,
+                               attrs={"reason": done.finish_reason,
+                                      "replica": rec.replica or ""})
         if rec is not None and rec.replica is not None:
             if done.timing and done.timing.get("first_token"):
                 # ROUTER-level TTFT: first token relative to router arrival
@@ -489,8 +553,24 @@ class ServingRouter:
             pass                        # a truly dead backend may not answer
         requeue = [uid for uid, rec in self._pending.items()
                    if rec.replica == rid]
+        t = self._clock()
         for uid in requeue:
-            self._pending[uid].replica = None
+            rec = self._pending[uid]
+            rec.replica = None
+            if self.tracer.enabled and rec.trace is not None:
+                # a dispatch arrow the dead replica never admitted would
+                # dangle as an orphan "s" event — terminate it at the
+                # reroute mark on the router track instead (no-op when
+                # admission already consumed it)
+                self.tracer.flow_end(rec.trace, t, tid=0)
+                # re-parent future spans back under the root: the NEXT
+                # dispatch opens a fresh subtree, and this mark is the
+                # visible seam between the two attempts — ONE trace id
+                # throughout, which is the failover-continuity contract
+                rec.trace.parent_id = rec.trace.root_id
+                self.tracer.event(rec.trace, "reroute", t, tid=0,
+                                  attrs={"from": rid,
+                                         "reason": str(reason)[:120]})
         self.queue.extendleft(reversed(requeue))
         self._count("reroutes", len(requeue))
         self._anticipated[rid].clear()   # its pool (and cache) is gone
@@ -501,6 +581,24 @@ class ServingRouter:
             self._dead.add(rid)
             logger.error(f"router: replica {rid} is out of restart budget; "
                          f"pool shrinks to {len(self._healthy())}")
+        if self.flightrec.enabled:
+            # the black-box moment this whole subsystem exists for: the
+            # quarantine event joins the ring, then the ring + a full
+            # router/replica state snapshot hit disk
+            self.flightrec.record("quarantine", replica=rid,
+                                  reason=str(reason)[:200],
+                                  requeued=len(requeue),
+                                  dead=rid in self._dead)
+            self.flightrec.dump(f"replica {rid} failed: {reason}",
+                                state=self._failure_snapshot())
+
+    def _failure_snapshot(self):
+        """stats() guarded for the dump path — a half-dead pool must still
+        produce a black box, even if some replica's stats() throws."""
+        try:
+            return self.stats()
+        except Exception as e:
+            return {"error": f"stats() failed during dump: {e}"}
 
     def _maybe_restart(self, now):
         for rid, t in list(self._quarantined.items()):
@@ -510,6 +608,13 @@ class ServingRouter:
             try:
                 self.replicas[rid].restart()
                 self._count("replica_restarts")
+                # a rebuilt engine starts detached from the pool's
+                # tracer/recorder (and from its Perfetto track) — re-inject
+                self._attach_observability(rid)
+                if self.flightrec.enabled:
+                    self.flightrec.record(
+                        "restart", replica=rid,
+                        nth=self._budgets[rid].restarts)
                 log_dist(f"router: replica {rid} restarted "
                          f"(#{self._budgets[rid].restarts})", ranks=[0])
             except Exception as e:
@@ -555,6 +660,24 @@ class ServingRouter:
                         prep.release_handoff(uid)
                         rec.replica = drep.replica_id
                         self._count("handoffs")
+                        if self.tracer.enabled and rec.trace is not None:
+                            # one flow arrow prefill-track -> decode-track:
+                            # the transplant renders as a connected hop
+                            t = self._clock()
+                            src = self._tids.get(prep.replica_id, 0)
+                            dst = self._tids.get(drep.replica_id, 0)
+                            self.tracer.flow_begin(rec.trace, t, tid=src)
+                            sid = self.tracer.record(
+                                rec.trace, "kv_handoff", t, 0.0, tid=0,
+                                parent=rec.trace.root_id,
+                                attrs={"from": prep.replica_id,
+                                       "to": drep.replica_id})
+                            rec.trace.parent_id = sid
+                            self.tracer.flow_end(rec.trace, t, tid=dst)
+                        if self.flightrec.enabled:
+                            self.flightrec.record(
+                                "handoff", uid=uid, src=prep.replica_id,
+                                dst=drep.replica_id)
                         break
 
     # ------------------------------------------------------------------
@@ -690,6 +813,12 @@ class ServingRouter:
                 "counters": dict(self.counters),
                 "disaggregated": self.disaggregated,
                 "replicas": reps}
+
+    def dump_flight_recorder(self, reason="operator dump"):
+        """Write the black box NOW (operator/test hook). For out-of-band
+        dumps wire `router.flightrec.install_signal_handler(
+        state_fn=router.stats)` and send SIGUSR2."""
+        return self.flightrec.dump(reason, state=self._failure_snapshot())
 
     def total_prefill_chunks(self) -> int:
         """Prefill chunks executed across live replicas — the quantity

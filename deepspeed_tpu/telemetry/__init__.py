@@ -19,7 +19,18 @@ Wiring (all opt-in via the `telemetry` config block):
   * checkpoint saver / recovery paths: their `(tag, value, step)` events
     route through `record_events`, turning save latency into a histogram.
 
-`bin/dstpu_metrics` renders the JSONL log (`telemetry/cli.py`).
+Three per-request diagnostics ride on the same config block and the same
+disabled-by-default contract:
+
+  * `tracer` (`tracing.py`, `telemetry.tracing` flag) — request-scoped
+    span trees (`<subsystem>.trace.jsonl` + a flow-linked chrome trace);
+  * `flightrec` (`flight_recorder.py`, `telemetry.flight_recorder` flag)
+    — bounded ring of scheduling events, dumped on failure;
+  * `watchdog` (always armed while telemetry is enabled) — recompile
+    detection over the persistent jitted serving programs.
+
+`bin/dstpu_metrics` renders the JSONL log (`telemetry/cli.py`);
+`bin/dstpu_trace` reconstructs request timelines (`telemetry/tracing.py`).
 """
 
 import contextlib
@@ -32,10 +43,16 @@ from deepspeed_tpu.telemetry.exporters import (JsonlExporter, MonitorBridge,
                                                prometheus_text)
 from deepspeed_tpu.telemetry import spans
 from deepspeed_tpu.telemetry.spans import ChromeTraceSink, Span
+from deepspeed_tpu.telemetry.tracing import (NULL_TRACER, TraceContext,
+                                             Tracer)
+from deepspeed_tpu.telemetry.flight_recorder import (NULL_RECORDER,
+                                                     CompileWatchdog,
+                                                     FlightRecorder)
 
 __all__ = ["Telemetry", "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "PrometheusFileExporter", "JsonlExporter", "MonitorBridge",
-           "prometheus_text", "ChromeTraceSink", "Span"]
+           "prometheus_text", "ChromeTraceSink", "Span", "Tracer",
+           "TraceContext", "FlightRecorder", "CompileWatchdog"]
 
 _NULL_SPAN = contextlib.nullcontext()
 
@@ -53,10 +70,16 @@ class Telemetry:
         self._exporters = []
         self._trace = None
         self._closed = False
+        self.tracer = NULL_TRACER
+        self.flightrec = NULL_RECORDER
+        self.watchdog = CompileWatchdog(self if self.enabled else None)
         if not self.enabled:
             return
         out = pathlib.Path(config.output_path or "telemetry")
-        if config.prometheus or config.jsonl or config.chrome_trace:
+        tracing = bool(getattr(config, "tracing", False))
+        flight = bool(getattr(config, "flight_recorder", False))
+        if config.prometheus or config.jsonl or config.chrome_trace \
+                or tracing or flight:
             # registry-only configurations (all file sinks off — the bench
             # lanes) must not litter an empty directory
             out.mkdir(parents=True, exist_ok=True)
@@ -68,8 +91,18 @@ class Telemetry:
         if config.monitor_bridge and monitor is not None and \
                 getattr(monitor, "enabled", False):
             self._exporters.append(MonitorBridge(monitor))
-        if config.chrome_trace:
+        if config.chrome_trace or tracing:
+            # one shared chrome sink: phase spans (span()) and request
+            # traces (tracer) land on one Perfetto timeline
             self._trace = ChromeTraceSink(out / f"{subsystem}.trace.json")
+        if tracing:
+            self.tracer = Tracer(out / f"{subsystem}.trace.jsonl",
+                                 chrome=self._trace)
+        if flight:
+            self.flightrec = FlightRecorder(
+                out, subsystem=subsystem,
+                capacity=int(getattr(config, "flight_recorder_events", 256)))
+        self.watchdog.recorder = self.flightrec
 
     # ---- recording ---------------------------------------------------
 
@@ -97,11 +130,13 @@ class Telemetry:
             else:
                 self.registry.gauge(tag).set(value)
 
-    def span(self, name):
-        """Timed/annotated region; a shared null context when disabled."""
+    def span(self, name, tid=0):
+        """Timed/annotated region; a shared null context when disabled.
+        `tid` selects the chrome-trace track (per-replica tids keep a
+        serving pool's phase timelines separated in Perfetto)."""
         if not self.enabled:
             return _NULL_SPAN
-        return spans.span(name, sink=self._trace)
+        return spans.span(name, sink=self._trace, tid=tid)
 
     # ---- export ------------------------------------------------------
 
@@ -151,6 +186,10 @@ class Telemetry:
                 self._trace.close()
             except Exception:
                 pass
+        try:
+            self.tracer.close()
+        except Exception:
+            pass
 
     def __del__(self):
         try:

@@ -47,21 +47,47 @@ def load_latest(path):
     return record
 
 
-def render(record):
-    """Human table for one snapshot record."""
+def counter_rate(name, cur, prev):
+    """Per-second rate of a counter between two snapshot records, or None
+    when it cannot be computed (no previous record, metric absent/not a
+    counter there, no wall-time delta, or a reset — the counter going
+    BACKWARD between snapshots, e.g. a restarted process)."""
+    if prev is None:
+        return None
+    pm = prev.get("metrics", {}).get(name)
+    if pm is None or pm.get("type") != "counter":
+        return None
+    dt = cur.get("time", 0) - prev.get("time", 0)
+    if dt <= 0:
+        return None
+    delta = cur["metrics"][name]["value"] - pm["value"]
+    if delta < 0:
+        return None
+    return delta / dt
+
+
+def render(record, prev=None):
+    """Human table for one snapshot record. With `prev` (the previously
+    rendered record — `--watch` threads it through), counters grow a
+    per-interval rate column: the thing you actually watch is tokens/s or
+    requests/s, not a raw monotonic total."""
     metrics = record.get("metrics", {})
     when = time.strftime("%Y-%m-%d %H:%M:%S",
                          time.localtime(record.get("time", 0)))
     lines = [f"step {record.get('step')} @ {when}", ""]
-    rows = [("metric", "type", "value / count", "mean", "p50", "p90", "p99")]
+    rows = [("metric", "type", "value / count", "rate/s", "mean", "p50",
+             "p90", "p99")]
     for name in sorted(metrics):
         m = metrics[name]
         if m.get("type") == "histogram":
-            rows.append((name, "hist", str(m["count"]),
+            rows.append((name, "hist", str(m["count"]), "",
                          f"{m['mean']:.3f}", f"{m['p50']:.3f}",
                          f"{m['p90']:.3f}", f"{m['p99']:.3f}"))
         else:
+            rate = counter_rate(name, record, prev) \
+                if m.get("type") == "counter" else None
             rows.append((name, m.get("type", "?"), f"{m.get('value', 0):g}",
+                         "" if rate is None else f"{rate:.3g}/s",
                          "", "", "", ""))
     widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
     for r in rows:
@@ -83,21 +109,25 @@ def main(argv=None):
     ap.add_argument("--interval", type=float, default=2.0)
     args = ap.parse_args(argv)
 
-    def emit():
+    def emit(prev=None):
         record = load_latest(args.path)
         if record is None:
             print(f"dstpu_metrics: no metrics log at {args.path!r}",
                   file=sys.stderr)
-            return 1
-        print(json.dumps(record) if args.json else render(record))
-        return 0
+            return 1, prev
+        print(json.dumps(record) if args.json
+              else render(record, prev=prev))
+        return 0, record
 
     if not args.watch:
-        return emit()
+        return emit()[0]
+    prev = None
     try:
         while True:
             sys.stdout.write("\x1b[2J\x1b[H")
-            emit()
+            # thread the previous snapshot through so counters render
+            # per-interval rates, not just monotonic totals
+            _, prev = emit(prev)
             time.sleep(max(args.interval, 0.2))
     except KeyboardInterrupt:
         return 0

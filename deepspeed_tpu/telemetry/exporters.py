@@ -25,8 +25,23 @@ __all__ = ["prometheus_text", "PrometheusFileExporter", "JsonlExporter",
 
 
 def _prom_name(name):
-    """Sanitize a metric name for Prometheus ([a-zA-Z0-9_:] only)."""
-    return "".join(c if c.isalnum() or c in "_:" else "_" for c in name)
+    """Sanitize a metric name for Prometheus ([a-zA-Z0-9_:] only, and a
+    leading digit gets an underscore prefix — the name grammar is
+    `[a-zA-Z_:][a-zA-Z0-9_:]*`)."""
+    pn = "".join(c if c.isalnum() or c in "_:" else "_" for c in name)
+    return "_" + pn if pn[:1].isdigit() else pn
+
+
+def _escape_help(text):
+    """HELP-line escaping per the text format: backslash and newline."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(value):
+    """Label-value escaping per the text format: backslash, double quote,
+    newline."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
 
 
 def _fmt(v):
@@ -37,23 +52,38 @@ def _fmt(v):
     return f"{v:.10g}"
 
 
-def prometheus_text(registry):
-    """Render a registry in the Prometheus text exposition format."""
+def prometheus_text(registry, help_map=None):
+    """Render a registry in the Prometheus text exposition format.
+
+    Conformance points external scrapers check (pinned by the exporter
+    conformance test): every metric family carries `# HELP` then `# TYPE`
+    exactly once, HELP text and label values are escaped, counters end in
+    `_total`, and every histogram exposes the mandatory `+Inf` bucket whose
+    cumulative count equals `_count` (with `_sum` alongside). `help_map`
+    overrides the per-metric HELP text (original metric name -> text);
+    the default text is the registry name itself, which carries the unit
+    suffix convention (`*_ms`) the catalog documents."""
+    help_map = help_map or {}
     lines = []
     for name, m in registry.metrics():
         pn = _prom_name(name)
+        help_text = _escape_help(help_map.get(name, f"deepspeed-tpu {name}"))
         if isinstance(m, Counter):
             if not pn.endswith("_total"):
                 pn += "_total"
+            lines.append(f"# HELP {pn} {help_text}")
             lines.append(f"# TYPE {pn} counter")
             lines.append(f"{pn} {_fmt(m.value)}")
         elif isinstance(m, Gauge):
+            lines.append(f"# HELP {pn} {help_text}")
             lines.append(f"# TYPE {pn} gauge")
             lines.append(f"{pn} {_fmt(m.value)}")
         elif isinstance(m, Histogram):
+            lines.append(f"# HELP {pn} {help_text}")
             lines.append(f"# TYPE {pn} histogram")
             for edge, cum in m.cumulative_buckets():
-                lines.append(f'{pn}_bucket{{le="{_fmt(edge)}"}} {cum}')
+                lines.append(
+                    f'{pn}_bucket{{le="{_escape_label(_fmt(edge))}"}} {cum}')
             lines.append(f"{pn}_sum {_fmt(m.sum)}")
             lines.append(f"{pn}_count {m.count}")
     return "\n".join(lines) + "\n"

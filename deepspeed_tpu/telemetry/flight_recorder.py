@@ -1,0 +1,213 @@
+"""Failure flight recorder + compile watchdog: the serving black box.
+
+When a replica dies in production the aggregate histograms say *that* it
+died, not *what the scheduler was doing* in the seconds before. The
+`FlightRecorder` is the aircraft-style answer: a bounded ring buffer of
+recent structured scheduling events (admission decisions with their
+scores, evictions, re-routes, rollbacks, quarantines, recompiles) that
+costs one deque append per event while everything is healthy, and dumps to
+disk — together with whatever state snapshot the caller hands it
+(`engine.stats()`, `router.stats()`) — the moment something goes wrong: a
+replica throws, the bad-state sentinel fires, or an operator sends the
+dump signal.
+
+The `CompileWatchdog` covers the silent killer of TPU serving latency:
+unexpected recompiles. The serving engine's promise is ONE compile per
+persistent program for its lifetime; a shape regression anywhere upstream
+turns that into a multi-second stall per novel shape, invisible in mean
+throughput until the p99 explodes. The watchdog wraps each jitted program,
+watches its jit cache size across calls, and on any compile AFTER the
+warmup compile records which program recompiled (and the observed wall
+time of the compiling call) into the telemetry registry
+(`telemetry/recompiles`, `telemetry/compile_ms`) and the flight recorder.
+
+Both are disabled by default and free when disabled: the recorder's
+`record` is one flag check, and `CompileWatchdog.wrap` returns the jitted
+function UNWRAPPED, so the hot path is byte-identical to a build without
+the watchdog.
+"""
+
+import collections
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+__all__ = ["FlightRecorder", "CompileWatchdog", "NULL_RECORDER"]
+
+
+class FlightRecorder:
+    """Bounded ring of structured events + dump-on-failure.
+
+    `record(kind, **fields)` appends `{"seq", "t", "kind", **fields}`;
+    `dump(reason, state=...)` writes the ring plus the state snapshot to
+    `<out_dir>/<subsystem>.flightrec.<n>.json` and returns the path. The
+    ring keeps only the last `capacity` events — post-mortems need the
+    recent past, not the whole run — and survives any number of dumps
+    (each dump gets a fresh numbered file; the ring keeps rolling)."""
+
+    def __init__(self, out_dir=None, subsystem="serving", capacity=256,
+                 enabled=True, clock=None):
+        self.enabled = bool(enabled) and out_dir is not None
+        self.out_dir = str(out_dir) if out_dir is not None else None
+        self.subsystem = subsystem
+        self._clock = clock if clock is not None else time.monotonic
+        self._ring = collections.deque(maxlen=max(1, int(capacity)))
+        self._seq = 0
+        self._dumps = 0
+
+    def record(self, kind, **fields):
+        if not self.enabled:
+            return
+        self._seq += 1
+        self._ring.append({"seq": self._seq, "t": self._clock(),
+                           "kind": kind, **fields})
+
+    def events(self):
+        return list(self._ring)
+
+    def dump(self, reason, state=None) -> Optional[str]:
+        """Write the black box: last-N events + a state snapshot. Returns
+        the dump path (None when disabled). Never raises — the dump runs
+        inside failure paths that must keep failing over."""
+        if not self.enabled:
+            return None
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            if self._dumps == 0:
+                # resume numbering past dumps left by a previous process in
+                # the same output dir — a restart after a crash is exactly
+                # when the PREVIOUS black box must survive, not be clobbered
+                prefix = f"{self.subsystem}.flightrec."
+                for name in os.listdir(self.out_dir):
+                    if name.startswith(prefix) and name.endswith(".json"):
+                        try:
+                            n = int(name[len(prefix):-len(".json")])
+                        except ValueError:
+                            continue
+                        self._dumps = max(self._dumps, n + 1)
+            path = os.path.join(
+                self.out_dir,
+                f"{self.subsystem}.flightrec.{self._dumps:03d}.json")
+            self._dumps += 1
+            with open(path, "w") as f:
+                json.dump({"reason": str(reason), "time": time.time(),
+                           "clock": self._clock(),
+                           "events": self.events(),
+                           "state": _jsonable(state)}, f, indent=1,
+                          default=str)
+            return path
+        except Exception:
+            return None
+
+    def install_signal_handler(self, state_fn=None, signum=None):
+        """Operator dump signal: SIGUSR2 (default) writes a dump with the
+        current state snapshot without disturbing the process. Opt-in —
+        never installed implicitly (libraries must not steal signals)."""
+        if not self.enabled:
+            return
+        import signal
+
+        signum = signal.SIGUSR2 if signum is None else signum
+
+        def _handler(_sig, _frame):
+            self.dump("dump signal",
+                      state=state_fn() if state_fn is not None else None)
+
+        signal.signal(signum, _handler)
+
+
+def _jsonable(obj):
+    """Best-effort JSON coercion for state snapshots (stats() dicts carry
+    numpy scalars); anything stubborn stringifies via `default=str`."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if hasattr(obj, "item") and not isinstance(obj, (str, bytes)):
+        try:
+            return obj.item()
+        except Exception:
+            return str(obj)
+    return obj
+
+
+NULL_RECORDER = FlightRecorder(out_dir=None, enabled=False)
+
+
+class _WatchedProgram:
+    """A jitted program under watch. Transparent to callers: `__call__`
+    forwards, `_cache_size` delegates (so `compile_stats()` keeps
+    working). The jit cache size is read before and after each call — a
+    growth is a compile; any growth past the first is a RECOMPILE."""
+
+    __slots__ = ("watchdog", "name", "fn")
+
+    def __init__(self, watchdog, name, fn):
+        self.watchdog = watchdog
+        self.name = name
+        self.fn = fn
+
+    def _cache_size(self):
+        return self.fn._cache_size()
+
+    def __call__(self, *args, **kwargs):
+        try:
+            before = self.fn._cache_size()
+        except Exception:
+            return self.fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = self.fn(*args, **kwargs)
+        if self.fn._cache_size() > before:
+            self.watchdog._on_compile(
+                self.name, (time.perf_counter() - t0) * 1e3,
+                [tuple(a.shape) for a in args if hasattr(a, "shape")])
+        return out
+
+
+class CompileWatchdog:
+    """Per-engine recompile detector over the persistent jitted programs.
+
+    `wrap(name, fn)` returns `fn` untouched when disabled; when enabled it
+    returns a `_WatchedProgram` that reports every cache miss. The first
+    compile of each program is the expected warmup; every later one
+    increments `telemetry/recompiles`, lands a `compile_ms` observation
+    (wall time of the compiling call — compile + one step, the latency the
+    caller actually felt), and files a flight-recorder event naming the
+    program and the argument shapes that triggered it."""
+
+    def __init__(self, telemetry=None, recorder=None):
+        self.telemetry = telemetry
+        self.recorder = recorder if recorder is not None else NULL_RECORDER
+        self.enabled = bool(telemetry is not None and
+                            getattr(telemetry, "enabled", False))
+        self.recompiles = 0
+        self.programs: Dict[str, Dict[str, Any]] = {}
+
+    def wrap(self, name, fn):
+        if not self.enabled:
+            return fn
+        self.programs[name] = {"compiles": 0, "recompiles": 0,
+                               "last_shapes": None}
+        return _WatchedProgram(self, name, fn)
+
+    def _on_compile(self, name, elapsed_ms, shapes):
+        entry = self.programs[name]
+        entry["compiles"] += 1
+        entry["last_shapes"] = shapes
+        if self.telemetry is not None:
+            self.telemetry.observe("telemetry/compile_ms", elapsed_ms)
+        if entry["compiles"] <= 1:
+            return                     # warmup: the one expected compile
+        entry["recompiles"] += 1
+        self.recompiles += 1
+        if self.telemetry is not None:
+            self.telemetry.inc("telemetry/recompiles")
+        self.recorder.record("recompile", program=name,
+                             shapes=[list(s) for s in shapes],
+                             compile_ms=round(elapsed_ms, 3),
+                             nth_compile=entry["compiles"])
+
+    def summary(self) -> Dict[str, Any]:
+        return {"recompiles": self.recompiles,
+                "programs": {n: dict(e) for n, e in self.programs.items()}}

@@ -11,9 +11,14 @@ A span does two things at once:
     phases are exactly the ones a device trace cannot see.
 
 The sink writes the Chrome trace event format as streamed JSON: an opening
-`[` then one complete ("ph": "X") event object per line, comma-terminated.
-Perfetto and chrome://tracing both accept the unterminated-array form, which
-is what makes the sink append-only and crash-safe.
+`[` then one complete event object per line, comma-terminated. Perfetto and
+chrome://tracing both accept the unterminated-array form, which is what
+makes the sink append-only and crash-safe. Beyond the duration ("X") events
+the sink also speaks the metadata ("M": `process_name`/`thread_name`, so
+every replica of a serving pool gets its own NAMED Perfetto track) and flow
+("s"/"f": the arrows that connect a request's spans across tracks when the
+router re-routes or hands a slot off) subsets of the format — the request
+tracer (`telemetry/tracing.py`) drives those.
 """
 
 import json
@@ -40,18 +45,32 @@ class ChromeTraceSink:
         self._t0 = time.perf_counter()
         self._lock = threading.Lock()
 
-    def add(self, name, start_s, dur_s, tid=0):
-        """Record one complete event; timestamps are seconds on the
-        `time.perf_counter` clock (converted to trace microseconds)."""
-        ev = {"name": name, "ph": "X", "pid": os.getpid(), "tid": tid,
-              "ts": round((start_s - self._t0) * 1e6, 3),
-              "dur": round(dur_s * 1e6, 3)}
+    def write(self, ev):
+        """Append one raw chrome-trace event dict (already carrying its own
+        `ts`/`dur` in trace microseconds). The structured-span tracer uses
+        this directly so its events stay on ONE caller-owned clock domain;
+        `add` below converts from this sink's perf_counter baseline."""
         with self._lock:
             if self._f is None:
                 self._f = open(self.path, "w")
                 self._f.write("[\n")
             self._f.write(json.dumps(ev) + ",\n")
             self._f.flush()     # crash-safe: the timeline is readable mid-run
+
+    def add(self, name, start_s, dur_s, tid=0):
+        """Record one complete event; timestamps are seconds on the
+        `time.perf_counter` clock (converted to trace microseconds).
+        `tid` picks the Perfetto track — per-replica tids keep a serving
+        pool's timelines from collapsing onto one row."""
+        self.write({"name": name, "ph": "X", "pid": os.getpid(), "tid": tid,
+                    "ts": round((start_s - self._t0) * 1e6, 3),
+                    "dur": round(dur_s * 1e6, 3)})
+
+    def add_meta(self, kind, value, tid=0):
+        """Metadata event: kind is "process_name" or "thread_name"; value
+        labels this pid (or `tid`'s track) in the Perfetto UI."""
+        self.write({"name": kind, "ph": "M", "pid": os.getpid(), "tid": tid,
+                    "ts": 0, "args": {"name": str(value)}})
 
     def close(self):
         with self._lock:
@@ -65,14 +84,17 @@ class ChromeTraceSink:
 
 class Span:
     """Context manager: nvtx annotation + optional chrome-trace event +
-    optional histogram observation (duration in ms)."""
+    optional histogram observation (duration in ms). `tid` selects the
+    chrome-trace track (default 0 — single-engine timelines; the serving
+    stack passes its replica tid so pool timelines stay separated)."""
 
-    __slots__ = ("name", "sink", "histogram", "_t0", "_nvtx")
+    __slots__ = ("name", "sink", "histogram", "tid", "_t0", "_nvtx")
 
-    def __init__(self, name, sink=None, histogram=None):
+    def __init__(self, name, sink=None, histogram=None, tid=0):
         self.name = name
         self.sink = sink
         self.histogram = histogram
+        self.tid = tid
         self._t0 = 0.0
         self._nvtx = None
 
@@ -87,12 +109,12 @@ class Span:
         self._nvtx.__exit__(exc_type, exc, tb)
         self._nvtx = None
         if self.sink is not None:
-            self.sink.add(self.name, self._t0, dur)
+            self.sink.add(self.name, self._t0, dur, tid=self.tid)
         if self.histogram is not None:
             self.histogram.observe(dur * 1e3)
         return False
 
 
-def span(name, sink=None, histogram=None):
+def span(name, sink=None, histogram=None, tid=0):
     """Open a named span (see `Span`); usable as `with span("admit"): ...`."""
-    return Span(name, sink=sink, histogram=histogram)
+    return Span(name, sink=sink, histogram=histogram, tid=tid)

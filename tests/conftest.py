@@ -76,6 +76,13 @@ def pytest_configure(config):
                    "histograms, MFU accounting, exporters, dstpu_metrics) — "
                    "fast and CPU-harness-safe, rides in tier-1; run it "
                    "alone with pytest -m telemetry)")
+    config.addinivalue_line(
+        "markers", "tracing: request tracing / flight recorder / compile "
+                   "watchdog suite (tests/test_tracing.py — end-to-end "
+                   "request span trees across the router pool, failover "
+                   "trace continuity, black-box dumps, recompile "
+                   "detection, dstpu_trace) — fast and CPU-harness-safe, "
+                   "rides in tier-1; run it alone with pytest -m tracing)")
 
 
 # The slow tier, by measured duration (r5 full-suite run with --durations,

@@ -133,10 +133,13 @@ def _golden_registry():
 
 def test_prometheus_golden():
     expected = "\n".join([
+        "# HELP serving_queue_depth deepspeed-tpu serving/queue_depth",
         "# TYPE serving_queue_depth gauge",
         "serving_queue_depth 2.5",
+        "# HELP serving_requests_total deepspeed-tpu serving/requests",
         "# TYPE serving_requests_total counter",
         "serving_requests_total 3",
+        "# HELP serving_ttft_ms deepspeed-tpu serving/ttft_ms",
         "# TYPE serving_ttft_ms histogram",
         'serving_ttft_ms_bucket{le="1"} 1',
         'serving_ttft_ms_bucket{le="10"} 2',
@@ -146,6 +149,75 @@ def test_prometheus_golden():
         "serving_ttft_ms_count 4",
     ]) + "\n"
     assert prometheus_text(_golden_registry()) == expected
+
+
+def _check_prometheus_conformance(text):
+    """Validate the text exposition rules an external scraper enforces:
+    name grammar, HELP-then-TYPE exactly once per family, counters ending
+    in `_total`, the mandatory `+Inf` bucket, and `_count`/`_sum`
+    consistency (cumulative +Inf count == _count)."""
+    import re
+    name_re = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    lines = text.strip().splitlines()
+    seen_help, seen_type, types = set(), set(), {}
+    samples = {}                       # family -> [(suffix_or_name, value)]
+    for ln in lines:
+        if ln.startswith("# HELP "):
+            fam = ln.split()[2]
+            assert fam not in seen_help, f"duplicate HELP for {fam}"
+            assert fam not in seen_type, f"HELP after TYPE for {fam}"
+            assert "\n" not in ln      # newlines must be escaped
+            seen_help.add(fam)
+        elif ln.startswith("# TYPE "):
+            _, _, fam, kind = ln.split()
+            assert fam in seen_help, f"TYPE before HELP for {fam}"
+            assert fam not in seen_type, f"duplicate TYPE for {fam}"
+            assert kind in ("counter", "gauge", "histogram")
+            seen_type.add(fam)
+            types[fam] = kind
+        else:
+            name = ln.split("{", 1)[0].split()[0]
+            assert name_re.match(name), f"bad sample name {name!r}"
+            fam = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and name[:-len(suffix)] in types:
+                    fam = name[:-len(suffix)]
+            assert fam in types, f"sample {name!r} outside any TYPE family"
+            float(ln.split()[-1])      # value parses
+            samples.setdefault(fam, []).append(ln)
+    for fam, kind in types.items():
+        assert samples.get(fam), f"family {fam} has no samples"
+        if kind == "counter":
+            assert fam.endswith("_total")
+        if kind == "histogram":
+            buckets = [s for s in samples[fam] if "_bucket{" in s]
+            les = [re.search(r'le="([^"]+)"', s).group(1) for s in buckets]
+            assert les[-1] == "+Inf", f"{fam} misses the +Inf bucket"
+            counts = [int(s.split()[-1]) for s in buckets]
+            assert counts == sorted(counts), f"{fam} buckets not cumulative"
+            count_line = next(s for s in samples[fam]
+                              if s.startswith(f"{fam}_count "))
+            assert int(count_line.split()[-1]) == counts[-1], \
+                f"{fam}: +Inf bucket != _count"
+            assert any(s.startswith(f"{fam}_sum ") for s in samples[fam])
+
+
+def test_prometheus_conformance_rules():
+    # the golden registry plus every escaping hazard: slashes and dashes in
+    # names, a leading digit, backslash + newline in HELP text
+    reg = _golden_registry()
+    reg.counter("1weird/name-with.dots").inc()
+    reg.histogram("spans/dur_ms").observe(3.0)
+    text = prometheus_text(reg, help_map={
+        "spans/dur_ms": 'line1\nline2 "quoted" \\backslash'})
+    _check_prometheus_conformance(text)
+    # escaping: the HELP newline/backslash survive as \n and \\
+    help_line = next(ln for ln in text.splitlines()
+                     if ln.startswith("# HELP spans_dur_ms"))
+    assert "\\n" in help_line and "\\\\" in help_line
+    assert "_1weird_name_with_dots_total 1" in text
+    # and the serving engine's real registry passes the same checker
+    _check_prometheus_conformance(prometheus_text(_golden_registry()))
 
 
 def test_prometheus_file_exporter_atomic(tmp_path):
@@ -168,6 +240,37 @@ def test_jsonl_exporter_golden_roundtrip(tmp_path):
     rec = json.loads(lines[-1])
     assert rec["step"] == 8
     assert rec["metrics"] == reg.snapshot()
+
+
+def test_dstpu_metrics_watch_rate_column():
+    """--watch threads the previous snapshot through render(): counters
+    grow a per-interval rate column (delta/dt), histograms and gauges do
+    not, and a counter RESET (monotonic total going backward — process
+    restart) suppresses the rate instead of printing a negative one."""
+    from deepspeed_tpu.telemetry.cli import counter_rate, render
+
+    def rec(t, tokens, depth):
+        return {"step": 1, "time": t, "metrics": {
+            "serving/tokens": {"type": "counter", "value": tokens},
+            "serving/queue_depth": {"type": "gauge", "value": depth},
+            "serving/ttft_ms": {"type": "histogram", "count": 3, "sum": 30.0,
+                                "mean": 10.0, "min": 1.0, "max": 20.0,
+                                "p50": 10.0, "p90": 19.0, "p99": 20.0}}}
+
+    r0, r1 = rec(100.0, 1000.0, 2.0), rec(104.0, 1600.0, 3.0)
+    assert counter_rate("serving/tokens", r1, r0) == pytest.approx(150.0)
+    assert counter_rate("serving/tokens", r1, None) is None    # first sample
+    assert counter_rate("serving/queue_depth", r1, r0) is None  # not a counter
+    assert counter_rate("serving/tokens", r0, r1) is None       # dt <= 0
+    reset = rec(108.0, 5.0, 1.0)
+    assert counter_rate("serving/tokens", reset, r1) is None    # reset guard
+    out = render(r1, prev=r0)
+    row = next(ln for ln in out.splitlines() if "serving/tokens" in ln)
+    assert "150/s" in row
+    hist_row = next(ln for ln in out.splitlines() if "ttft" in ln)
+    assert "/s" not in hist_row
+    # without prev (plain one-shot mode) the rate column stays empty
+    assert "150/s" not in render(r1)
 
 
 def test_dstpu_metrics_cli_json_roundtrip(tmp_path, capsys):
@@ -285,6 +388,88 @@ def test_span_chrome_trace_sink(tmp_path):
     assert [e["name"] for e in events] == ["serving/admit",
                                            "serving/decode_window"]
     assert all(e["ph"] == "X" and e["dur"] >= 0 for e in events)
+
+
+def test_chrome_sink_metadata_and_tid(tmp_path):
+    """ChromeTraceSink speaks the metadata ("M") subset and honors a
+    caller-supplied tid, so a serving pool's replicas land on separate
+    NAMED Perfetto tracks instead of collapsing onto tid 0."""
+    from deepspeed_tpu.telemetry.spans import ChromeTraceSink, span
+    path = tmp_path / "t.trace.json"
+    sink = ChromeTraceSink(path)
+    sink.add_meta("process_name", "dstpu serving pool")
+    sink.add_meta("thread_name", "router", tid=0)
+    sink.add_meta("thread_name", "replica r1", tid=1)
+    with span("serving/admit", sink=sink):            # default tid 0
+        pass
+    with span("serving/decode_window", sink=sink, tid=1):
+        pass
+    sink.close()
+    events = [json.loads(ln.rstrip(",")) for ln in
+              path.read_text().strip().splitlines()[1:]]
+    meta = [e for e in events if e["ph"] == "M"]
+    assert [(e["name"], e["tid"], e["args"]["name"]) for e in meta] == [
+        ("process_name", 0, "dstpu serving pool"),
+        ("thread_name", 0, "router"),
+        ("thread_name", 1, "replica r1")]
+    spans_x = {e["name"]: e["tid"] for e in events if e["ph"] == "X"}
+    assert spans_x == {"serving/admit": 0, "serving/decode_window": 1}
+    # the Telemetry facade plumbs tid through span() too
+    t = Telemetry(TelemetryConfig(enabled=True, output_path=str(tmp_path),
+                                  prometheus=False, jsonl=False,
+                                  chrome_trace=True), subsystem="pool")
+    with t.span("serving/verify", tid=3):
+        pass
+    t.close()
+    events = [json.loads(ln.rstrip(",")) for ln in
+              (tmp_path / "pool.trace.json").read_text()
+              .strip().splitlines()[1:]]
+    assert events[0]["name"] == "serving/verify" and events[0]["tid"] == 3
+
+
+def test_metric_catalog_lint():
+    """The docs/profiling.md metric catalog and the source tree must agree:
+    every literal metric name recorded through the telemetry facade (or a
+    registry handle) appears in the catalog, and every catalog row names a
+    metric that still exists (no dead rows). Dynamically composed names
+    (f-string router counters, per-replica TTFT, record_events routing)
+    are enumerated explicitly — growing one means growing its doc row."""
+    import pathlib
+    import re
+
+    root = pathlib.Path(deepspeed_tpu.__file__).parent
+    pat = re.compile(
+        r'\.(?:inc|observe|set_gauge|histogram|gauge|counter)'
+        r'\(\s*"([^"\s]+/[^"\s]+)"')
+    code_names = set()
+    for p in root.rglob("*.py"):
+        code_names |= {m.group(1) for m in pat.finditer(p.read_text())}
+    assert code_names, "the scan regex found nothing — did the facade move?"
+
+    # names the regex cannot see because they are composed at runtime
+    from deepspeed_tpu.serving import ServingRouter
+    router_counters = ServingRouter(replicas=[]).counters
+    dynamic = {f"router/{k}" for k in router_counters}
+    dynamic |= {
+        "router/replica/<rid>/ttft_ms",   # per-replica, rid interpolated
+        "train/hbm_bytes_in_use",         # gauge set via a (src, dst) table
+        "train/hbm_peak_bytes",
+        "Checkpoint/save_ms",             # routed through record_events
+    }
+
+    doc = (root.parent / "docs" / "profiling.md").read_text()
+    section = doc.split("### Metric catalog")[1].split("###")[0]
+    doc_names = set(re.findall(r"`([^`\s]+/[^`\s]+)`", section))
+    doc_names -= {n for n in doc_names if n.startswith("docs/")}  # links
+
+    undocumented = code_names - doc_names
+    assert not undocumented, \
+        f"metrics recorded in code but missing from the " \
+        f"docs/profiling.md catalog: {sorted(undocumented)}"
+    dead_rows = doc_names - code_names - dynamic
+    assert not dead_rows, \
+        f"docs/profiling.md catalog rows with no recording site left in " \
+        f"the tree: {sorted(dead_rows)}"
 
 
 def test_disabled_telemetry_is_total_noop(tmp_path, monkeypatch):
