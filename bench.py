@@ -52,7 +52,11 @@ Four lanes per run:
      the paged KV pool + scheduler (inference/scheduler.py) vs static-batch
      generate() on the SAME ragged mixed prompt/output-length trace;
      vs_baseline is the aggregate-tokens/s speedup of continuous over
-     static (the convoy + recompile tax made visible).
+     static (the convoy + recompile tax made visible). The same gate also
+     carries the prefix-cache, spec-decode, router, and robustness
+     sub-lanes (the last: a fixed chaos schedule through the self-healing
+     pool — completion rate, hedge wins, deadline cancellations,
+     degradation-level occupancy, watchdog-vs-hedging recovery TTFT).
   1c. bert (BENCH_BERT=0 to disable): bert-large MLM on the reference's
      fastest-BERT shapes (seq 128 / mbs 128 and seq 512 / mbs 16) — raw
      samples/s vs the V100 272/52 headline plus MFU on both chips' own
@@ -830,6 +834,188 @@ def run_router_lane():
     return result
 
 
+def run_robustness_lane():
+    """ROBUSTNESS lane (BENCH_SERVING gate): the self-healing layer under a
+    FIXED chaos schedule — a 2-replica pool serving a ragged trace while one
+    replica hangs mid-run (never raises, health probe fails) and the other
+    suffers scheduled safe pool corruptions (audit_interval=1 repairs them).
+    The same trace + schedule runs twice on a deterministic ChaosClock:
+    WITH the hung-replica watchdog (strike budget -> quarantine -> reroute
+    -> restart) and WITHOUT it (recovery rides hedged dispatch alone).
+
+    value is the completion rate (every submitted request resolved exactly
+    once — completed, or cancelled with an explicit reason); vs_baseline is
+    recovery latency leverage: simulated-clock TTFT p99 without the
+    watchdog over with it (>1 means the watchdog beats hedging alone to
+    recovery). extra carries the mechanism counters the ISSUE names: hedge
+    launches/wins, deadline cancellations, watchdog strikes/quarantines,
+    reroutes, audit repairs, and — from a single-engine pressure phase with
+    the ladder enabled — degradation-level occupancy and sheds.
+
+    Simulated time, real work: the clock driving watchdog/hedge/deadline
+    timers is the injected ChaosClock the schedule advances, so the lane
+    is replayable bit-for-bit; decode itself runs for real and wall times
+    ride in extra."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.inference.engine import init_inference
+    from deepspeed_tpu.inference.scheduler import Request
+    from deepspeed_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                          make_gpt_decode_model)
+    from deepspeed_tpu.serving import InProcessReplica, ServingRouter
+    from deepspeed_tpu.testing.chaos import (ChaosClock, ChaosReplica,
+                                             ChaosSchedule, ChaosEvent,
+                                             SAFE_CORRUPTIONS)
+
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    n_req = int(os.environ.get("BENCH_ROBUST_REQUESTS", "16"))
+    slots = int(os.environ.get("BENCH_ROBUST_SLOTS", "4"))
+    cfg = GPTConfig(n_layer=4, n_head=8, n_kv_head=4, d_model=512,
+                    max_seq_len=1024, vocab_size=50304, remat=False,
+                    use_rotary=True)
+    params = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16), init_gpt_params(cfg, seed=0))
+    spec = make_gpt_decode_model(cfg=cfg, params=params)
+    engine = init_inference(model=spec, config={
+        "dtype": "bfloat16", "kv_cache_dtype": "bfloat16", "greedy": True,
+        "kv_block_size": 128, "max_out_tokens": 1024,
+        # telemetry stamps first-token times on the injected clock ->
+        # simulated-time TTFT
+        "telemetry": {"enabled": True, "prometheus": False, "jsonl": False,
+                      "monitor_bridge": False}})
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            (int(rng.integers(32, 256)),)).astype(np.int32)
+               for _ in range(n_req)]
+    news = [int(rng.integers(8, 32)) for _ in range(n_req)]
+
+    def reqs():
+        # every 4th request carries a hard deadline the hang will eat for
+        # copies stuck on the hung replica (the deadline survives hedge
+        # re-dispatch — dead-on-arrival copies retire reason="deadline")
+        return [Request(uid=i, tokens=p, max_new_tokens=n, stop_on_eos=False,
+                        deadline_ms=1200.0 if i % 4 == 0 else None)
+                for i, (p, n) in enumerate(zip(prompts, news))]
+
+    def serving():
+        return engine.serving(max_slots=slots, max_context=1024,
+                              prefill_chunk=128, enable_prefix_caching=True,
+                              audit_interval=1)
+
+    def chaos_pool(clock):
+        # fixed schedule: replica "hung" hangs for good at its step 3
+        # (each stuck step advances the clock 0.4s, so hedge timers and
+        # deadline sweeps keep firing); replica "dirty" takes seeded safe
+        # corruptions its audit_interval=1 audits must repair in-line
+        hung = ChaosReplica(
+            InProcessReplica(factory=serving, replica_id="hung"),
+            ChaosSchedule([ChaosEvent(3, "hang", 0.4)]), clock=clock)
+        dirty = ChaosReplica(
+            InProcessReplica(factory=serving, replica_id="dirty"),
+            ChaosSchedule.seeded(7, 64, corrupt_rate=0.3,
+                                 corruptions=SAFE_CORRUPTIONS),
+            clock=clock, seed=70)
+        return [hung, dirty]
+
+    def run_pool(watchdog):
+        clock = ChaosClock(tick=0.0005)
+        router = ServingRouter(
+            replicas=chaos_pool(clock), clock=clock,
+            step_deadline_ms=150.0 if watchdog else None,
+            step_strike_budget=2, hedge_after_ms=2000.0,
+            restart_backoff_s=0.0, max_replica_restarts=1)
+        t0 = time.perf_counter()
+        res, stalls = {}, 0
+        for r in reqs():
+            router.submit(r)
+        # manual drive with stall detection instead of router.run(): without
+        # the watchdog a request whose FIRST TOKEN already arrived on the
+        # replica that then hangs is unrecoverable by design (hedging is
+        # first-token-gated, deadlines sweep at engine syncs a hung engine
+        # never reaches) — the honest report is a completion rate < 1, not
+        # a stuck bench
+        while router.in_flight and stalls < 3:
+            before = router._progress_mark()
+            for d in router.step():
+                res[d.uid] = d
+            stalls = stalls + 1 if router._progress_mark() == before else 0
+        wall = time.perf_counter() - t0
+        if watchdog:
+            assert sorted(res) == list(range(n_req)), \
+                "watchdog pool lost or duplicated work"
+        ttft = sorted((r.timing or {}).get("first_token", 0.0) * 1e3
+                      for r in res.values() if (r.timing or {})
+                      .get("first_token"))
+        audits = {"runs": 0, "violations": 0, "repairs": 0}
+        for rep in router.replicas.values():
+            for k, v in rep.stats().get("audit", {}).items():
+                if k in audits:
+                    audits[k] += v
+        return {
+            "completion_rate": round(len(res) / n_req, 4),
+            "stuck": sorted(set(range(n_req)) - set(res)),
+            "completed_ok": sum(r.finish_reason == "length"
+                                for r in res.values()),
+            "deadline_cancelled": sum(r.finish_reason == "deadline"
+                                      for r in res.values()),
+            "ttft_p99_sim_ms": round(ttft[min(len(ttft) - 1,
+                                              int(0.99 * len(ttft)))], 1)
+            if ttft else None,
+            "counters": {k: v for k, v in router.counters.items() if v},
+            "audit": audits,
+            "wall_s": round(wall, 2),
+        }
+
+    with_wd = run_pool(watchdog=True)
+    without_wd = run_pool(watchdog=False)
+
+    # degradation phase: one saturated engine, ladder enabled, a flood of
+    # requests (two droppable-priority) — occupancy proves every rung
+    # engaged and fully released
+    degr = engine.serving(
+        max_slots=2, max_context=1024, prefill_chunk=128,
+        enable_prefix_caching=True,
+        degradation={"enabled": True, "eval_interval": 1, "queue_high": 4,
+                     "queue_low": 1, "free_block_low": 0.0,
+                     "free_block_high": 0.0, "hold_steps": 2,
+                     "shed_below_priority": 1})
+    flood = [Request(uid=i, tokens=prompts[i % n_req], max_new_tokens=8,
+                     stop_on_eos=False, priority=1) for i in range(12)]
+    flood += [Request(uid=f"low{i}", tokens=prompts[i], max_new_tokens=8,
+                      stop_on_eos=False, priority=0) for i in range(2)]
+    dres = degr.run(flood)
+    dstats = degr.stats()["degradation"]
+
+    result = {
+        "metric": "gpt_serving_chaos_completion_rate",
+        "value": with_wd["completion_rate"],
+        "unit": "fraction",
+        # recovery leverage: hedging-only TTFT p99 over watchdog TTFT p99
+        "vs_baseline": round(without_wd["ttft_p99_sim_ms"]
+                             / max(1e-9, with_wd["ttft_p99_sim_ms"]), 4)
+        if with_wd["ttft_p99_sim_ms"] and without_wd["ttft_p99_sim_ms"]
+        else None,
+        "extra": {
+            "requests": n_req, "slots_per_replica": slots,
+            "with_watchdog": with_wd,
+            "without_watchdog": without_wd,
+            "degradation": {
+                "completed": len(dres),
+                "sheds": dstats["sheds"],
+                "escalations": dstats["escalations"],
+                "deescalations": dstats["deescalations"],
+                "final_level": dstats["level"],
+                "level_occupancy": dstats["level_occupancy"],
+            },
+        },
+    }
+    print(json.dumps(result))
+    return result
+
+
 REF_BERT_SAMPLES = {128: 272.0, 512: 52.0}   # V100 samples/s/GPU, fastest-BERT post
 V100_FP16_PEAK = 125.0                        # TFLOPs
 
@@ -916,6 +1102,9 @@ def main():
         return
     if env("BENCH_ROUTER_CHILD") == "1":  # serving-router sub-lane child
         run_router_lane()
+        return
+    if env("BENCH_ROBUST_CHILD") == "1":  # robustness sub-lane child
+        run_robustness_lane()
         return
     model_name = env("BENCH_MODEL", "gpt2-760m")
     import jax.numpy as jnp
@@ -1071,6 +1260,18 @@ def main():
         if router is not None:
             print(json.dumps(router))
 
+    # robustness lane (same gate): the self-healing layer under a fixed
+    # chaos schedule — completion rate, hedge wins, deadline cancels,
+    # degradation occupancy, watchdog-vs-hedging recovery TTFT
+    robust = None
+    if env("BENCH_SERVING", "1") == "1" and "BENCH_MODEL" not in os.environ:
+        robust = sub_lane(
+            "robustness", BENCH_ROBUST_CHILD="1",
+            BENCH_ROBUST_REQUESTS=env("BENCH_ROBUST_REQUESTS", "16"),
+            BENCH_ROBUST_SLOTS=env("BENCH_ROBUST_SLOTS", "4"))
+        if robust is not None:
+            print(json.dumps(robust))
+
     # BERT lane (reference's second headline; VERDICT r4 item 5): raw
     # samples/s + MFU on both conventions, both reference shapes
     bert = None
@@ -1146,6 +1347,17 @@ def main():
             "affinity_hit_rate": router["extra"]["affinity_hit_rate"],
             "router_prefill_chunks":
                 router["extra"]["router_prefill_chunks"],
+        }
+    if robust is not None:
+        headline["extra"]["robustness"] = {
+            "metric": robust["metric"], "value": robust["value"],
+            "vs_baseline": robust["vs_baseline"],
+            "hedge_wins": robust["extra"]["without_watchdog"]["counters"]
+            .get("hedge_wins", 0),
+            "watchdog_quarantines":
+                robust["extra"]["with_watchdog"]["counters"]
+                .get("watchdog_quarantines", 0),
+            "degradation_sheds": robust["extra"]["degradation"]["sheds"],
         }
     if bert is not None:
         headline["extra"]["bert"] = bert["extra"]

@@ -54,6 +54,43 @@ class SpecDecodeConfig(ConfigModel):
 
 
 @dataclass
+class DegradationConfig(ConfigModel):
+    """Graceful-degradation ladder (`serving/degradation.py`).
+
+    When enabled, a `PressureController` evaluates pool pressure every
+    `eval_interval` scheduler syncs — free-block fraction, queue depth,
+    and (when telemetry is on) TTFT p99 — and walks an ORDERED ladder of
+    service-degrading levels, one rung per evaluation, escalating while
+    any signal is over its high watermark and de-escalating one rung only
+    after `hold_steps` consecutive calm evaluations (hysteresis: separate
+    high/low watermarks + the hold count prevent flapping):
+
+      0 normal · 1 cap draft_k to 1 (spec decode keeps its compiled shape,
+      the drafter just proposes less) · 2 disable spec decode (fall back
+      to a single-step decode program) · 3 force the 1-step decode window
+      (finer retirement granularity frees blocks sooner) · 4 aggressively
+      flush the reclaimable prefix-cache blocks (zeroes the replica's
+      prefix-affinity pull so the router routes shared-prefix traffic
+      elsewhere, and moves demand-eviction work off the admission path) ·
+      5 shed queued requests whose priority is below `shed_below_priority`.
+
+    Disabled (default) the controller is never constructed: the hot path,
+    the compiled programs, and `compile_stats()` are untouched.
+    """
+    enabled: bool = False
+    eval_interval: int = 4        # scheduler syncs between evaluations
+    free_block_low: float = 0.10  # available/capacity below this => pressure
+    free_block_high: float = 0.30 # ...and above this counts as calm
+    queue_high: int = 16          # engine queue depth over this => pressure
+    queue_low: int = 2            # ...and at/below this counts as calm
+    ttft_p99_ms: float = 0.0      # TTFT p99 over this => pressure (0 = off;
+                                  # needs telemetry for the histogram)
+    hold_steps: int = 3           # consecutive calm evals per de-escalation
+    shed_below_priority: int = 0  # level 5 sheds queued requests with
+                                  # Request.priority strictly below this
+
+
+@dataclass
 class ServingConfig(ConfigModel):
     """Continuous-batching serving engine (`inference/scheduler.py`).
 
@@ -99,6 +136,22 @@ class ServingConfig(ConfigModel):
                                   # speculative decoding (drafter/draft_k —
                                   # see SpecDecodeConfig); replaces the
                                   # decode window when on
+    audit_interval: int = 0       # run the KV-pool invariant auditor
+                                  # (inference/audit.py) every N scheduler
+                                  # syncs (0 = on-demand/shutdown only).
+                                  # Host-side reads only — never touches the
+                                  # compiled programs
+    audit_action: str = "repair"  # on a failed audit, after the flight-
+                                  # recorder dump: "repair" rebuilds the
+                                  # free list/refcounts from the slot tables
+                                  # (ground truth) and keeps serving;
+                                  # "raise" raises PoolCorruptionError out
+                                  # of step() so the serving router
+                                  # quarantines the replica (PR 6 failover)
+    degradation: DegradationConfig = field(default_factory=DegradationConfig)
+                                  # graceful-degradation ladder under
+                                  # sustained pressure (see
+                                  # DegradationConfig); off by default
     prefix_cache_policy: str = "lru"  # what happens to a cached block when
                                   # its last reader retires: "lru" parks it
                                   # on the reclaimable list (evicted oldest-

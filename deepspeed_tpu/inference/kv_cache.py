@@ -144,6 +144,19 @@ class BlockAllocator:
             del self._reclaimable[b]
         return self._refs[b]
 
+    def flush_reclaimable(self, keep: int = 0) -> int:
+        """Demand-independent reclaim (the degradation ladder's "aggressive
+        prefix-cache reclaim" rung): evict parked refcount-0 cached blocks
+        NOW — oldest first, down to `keep` survivors — instead of lazily at
+        the next failing alloc. Trades future prefix-cache hits for
+        immediately-free blocks under pool pressure. Returns the number of
+        blocks evicted."""
+        n = 0
+        while len(self._reclaimable) > max(0, int(keep)):
+            self._evict_one()
+            n += 1
+        return n
+
     def free(self, blocks: List[int]):
         """Decref each block. At zero: cached blocks (per `is_cached`) park
         on the reclaimable LRU (policy 'lru'); everything else — and all

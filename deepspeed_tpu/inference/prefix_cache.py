@@ -130,3 +130,21 @@ class PrefixCache:
     def num_cached(self) -> int:
         """Registered blocks (live shared + reclaimable)."""
         return len(self._by_block)
+
+    # ------------------------------------------------------------------
+    # audit surface (inference/audit.py, bin/dstpu_audit)
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, int]:
+        """Portable hex-keyed copy of the forward registration map
+        (hash -> physical block) — the audit-state interchange form the
+        pool auditor checks I3 (hash-chain liveness + bijection) against.
+        All-JSON types, so a flight dump embeds it directly."""
+        return {h.hex(): int(b) for h, b in self._by_hash.items()}
+
+    def reverse_snapshot(self) -> Dict[int, str]:
+        """Portable copy of the reverse map (block -> hash hex). The
+        auditor cross-checks it against `snapshot()`: the two maps must be
+        inverse bijections, or a future hit would serve another prefix's
+        KV content."""
+        return {int(b): h.hex() for b, h in self._by_block.items()}

@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.inference.audit import PoolAuditor, PoolCorruptionError
 from deepspeed_tpu.inference.engine import sample_logits
 from deepspeed_tpu.inference.kv_cache import (BlockAllocator, TRASH_BLOCK,
                                               blocks_needed, max_written_pos,
@@ -74,12 +75,23 @@ class InadmissibleRequestError(ValueError):
 @dataclasses.dataclass
 class Request:
     """One generation request. `eos_token_id=None` falls back to the engine /
-    model default; `stop_on_eos=False` disables early stop entirely."""
+    model default; `stop_on_eos=False` disables early stop entirely.
+
+    `deadline_ms` is a hard end-to-end budget from submission: unlike the
+    router's TTL (which only cancels QUEUED requests), the deadline is
+    enforced past admission — a request still generating when its budget
+    runs out retires at the next scheduler sync with
+    ``finish_reason="deadline"`` (tokens emitted so far are kept).
+    `priority` orders degradation-time shedding (`serving/degradation.py`):
+    under the ladder's top level, queued requests with priority below the
+    configured threshold are shed first; it never affects FIFO order."""
     uid: Any
     tokens: Sequence[int]
     max_new_tokens: int = 32
     eos_token_id: Optional[int] = None
     stop_on_eos: bool = True
+    deadline_ms: Optional[float] = None
+    priority: int = 0
 
 
 @dataclasses.dataclass
@@ -89,7 +101,8 @@ class CompletedRequest:
     tokens: np.ndarray        # generated tokens; the EOS (if emitted) is kept
     finish_reason: str        # "eos" | "length" | "cancelled" (withdrawn via
                               # cancel() before finishing; router TTL/shedding
-                              # surfaces as this too)
+                              # surfaces as this too) | "deadline" (hard
+                              # per-request budget expired mid-flight)
     cached_prefix_tokens: int = 0  # prompt tokens whose KV came from the
                               # prefix cache (0 when caching is off/missed)
     timing: Optional[Dict[str, float]] = None  # telemetry only: monotonic
@@ -103,7 +116,7 @@ _FREE, _PREFILL, _DECODE, _HANDOFF = 0, 1, 2, 3
 class _Slot:
     __slots__ = ("idx", "state", "uid", "prompt", "prompt_len", "padded_len",
                  "max_new", "eos", "blocks", "cursor", "pos", "emitted",
-                 "hashes", "reg", "cached", "prefill_only",
+                 "hashes", "reg", "cached", "prefill_only", "deadline",
                  "t_arrive", "t_admit", "t_first", "t_prev", "trace")
 
     def __init__(self, idx):
@@ -123,6 +136,7 @@ class _Slot:
         self.cached = 0         # blocks mapped from the cache at admission
         self.prefill_only = False  # disaggregated serving: park in _HANDOFF
                                 # after the last chunk instead of decoding
+        self.deadline = None    # absolute hard deadline (engine clock)
         self.t_arrive = self.t_admit = self.t_first = None  # telemetry stamps
         self.t_prev = None      # last emission sync (TPOT interpolation anchor)
         self.trace = None       # TraceContext (None unless tracing is on)
@@ -160,6 +174,11 @@ class ServingEngine:
             from deepspeed_tpu.inference.config import SpecDecodeConfig
             scfg = dataclasses.replace(
                 scfg, spec_decode=SpecDecodeConfig.from_dict(scfg.spec_decode))
+        if isinstance(scfg.degradation, dict):
+            # `serving(degradation={"enabled": True, ...})` overrides
+            from deepspeed_tpu.inference.config import DegradationConfig
+            scfg = dataclasses.replace(
+                scfg, degradation=DegradationConfig.from_dict(scfg.degradation))
         self.serving_config = scfg
         # injectable clock (tests pin TTFT/TPOT interpolation with it; the
         # router injects its own for TTL — this one stamps request timing)
@@ -247,6 +266,32 @@ class ServingEngine:
                                     draft_spec=draft_spec) \
             if self.spec_on else None
 
+        # self-healing: pool invariant auditor (inference/audit.py) — pure
+        # host-side reads, run every `audit_interval` syncs / on demand /
+        # at close(); on violation: flight dump, then repair-or-raise
+        self.audit_interval = int(scfg.audit_interval or 0)
+        self.audit_action = str(scfg.audit_action or "repair")
+        if self.audit_action not in ("repair", "raise"):
+            raise ValueError(f"unknown audit_action {self.audit_action!r} "
+                             f"(expected 'repair' or 'raise')")
+        self._auditor = PoolAuditor(self)
+        self.audits_run = 0
+        self.audit_violations_total = 0
+        self.audit_repairs = 0
+
+        # graceful degradation (serving/degradation.py): disabled default
+        # means the controller is never built — the hot path, the compiled
+        # programs and compile_stats() are byte-identical without it
+        self.pressure = None
+        if scfg.degradation.enabled:
+            from deepspeed_tpu.serving.degradation import PressureController
+            self.pressure = PressureController(self, scfg.degradation)
+        self._decode_step_w1 = None   # lazily-built 1-step decode program
+                                      # (degradation fallback; also the spec-
+                                      # decode-disabled path, whose block
+                                      # sizing has no window-rounding tail)
+        self._deadlines = False       # any live request carries a deadline
+
         # observability
         self.steps = 0
         self.decode_steps = 0
@@ -257,6 +302,9 @@ class ServingEngine:
         self.tokens_generated = 0
         self.peak_active = 0
         self.cancelled = 0                  # requests withdrawn via cancel()
+        self.deadline_cancelled = 0         # requests retired reason="deadline"
+        self.degradation_sheds = 0          # queued requests shed by the
+                                            # pressure controller's top rung
         self.handoffs_out = 0               # slots exported to a decode engine
         self.handoffs_in = 0                # slots adopted from a prefill engine
         self.verify_calls = 0               # spec decode: jitted verify steps
@@ -290,29 +338,39 @@ class ServingEngine:
                                  temperature=cfg.temperature, top_k=cfg.top_k,
                                  top_p=cfg.top_p)
 
-        window = self.window
+        def make_decode_step(window):
+            """Build the decode-WINDOW program: `window` tokens per sync
+            inside one lax.scan (multi-step scheduling). One device call +
+            one host roundtrip amortize over the whole window — the
+            dispatch-latency lever. Returns emitted tokens [S, window]: the
+            window of successors of the input token, with the input's k/v
+            (and each successor's but the last) written into the pool along
+            the way. A builder, not a single closure, because the pressure
+            controller's window-shrink rung needs a second, 1-step variant
+            of the same program built lazily at degradation time."""
 
-        def decode_step(params, tok, pos, pool, tables, rng):
-            """Decode WINDOW: `window` tokens per sync inside one lax.scan
-            (multi-step scheduling). One device call + one host roundtrip
-            amortize over the whole window — the dispatch-latency lever.
-            Returns emitted tokens [S, window]: the window of successors of
-            the input token, with the input's k/v (and each successor's but
-            the last) written into the pool along the way."""
-            if window == 1:      # no scan wrapper: keep the 1-step hot path
-                logits, pool = decode_paged(params, tok, pos, pool, tables)
-                return sample(logits, rng)[:, None], pool
+            def decode_step(params, tok, pos, pool, tables, rng):
+                if window == 1:  # no scan wrapper: keep the 1-step hot path
+                    logits, pool = decode_paged(params, tok, pos, pool,
+                                                tables)
+                    return sample(logits, rng)[:, None], pool
 
-            def body(carry, _):
-                tok, pos, pool, rng = carry
-                rng, sub = jax.random.split(rng)
-                logits, pool = decode_paged(params, tok, pos, pool, tables)
-                nxt = sample(logits, sub)
-                return (nxt, pos + 1, pool, rng), nxt
+                def body(carry, _):
+                    tok, pos, pool, rng = carry
+                    rng, sub = jax.random.split(rng)
+                    logits, pool = decode_paged(params, tok, pos, pool,
+                                                tables)
+                    nxt = sample(logits, sub)
+                    return (nxt, pos + 1, pool, rng), nxt
 
-            (_, _, pool, _), toks = jax.lax.scan(
-                body, (tok, pos, pool, rng), None, length=window)
-            return jnp.moveaxis(toks, 0, 1), pool
+                (_, _, pool, _), toks = jax.lax.scan(
+                    body, (tok, pos, pool, rng), None, length=window)
+                return jnp.moveaxis(toks, 0, 1), pool
+
+            return decode_step
+
+        self._make_decode_fn = make_decode_step
+        decode_step = make_decode_step(self.window)
 
         def prefill_step(params, toks, start, last_idx, pool, table, rng):
             logits, pool = prefill_paged(params, toks, start, last_idx, pool,
@@ -357,6 +415,22 @@ class ServingEngine:
 
             self._verify_step = wd.wrap(
                 "verify_step", jax.jit(verify_step, donate_argnums=(3,)))
+
+    def _degraded_decode_step(self):
+        """The 1-step decode program, built lazily the first time a
+        degraded path needs it: the spec-decode-disabled fallback (whose
+        block sizing carries a k-draft overhang, not a window-rounding
+        tail, so running the K-step window could write past the allocated
+        blocks) and the pressure ladder's window-shrink rung. One extra
+        warmup compile at first engagement; `compile_stats()` reports it
+        as `decode_step_w1` from then on."""
+        if self.window == 1:
+            return self._decode_step
+        if self._decode_step_w1 is None:
+            self._decode_step_w1 = self.telemetry.watchdog.wrap(
+                "decode_step_w1",
+                jax.jit(self._make_decode_fn(1), donate_argnums=(3,)))
+        return self._decode_step_w1
 
     def _next_rng(self):
         if self.config.greedy:
@@ -423,8 +497,18 @@ class ServingEngine:
         if tid is not None:
             self.trace_tid = int(tid)
 
+    def set_clock(self, clock):
+        """Unified clock injection (the router calls this on every replica,
+        and again after a restart): TTL at the router, the TTFT/TPOT stamps
+        and hard-deadline sweep here, and the watchdog/hedging timers all
+        read ONE time source, so a chaos test drives the whole pool's time
+        deterministically. Absolute `deadline_at` values stay comparable
+        across replicas because every engine shares the router's clock."""
+        self._clock = clock
+
     def submit(self, request: Request, prefill_only: bool = False,
-               hashes: Optional[List[bytes]] = None, trace=None):
+               hashes: Optional[List[bytes]] = None, trace=None,
+               deadline_at: Optional[float] = None):
         """Queue a request. Raises `InadmissibleRequestError` if it can
         NEVER be admitted (it exceeds the engine's max_context table width
         or the whole pool); a request that merely doesn't fit *right now*
@@ -442,7 +526,11 @@ class ServingEngine:
         and again per failover re-dispatch — would be pure waste).
         `trace` carries the router's `TraceContext`; a standalone engine
         with tracing on mints its own here, so the request's whole life is
-        one connected span tree either way."""
+        one connected span tree either way. `deadline_at` pins the hard
+        deadline ABSOLUTELY (on this engine's clock) — the router passes
+        the original submit-time deadline through every re-dispatch so a
+        failover rerun or a hedged duplicate never extends the budget;
+        without it, `request.deadline_ms` anchors at arrival here."""
         prompt = np.asarray(request.tokens, np.int32).reshape(-1)
         prompt_len = int(prompt.shape[0])
         padded = -(-prompt_len // self.chunk) * self.chunk
@@ -456,6 +544,10 @@ class ServingEngine:
         elif hashes is None:
             hashes = self.prefix_cache.hash_chain(prompt)
         t_arrive = self._clock()
+        if deadline_at is None and request.deadline_ms is not None:
+            deadline_at = t_arrive + float(request.deadline_ms) / 1e3
+        if deadline_at is not None:
+            self._deadlines = True
         if self.tracer.enabled:
             if trace is None:
                 # no router above: this engine owns the trace end to end
@@ -465,7 +557,7 @@ class ServingEngine:
                               attrs={"prompt_len": prompt_len,
                                      "max_new": int(request.max_new_tokens)})
         self.queue.append((request, prompt, prompt_len, padded, need, hashes,
-                           t_arrive, prefill_only, trace))
+                           t_arrive, prefill_only, trace, deadline_at))
 
     def _resolve_eos(self, req: Request):
         if not req.stop_on_eos:
@@ -477,11 +569,17 @@ class ServingEngine:
             eos = self.engine.model_spec.eos_token_id
         return eos
 
-    def _admit(self):
+    def _admit(self, finished: List[CompletedRequest]):
         free = [s for s in self.slots if s.state == _FREE]
         while self.queue and free:
             (req, prompt, prompt_len, padded, need, hashes,
-             t_arrive, prefill_only, trace) = self.queue[0]
+             t_arrive, prefill_only, trace, deadline_at) = self.queue[0]
+            if deadline_at is not None and self._clock() >= deadline_at:
+                # dead on arrival at the slot: don't burn prefill compute
+                # on a request whose budget already expired in the queue
+                self.queue.popleft()
+                finished.append(self._expire_queued(req.uid, prompt_len))
+                continue
             hit = []
             if hashes:
                 # longest-prefix match, capped so at least the final prompt
@@ -543,6 +641,7 @@ class ServingEngine:
             slot.pos = prompt_len
             slot.emitted = []
             slot.prefill_only = prefill_only
+            slot.deadline = deadline_at
             slot.t_arrive = t_arrive
             if self.telemetry.enabled:
                 slot.t_admit = self._clock()
@@ -661,30 +760,77 @@ class ServingEngine:
     # cancellation + queue extraction (router TTL / failover build on these)
     # ------------------------------------------------------------------
 
-    def cancel(self, uid, queued_only: bool = False) -> Optional[CompletedRequest]:
+    def _expire_queued(self, uid, prompt_len) -> CompletedRequest:
+        """Complete a queued request whose hard deadline passed before it
+        ever touched a slot."""
+        self.deadline_cancelled += 1
+        if self.telemetry.enabled:
+            self.telemetry.inc("serving/deadline_cancelled")
+        if self.flightrec.enabled:
+            self.flightrec.record("deadline", uid=uid, queued=True)
+        return CompletedRequest(uid=uid, prompt_len=prompt_len,
+                                tokens=np.zeros((0,), np.int32),
+                                finish_reason="deadline")
+
+    def _sweep_deadlines(self, finished: List[CompletedRequest]):
+        """Hard-deadline enforcement at the scheduler sync point: an active
+        slot (generating OR parked for handoff) past its budget retires
+        with reason "deadline" — blocks freed the same call — and queued
+        requests past theirs complete without ever occupying a slot. Gated
+        by `_deadlines`, so traffic without deadlines never pays the scan."""
+        if not self._deadlines:
+            return
+        now = self._clock()
+        for slot in self.slots:
+            if slot.state != _FREE and slot.deadline is not None \
+                    and now >= slot.deadline:
+                self.deadline_cancelled += 1
+                if self.telemetry.enabled:
+                    self.telemetry.inc("serving/deadline_cancelled")
+                if self.flightrec.enabled:
+                    self.flightrec.record("deadline", uid=slot.uid,
+                                          tokens=len(slot.emitted))
+                finished.append(self._retire(slot, "deadline"))
+        if any(rec[9] is not None for rec in self.queue):
+            keep = collections.deque()
+            for rec in self.queue:
+                if rec[9] is not None and now >= rec[9]:
+                    finished.append(self._expire_queued(rec[0].uid, rec[2]))
+                else:
+                    keep.append(rec)
+            self.queue = keep
+
+    def cancel(self, uid, queued_only: bool = False,
+               reason: str = "cancelled") -> Optional[CompletedRequest]:
         """Withdraw a request wherever it lives. A queued request is removed
         before it ever touches a slot; an active one retires immediately —
         its blocks freed/decref'd the same call, exactly like an EOS
         retirement. Returns a `CompletedRequest` with
-        ``finish_reason="cancelled"`` (whatever tokens were already emitted
-        are kept), or None when `uid` is unknown — or still unstarted-only
-        under `queued_only=True`, the router-TTL mode that must never kill a
-        request already generating."""
+        ``finish_reason=reason`` (whatever tokens were already emitted are
+        kept), or None when `uid` is unknown — or not cancellable under
+        `queued_only=True`, the router-TTL mode that must never kill a
+        request already generating. A slot PARKED in the handoff state is
+        "not generating" for that purpose and IS cancelled under
+        `queued_only` — it holds exported blocks on the source pool while
+        waiting for a decode replica, and skipping it would leak them for
+        as long as the handoff stays deferred."""
         for i, rec in enumerate(self.queue):
             if rec[0].uid == uid:
                 del self.queue[i]
                 self.cancelled += 1
                 if self.flightrec.enabled:
-                    self.flightrec.record("cancel", uid=uid, queued=True)
+                    self.flightrec.record("cancel", uid=uid, queued=True,
+                                          reason=reason)
                 return CompletedRequest(uid=uid, prompt_len=rec[2],
                                         tokens=np.zeros((0,), np.int32),
-                                        finish_reason="cancelled")
-        if queued_only:
-            return None
+                                        finish_reason=reason)
         for slot in self.slots:
-            if slot.state != _FREE and slot.uid == uid:
-                self.cancelled += 1
-                return self._retire(slot, "cancelled")
+            if slot.state == _FREE or slot.uid != uid:
+                continue
+            if queued_only and slot.state != _HANDOFF:
+                return None
+            self.cancelled += 1
+            return self._retire(slot, reason)
         return None
 
     def drain_queued(self) -> List[Request]:
@@ -700,6 +846,108 @@ class ServingEngine:
         """Uids currently occupying slots (prefilling, decoding, or parked
         for handoff) — in-flight work that dies with the engine."""
         return [s.uid for s in self.slots if s.state != _FREE]
+
+    def has_output(self, uid) -> bool:
+        """True once the request has emitted its first token here — the
+        router's hedging probe: a dispatched request with no output past
+        `hedge_after_ms` earns a speculative duplicate elsewhere."""
+        for s in self.slots:
+            if s.state != _FREE and s.uid == uid:
+                return len(s.emitted) > 0
+        return False
+
+    def shed_queued_below_priority(self, min_priority: int
+                                   ) -> List[CompletedRequest]:
+        """Degradation-ladder top rung: complete (reason "cancelled") every
+        QUEUED request whose priority is strictly below `min_priority`.
+        Active slots are never shed — their compute is already sunk."""
+        out: List[CompletedRequest] = []
+        keep = collections.deque()
+        for rec in self.queue:
+            req = rec[0]
+            if int(getattr(req, "priority", 0)) < min_priority:
+                self.cancelled += 1
+                self.degradation_sheds += 1
+                if self.telemetry.enabled:
+                    self.telemetry.inc("serving/degradation_sheds")
+                if self.flightrec.enabled:
+                    self.flightrec.record("degrade_shed", uid=req.uid,
+                                          priority=int(req.priority))
+                out.append(CompletedRequest(uid=req.uid, prompt_len=rec[2],
+                                            tokens=np.zeros((0,), np.int32),
+                                            finish_reason="cancelled"))
+            else:
+                keep.append(rec)
+        self.queue = keep
+        return out
+
+    # ------------------------------------------------------------------
+    # pool invariant auditing (inference/audit.py)
+    # ------------------------------------------------------------------
+
+    def audit_state(self) -> Dict[str, Any]:
+        """Portable JSON snapshot of the pool bookkeeping — what
+        `bin/dstpu_audit` consumes, and what a flight dump embeds."""
+        return self._auditor.snapshot()
+
+    def audit(self, repair: bool = False):
+        """Run the pool invariant auditor now. On violations: dump the
+        flight recorder (ring + report + portable state snapshot), then —
+        with `repair=True` — rebuild the free list/refcounts/reclaimable
+        LRU from the slot tables (ground truth) and re-audit; a repair
+        that cannot reach a clean state raises `PoolCorruptionError`.
+        Returns the (pre-repair) `AuditReport`."""
+        report = self._auditor.audit()
+        self.audits_run += 1
+        if report.ok:
+            return report
+        self.audit_violations_total += len(report.violations)
+        if self.telemetry.enabled:
+            self.telemetry.inc("serving/audit_violations",
+                               len(report.violations))
+        if self.flightrec.enabled:
+            self.flightrec.record("audit_violation",
+                                  violations=len(report.violations),
+                                  by_kind=report.by_kind())
+            try:
+                stats = self.stats()
+            except Exception as e:                    # a corrupt pool may
+                stats = {"error": str(e)}             # break stats() itself
+            self.flightrec.dump(
+                f"pool audit failed: {report.summary()}",
+                state={"audit": report.to_dict(),
+                       "audit_state": self._auditor.snapshot(),
+                       "stats": stats})
+        if repair:
+            summary = self._auditor.repair()
+            self.audit_repairs += 1
+            if self.telemetry.enabled:
+                self.telemetry.inc("serving/audit_repairs")
+            if self.flightrec.enabled:
+                self.flightrec.record("audit_repair", **{
+                    k: summary[k] for k in ("violations_before",
+                                            "violations_after", "clean")})
+            log_dist(f"serving audit: repaired {report.summary()} -> "
+                     f"{'clean' if summary['clean'] else 'STILL DIRTY'}",
+                     ranks=[0])
+            if not summary["clean"]:
+                raise PoolCorruptionError(report)
+        return report
+
+    def _scheduled_audit(self):
+        """The every-N-syncs audit: repair in place or raise so the router
+        quarantines this replica, per `serving.audit_action`."""
+        report = self.audit(repair=(self.audit_action == "repair"))
+        if not report.ok and self.audit_action == "raise":
+            raise PoolCorruptionError(report)
+
+    def close(self):
+        """Engine shutdown: one final invariant audit (always — leaked
+        blocks at teardown are the cheapest possible time to catch) plus a
+        telemetry flush. Returns the final `AuditReport`."""
+        report = self.audit(repair=(self.audit_action == "repair"))
+        self.telemetry.close()
+        return report
 
     # ------------------------------------------------------------------
     # router surface: affinity scoring + load signals
@@ -858,6 +1106,11 @@ class ServingEngine:
         tr_on = self.tracer.enabled
         with self.telemetry.span("serving/draft", tid=self.trace_tid):
             drafts, dlens = self.drafter.propose(dec, tok, pos, tables)
+        if self.pressure is not None and self.pressure.draft_cap is not None:
+            # ladder rung 1: cap the ACCEPTED draft length only — the
+            # verify program keeps its compiled [S, k+1] shape, drafts past
+            # the cap score as padding and land past the cursor (dead)
+            dlens = np.minimum(dlens, self.pressure.draft_cap)
         toks = np.concatenate([tok[:, None], drafts], axis=1)
         t0 = self._clock() if tr_on else 0.0
         with self.telemetry.span("serving/verify", tid=self.trace_tid):
@@ -923,7 +1176,7 @@ class ServingEngine:
         params = self.engine.params
 
         with self.telemetry.span("serving/admit", tid=self.trace_tid):
-            self._admit()
+            self._admit(finished)
 
         # chunked prefill, bounded per step so arriving prompts cannot stall
         # the running batch for more than prefill_budget chunk-times
@@ -1000,21 +1253,35 @@ class ServingEngine:
                 tok[s.idx] = s.emitted[-1]
                 pos[s.idx] = s.pos
                 tables[s.idx] = self.tables[s.idx]
-            if self.spec_on:
+            spec_active = self.spec_on and not (
+                self.pressure is not None and self.pressure.spec_disabled)
+            if spec_active:
                 self._verify_decode(dec, tok, pos, tables, finished)
             else:
+                # the degraded paths run the 1-STEP decode program: with
+                # spec decode pressure-disabled the blocks were sized for
+                # the k-draft overhang (no window-rounding tail, so a K-step
+                # window could write past them), and the ladder's window-
+                # shrink rung trades dispatch amortization for K-times finer
+                # retirement/admission granularity under pool pressure
+                use_w1 = self.spec_on or (
+                    self.pressure is not None
+                    and self.pressure.force_window_1)
+                step_fn = self._degraded_decode_step() if use_w1 \
+                    else self._decode_step
+                win = 1 if use_w1 else self.window
                 tr_on = self.tracer.enabled
                 t0 = self._clock() if tr_on else 0.0
                 with self.telemetry.span("serving/decode_window",
                                          tid=self.trace_tid):
-                    nxt, self.pool = self._decode_step(params, tok, pos,
-                                                       self.pool, tables,
-                                                       self._next_rng())
-                    nxt = np.asarray(jax.device_get(nxt))   # [S, window]
+                    nxt, self.pool = step_fn(params, tok, pos,
+                                             self.pool, tables,
+                                             self._next_rng())
+                    nxt = np.asarray(jax.device_get(nxt))   # [S, win]
                 t1 = self._clock() if tr_on else 0.0
                 self.decode_steps += 1
                 for s in dec:
-                    s.pos += self.window
+                    s.pos += win
                     ctx = s.trace             # _retire resets the slot
                     anchor, j = s.t_prev, 0
                     for t in nxt[s.idx]:
@@ -1027,6 +1294,15 @@ class ServingEngine:
                         self.tracer.record(ctx, "decode_window", t0, t1 - t0,
                                            tid=self.trace_tid,
                                            attrs={"emitted": j})
+
+        # sync-point housekeeping: hard deadlines, the pressure ladder, and
+        # the scheduled pool audit all run here — between compiled calls,
+        # on host state only
+        self._sweep_deadlines(finished)
+        if self.pressure is not None:
+            self.pressure.update(finished)
+        if self.audit_interval and self.steps % self.audit_interval == 0:
+            self._scheduled_audit()
 
         if self.telemetry.enabled:
             self.telemetry.set_gauge("serving/queue_depth", len(self.queue))
@@ -1076,6 +1352,10 @@ class ServingEngine:
         if self.spec_on:
             out["verify_step"] = int(self._verify_step._cache_size())
             out.update(self.drafter.compile_stats())
+        if self._decode_step_w1 is not None:
+            # appears only once the degradation ladder (or the spec-decode
+            # fallback) actually built it — absent means never engaged
+            out["decode_step_w1"] = int(self._decode_step_w1._cache_size())
         return out
 
     def stats(self) -> Dict[str, Any]:
@@ -1084,6 +1364,7 @@ class ServingEngine:
                "tokens_generated": self.tokens_generated,
                "peak_active": self.peak_active,
                "cancelled": self.cancelled,
+               "deadline_cancelled": self.deadline_cancelled,
                "handoffs_in": self.handoffs_in,
                "handoffs_out": self.handoffs_out,
                "queued": len(self.queue), "active": self.num_active,
@@ -1108,6 +1389,12 @@ class ServingEngine:
                                     max(1, self.drafted_tokens)),
                 "accepted_tokens_per_step": (self.spec_emitted_tokens /
                                              max(1, self.verify_slot_steps))}
+        if self.audits_run:
+            out["audit"] = {"runs": self.audits_run,
+                            "violations": self.audit_violations_total,
+                            "repairs": self.audit_repairs}
+        if self.pressure is not None:
+            out["degradation"] = self.pressure.stats()
         if self.prefix_cache is not None:
             out["prefix_cache"] = {
                 "hit_blocks": self.prefix_hit_blocks,

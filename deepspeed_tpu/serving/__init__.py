@@ -18,13 +18,21 @@ AutoTP shards):
   * disaggregated prefill/decode — replicas tagged `role="prefill"` run
     chunked prefill only and hand each slot's KV blocks to a
     `role="decode"` replica (`kv_cache.transplant_blocks`), so long
-    prefills stop stalling decode TPOT.
+    prefills stop stalling decode TPOT;
+  * self-healing (`degradation.py` + the router's watchdog/hedging knobs +
+    `inference/audit.py`) — a hung-replica watchdog (per-step deadline,
+    strike budget, health probe) converging hangs onto the crash-failover
+    path, hard per-request deadlines + hedged dispatch, a KV-pool
+    invariant auditor with in-place repair, and `PressureController`'s
+    graceful-degradation ladder under sustained overload.
 
-See docs/inference.md "Distributed serving".
+See docs/inference.md "Distributed serving" and "Self-healing &
+degradation".
 """
 
+from deepspeed_tpu.serving.degradation import PressureController
 from deepspeed_tpu.serving.replica import InProcessReplica, ReplicaHandle
 from deepspeed_tpu.serving.router import RouterConfig, ServingRouter
 
 __all__ = ["ServingRouter", "RouterConfig", "ReplicaHandle",
-           "InProcessReplica"]
+           "InProcessReplica", "PressureController"]

@@ -27,7 +27,7 @@ class ReplicaHandle:
 
     # -- request lifecycle ------------------------------------------------
     def submit(self, request: Request, prefill_only: bool = False,
-               hashes=None, trace=None):
+               hashes=None, trace=None, deadline_at=None):
         raise NotImplementedError
 
     def step(self) -> List[CompletedRequest]:
@@ -39,6 +39,14 @@ class ReplicaHandle:
         replica (and hand it its Perfetto track id), so a pool's spans land
         in ONE trace file and one black box. Default no-op: a remote
         backend records on its own side and ships spans home out of band."""
+
+    def set_clock(self, clock):
+        """Unified clock injection: the router hands every replica ITS
+        clock so TTL checks, engine TTFT/TPOT stamps, hard deadlines, and
+        the watchdog/hedging timers all read one time source — chaos tests
+        drive the whole pool's time deterministically through it. Default
+        no-op: a remote backend keeps its own wall clock and the router's
+        absolute deadlines are re-anchored at its boundary."""
 
     def cancel(self, uid, queued_only: bool = False) -> Optional[CompletedRequest]:
         raise NotImplementedError
@@ -105,6 +113,35 @@ class ReplicaHandle:
     def can_restart(self) -> bool:
         raise NotImplementedError
 
+    def health_probe(self) -> bool:
+        """The hung-replica watchdog's liveness check, asked only after a
+        replica exhausts its slow-step strike budget: True = slow but
+        alive (strikes reset), False = presumed hung (quarantined through
+        the same failover path a crash takes). Default True — an
+        in-process replica that returned from step() at all is alive; a
+        remote backend overrides this with a real ping."""
+        return True
+
+    def has_output(self, uid) -> bool:
+        """True once `uid` has emitted its first token on this replica —
+        the hedging probe: a dispatched request still silent past
+        `hedge_after_ms` earns a speculative duplicate elsewhere. Default
+        True (= never hedge) so a backend that cannot answer cheaply is
+        never double-dispatched by mistake."""
+        return True
+
+    def audit(self, repair: bool = False):
+        """Run the KV-pool invariant auditor (inference/audit.py) on this
+        replica's pool now; returns the `AuditReport` (pre-repair) or None
+        for a backend with no in-process pool to audit (a remote replica
+        audits on its own side at its scheduled interval)."""
+        return None
+
+    def audit_state(self) -> Optional[Dict[str, Any]]:
+        """Portable JSON snapshot of the pool bookkeeping (what
+        `bin/dstpu_audit` consumes), or None for a remote backend."""
+        return None
+
     def stats(self) -> Dict[str, Any]:
         raise NotImplementedError
 
@@ -137,9 +174,10 @@ class InProcessReplica(ReplicaHandle):
         self.role = role
 
     # -- request lifecycle ------------------------------------------------
-    def submit(self, request, prefill_only=False, hashes=None, trace=None):
+    def submit(self, request, prefill_only=False, hashes=None, trace=None,
+               deadline_at=None):
         self.engine.submit(request, prefill_only=prefill_only, hashes=hashes,
-                           trace=trace)
+                           trace=trace, deadline_at=deadline_at)
 
     def step(self):
         return self.engine.step()
@@ -148,6 +186,9 @@ class InProcessReplica(ReplicaHandle):
     def attach_observability(self, tracer=None, flightrec=None, tid=None):
         self.engine.attach_observability(tracer=tracer, flightrec=flightrec,
                                          tid=tid)
+
+    def set_clock(self, clock):
+        self.engine.set_clock(clock)
 
     def cancel(self, uid, queued_only=False):
         return self.engine.cancel(uid, queued_only=queued_only)
@@ -221,6 +262,23 @@ class InProcessReplica(ReplicaHandle):
     @property
     def can_restart(self):
         return self._factory is not None
+
+    def health_probe(self):
+        # answering a host-side attribute read is all "alive" means for an
+        # in-process engine; a wedged backend surfaces as an exception here
+        try:
+            return self.engine.num_active >= 0
+        except Exception:
+            return False
+
+    def has_output(self, uid):
+        return self.engine.has_output(uid)
+
+    def audit(self, repair=False):
+        return self.engine.audit(repair=repair)
+
+    def audit_state(self):
+        return self.engine.audit_state()
 
     def stats(self):
         return self.engine.stats()

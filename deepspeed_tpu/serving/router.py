@@ -43,6 +43,27 @@ highest score wins; ties break toward the replica with the least pending
 work, then rotation order. `affinity_hits` counts dispatches whose winner
 held a non-zero prefix; `load_spills` counts dispatches where some OTHER
 replica held a strictly longer prefix but lost on load/saturation.
+
+Self-healing (this PR) extends health beyond "step() threw":
+
+  * **hung-replica watchdog** — each replica's step() is timed against
+    `step_deadline_ms` on the router's (injectable) clock; a replica over
+    the deadline `step_strike_budget` times IN A ROW is health-probed and,
+    if the probe fails, quarantined through the exact failover path an
+    exception takes — hangs and crashes converge on one recovery flow;
+  * **hard deadlines** — `Request.deadline_ms` anchors an ABSOLUTE
+    deadline at router submit that survives every re-dispatch (failover
+    rerun, hedge duplicate): the engine enforces it past admission at
+    every scheduler sync (`finish_reason="deadline"`), and the router
+    expires requests still in its own queue;
+  * **hedged dispatch** — a dispatched request with no first token after
+    `hedge_after_ms` gets a speculative duplicate on another replica with
+    capacity; first completion wins, the loser is cancelled, completion
+    de-dup rides the same `_done` bookkeeping failover re-routes use;
+  * **one clock** — the router's clock is injected into every replica
+    (`set_clock`, re-applied after restarts), so TTL, TTFT/TPOT stamps,
+    deadlines, the watchdog, and hedging share one deterministic time
+    source under test.
 """
 
 import collections
@@ -85,6 +106,27 @@ class RouterConfig:
     restart_backoff_s: float = 0.0  # base backoff before a replica restart
     restart_backoff_factor: float = 2.0
     restart_max_backoff_s: float = 60.0
+    step_deadline_ms: Optional[float] = None  # hung-replica watchdog: a
+                                  # replica step() over this budget (router
+                                  # clock) is a STRIKE; None disables the
+                                  # watchdog entirely
+    step_strike_budget: int = 3   # consecutive strikes before the health
+                                  # probe; probe False => quarantine (slow-
+                                  # but-alive resets the strike count)
+    hedge_after_ms: Optional[float] = None  # dispatched request with no
+                                  # first token after this long gets a
+                                  # speculative duplicate on another
+                                  # replica with capacity (first completion
+                                  # wins, loser cancelled); None disables.
+                                  # MIXED pools only — a disaggregated
+                                  # pool ignores it (one handoff home per
+                                  # uid; see _maybe_hedge)
+
+
+class ReplicaHungError(RuntimeError):
+    """The watchdog gave up on a replica: `step_strike_budget` consecutive
+    over-deadline steps AND a failed health probe. Used as the quarantine
+    reason so hangs ride the same failover path exceptions take."""
 
 
 @dataclasses.dataclass
@@ -94,10 +136,16 @@ class _Pending:
     prompt_len: int
     hashes: Optional[List[bytes]]
     t_submit: float
-    deadline: Optional[float]
+    deadline: Optional[float]       # TTL: queued-only cancellation
     replica: Optional[str] = None   # None while queued at the router
     trace: Any = None               # TraceContext; the router owns the root
                                     # span and closes it at completion
+    deadline_at: Optional[float] = None  # HARD deadline (absolute, router
+                                    # clock): anchored once at submit and
+                                    # passed through every re-dispatch, so
+                                    # failover/hedging never extend it
+    t_dispatch: Optional[float] = None   # last dispatch time (hedge timer)
+    hedge_replica: Optional[str] = None  # speculative duplicate's replica
 
 
 class ServingRouter:
@@ -132,6 +180,12 @@ class ServingRouter:
         assert config.routing_policy in ("affinity", "round_robin"), \
             f"unknown routing_policy {config.routing_policy!r}"
         self._clock = clock if clock is not None else time.monotonic
+        # an EXPLICITLY injected clock propagates to every replica
+        # (set_clock, re-applied after restarts) so the whole pool — TTL,
+        # engine TTFT/TPOT stamps, deadlines, watchdog, hedging — reads one
+        # time source; without injection both layers already default to
+        # time.monotonic, so there is nothing to unify
+        self._clock_injected = clock is not None
         self.replicas: Dict[str, ReplicaHandle] = {}
         self._quarantined: Dict[str, float] = {}   # rid -> earliest restart
         self._dead: set = set()                    # budget exhausted
@@ -160,7 +214,12 @@ class ServingRouter:
         self.counters = {k: 0 for k in (
             "submitted", "completed", "affinity_hits", "load_spills",
             "reroutes", "ttl_cancelled", "shed", "replica_failures",
-            "replica_restarts", "handoffs")}
+            "replica_restarts", "handoffs", "watchdog_strikes",
+            "watchdog_quarantines", "hedges", "hedge_wins",
+            "deadline_cancelled")}
+        self._strikes: Dict[str, int] = {}  # consecutive over-deadline steps
+        self._hedged: set = set()           # uids ever hedge-dispatched (the
+                                            # expected-duplicate allowlist)
         # rid -> router-level TTFT ms, a bounded sliding window (the full
         # distribution lives in the telemetry histogram; this stays O(1))
         self._ttft: Dict[str, collections.deque] = {}
@@ -223,6 +282,9 @@ class ServingRouter:
         self._ttft[rid] = collections.deque(maxlen=self._ttft_window)
         self._anticipated[rid] = collections.OrderedDict()
         self._tids[rid] = len(self.replicas)       # tid 0 is the router's
+        self._strikes[rid] = 0
+        if self._clock_injected:
+            handle.set_clock(self._clock)
         self._attach_observability(rid)
         log_dist(f"serving router: +replica {rid} role={handle.role} "
                  f"(pool: {len(self.replicas)})", ranks=[0])
@@ -341,7 +403,12 @@ class ServingRouter:
         self._pending[request.uid] = _Pending(
             request=request, prompt_len=prompt_len, hashes=hashes,
             t_submit=now, deadline=(now + ttl) if ttl is not None else None,
-            trace=trace)
+            trace=trace,
+            # the HARD deadline anchors here, once: every re-dispatch
+            # (failover rerun, hedge duplicate) passes the same absolute
+            # value down, so recovery never silently extends the budget
+            deadline_at=(now + float(request.deadline_ms) / 1e3)
+            if request.deadline_ms is not None else None)
         self.queue.append(request.uid)
         self._count("submitted")
         return None
@@ -481,8 +548,10 @@ class ServingRouter:
                     affinity=int(aff), score=round(float(score), 3),
                     spilled=bool(spilled))
             rep.submit(rec.request, prefill_only=self.disaggregated,
-                       hashes=rec.hashes, trace=rec.trace)
+                       hashes=rec.hashes, trace=rec.trace,
+                       deadline_at=rec.deadline_at)
             rec.replica = rep.replica_id
+            rec.t_dispatch = self._clock()
             self._note_dispatch(rep.replica_id, rec.hashes)
             if self.config.routing_policy == "affinity":
                 if aff > 0:
@@ -495,17 +564,42 @@ class ServingRouter:
     # ------------------------------------------------------------------
 
     def _sweep_ttl(self, now, finished):
-        expired = [uid for uid, rec in self._pending.items()
-                   if rec.deadline is not None and now >= rec.deadline]
-        for uid in expired:
+        # hard deadlines first: a request still in the ROUTER queue past
+        # its absolute budget completes with reason "deadline" (dispatched
+        # requests are the engine's job — its sync-point sweep retires
+        # them, and the completion flows back through step())
+        dead = [uid for uid, rec in self._pending.items()
+                if rec.deadline_at is not None and now >= rec.deadline_at
+                and rec.replica is None]
+        for uid in dead:
             rec = self._pending[uid]
+            self.queue.remove(uid)
+            self._count("deadline_cancelled")
+            if self.flightrec.enabled:
+                self.flightrec.record("deadline", uid=uid, queued=True)
+            self._complete(CompletedRequest(
+                uid=uid, prompt_len=rec.prompt_len,
+                tokens=np.zeros((0,), np.int32),
+                finish_reason="deadline"), finished)
+        expired = [uid for uid, rec in self._pending.items()
+                   if rec.deadline is not None and now >= rec.deadline
+                   # a hedged request is by definition dispatched twice and
+                   # possibly generating on either copy — TTL (queued-only
+                   # semantics) leaves it to completion or its hard deadline
+                   and rec.hedge_replica is None]
+        for uid in expired:
+            rec = self._pending.get(uid)
+            if rec is None:                       # deadline-swept above
+                continue
             if rec.replica is None:
                 self.queue.remove(uid)
                 done = CompletedRequest(uid=uid, prompt_len=rec.prompt_len,
                                         tokens=np.zeros((0,), np.int32),
                                         finish_reason="cancelled")
             else:
-                # only queued-but-unstarted dies; a generating request runs on
+                # only queued-but-unstarted dies; a generating request runs
+                # on (a slot PARKED for handoff counts as cancellable — it
+                # holds exported blocks, see ServingEngine.cancel)
                 done = self.replicas[rec.replica].cancel(uid, queued_only=True)
                 if done is None:
                     continue
@@ -515,14 +609,36 @@ class ServingRouter:
                                       replica=rec.replica or "")
             self._complete(done, finished)
 
-    def _complete(self, done: CompletedRequest, finished):
+    def _complete(self, done: CompletedRequest, finished, rid=None):
         if done.uid in self._done:
-            logger.warning(f"router: dropping duplicate completion for "
-                           f"{done.uid!r}")
+            if done.uid not in self._hedged:
+                # a hedge loser finishing in the same router step as the
+                # winner is the EXPECTED duplicate; anything else is a bug
+                # worth a line in the log
+                logger.warning(f"router: dropping duplicate completion for "
+                               f"{done.uid!r}")
             return
         rec = self._pending.pop(done.uid, None)
         self._done.add(done.uid)
         self._count("completed")
+        if rec is not None and rec.hedge_replica is not None:
+            # first completion wins: cancel the other copy wherever it is
+            # (it may be generating — full cancel, not queued_only), and
+            # credit the hedge when the duplicate beat the primary
+            winner = rid
+            if winner == rec.hedge_replica:
+                self._count("hedge_wins")
+            for other in {rec.replica, rec.hedge_replica} - {winner}:
+                if other in self.replicas and other not in self._dead \
+                        and other not in self._quarantined:
+                    try:
+                        self.replicas[other].cancel(done.uid)
+                    except Exception:
+                        pass          # a dying loser gets quarantined later
+            if self.flightrec.enabled:
+                self.flightrec.record("hedge_resolved", uid=done.uid,
+                                      winner=str(winner),
+                                      won=winner == rec.hedge_replica)
         if rec is not None and rec.trace is not None:
             # close the root (whole-request e2e, router queue included)
             self.tracer.finish(rec.trace, self._clock(), tid=0,
@@ -551,12 +667,22 @@ class ServingRouter:
             rep.drain_queued()          # engine queue state is re-owned here
         except Exception:
             pass                        # a truly dead backend may not answer
-        requeue = [uid for uid, rec in self._pending.items()
-                   if rec.replica == rid]
+        requeue = []
+        for uid, rec in self._pending.items():
+            if rec.hedge_replica == rid:
+                rec.hedge_replica = None       # the duplicate died with it
+            elif rec.replica == rid and rec.hedge_replica is not None:
+                # the primary died but its hedge is alive and already
+                # running the same request — promote it instead of a
+                # from-scratch rerun
+                rec.replica, rec.hedge_replica = rec.hedge_replica, None
+            elif rec.replica == rid:
+                requeue.append(uid)
         t = self._clock()
         for uid in requeue:
             rec = self._pending[uid]
             rec.replica = None
+            rec.t_dispatch = None
             if self.tracer.enabled and rec.trace is not None:
                 # a dispatch arrow the dead replica never admitted would
                 # dangle as an orphan "s" event — terminate it at the
@@ -574,6 +700,7 @@ class ServingRouter:
         self.queue.extendleft(reversed(requeue))
         self._count("reroutes", len(requeue))
         self._anticipated[rid].clear()   # its pool (and cache) is gone
+        self._strikes[rid] = 0           # the watchdog starts fresh post-restart
         budget = self._budgets[rid]
         if rep.can_restart and budget.consume("crash"):
             self._quarantined[rid] = self._clock() + budget.next_delay()
@@ -609,7 +736,10 @@ class ServingRouter:
                 self.replicas[rid].restart()
                 self._count("replica_restarts")
                 # a rebuilt engine starts detached from the pool's
-                # tracer/recorder (and from its Perfetto track) — re-inject
+                # tracer/recorder (and from its Perfetto track) AND from
+                # the pool clock — re-inject both
+                if self._clock_injected:
+                    self.replicas[rid].set_clock(self._clock)
                 self._attach_observability(rid)
                 if self.flightrec.enabled:
                     self.flightrec.record(
@@ -629,6 +759,110 @@ class ServingRouter:
         if rid in self._dead or rid in self._quarantined:
             return
         self._quarantine(rid, "killed")
+
+    # ------------------------------------------------------------------
+    # hung-replica watchdog + hedged dispatch
+    # ------------------------------------------------------------------
+
+    def _watchdog_check(self, rid, rep, t0):
+        """Per-step() deadline with a strike budget: one slow step is
+        noise, `step_strike_budget` IN A ROW earns a health probe, and a
+        failed probe converges on the same quarantine/drain/reroute path
+        an exception takes. A fast step resets the count — 'slow' and
+        'dead' stay distinguishable."""
+        if self.config.step_deadline_ms is None:
+            return
+        dt_ms = (self._clock() - t0) * 1e3
+        if dt_ms <= self.config.step_deadline_ms:
+            self._strikes[rid] = 0
+            return
+        self._strikes[rid] += 1
+        self._count("watchdog_strikes")
+        if self.flightrec.enabled:
+            self.flightrec.record("watchdog_strike", replica=rid,
+                                  step_ms=round(dt_ms, 3),
+                                  strikes=self._strikes[rid])
+        if self._strikes[rid] < max(1, self.config.step_strike_budget):
+            return
+        alive = False
+        try:
+            alive = bool(rep.health_probe())
+        except Exception:
+            pass
+        if alive:
+            self._strikes[rid] = 0      # slow but answering: keep serving
+            return
+        self._count("watchdog_quarantines")
+        self._quarantine(rid, ReplicaHungError(
+            f"replica {rid}: {self._strikes[rid]} consecutive steps over "
+            f"{self.config.step_deadline_ms}ms and health probe failed"))
+
+    def _hedge_target(self, rec):
+        """A healthy entry replica (≠ primary) with room to take the
+        duplicate right now — free slot or shallow queue, and the request
+        admissible there."""
+        for rep in self._healthy(self._entry_roles()):
+            if rep.replica_id == rec.replica:
+                continue
+            if not (rep.has_free_slot
+                    or rep.queue_depth < self.config.max_replica_queue):
+                continue
+            try:
+                rep.check_admissible(rec.prompt_len,
+                                     rec.request.max_new_tokens,
+                                     prefill_only=self.disaggregated,
+                                     uid=rec.request.uid)
+            except InadmissibleRequestError:
+                continue
+            return rep
+        return None
+
+    def _maybe_hedge(self, now):
+        """Deadline-aware hedged retries: a dispatched request with no
+        first token after `hedge_after_ms` gets ONE speculative duplicate
+        on another replica with capacity. First completion wins
+        (`_complete` cancels the loser and de-dups); the duplicate carries
+        the same absolute hard deadline, so hedging never extends a
+        budget. The duplicate carries no router trace context — the
+        primary owns the request's root span tree (with tracing on, the
+        hedge replica records it as a separate engine-owned trace).
+
+        MIXED pools only: in a disaggregated pool a hedged request would
+        park TWO prefill-complete copies in _HANDOFF, and the handoff
+        bookkeeping tracks one decode home per uid — the second transplant
+        would clobber it and strand the loser's slot for the whole
+        generation. Hung prefill replicas there are the watchdog's job."""
+        if self.disaggregated:
+            return
+        wait = float(self.config.hedge_after_ms) / 1e3
+        for uid, rec in list(self._pending.items()):
+            if (rec.replica is None or rec.hedge_replica is not None
+                    or rec.t_dispatch is None
+                    or now - rec.t_dispatch < wait):
+                continue
+            primary = self.replicas.get(rec.replica)
+            if primary is None:
+                continue
+            try:
+                if primary.has_output(uid):
+                    continue            # first token arrived: no hedge
+            except Exception:
+                pass                    # unanswerable primary: hedge away
+            rep = self._hedge_target(rec)
+            if rep is None:
+                continue
+            rep.submit(rec.request, prefill_only=self.disaggregated,
+                       hashes=rec.hashes, trace=None,
+                       deadline_at=rec.deadline_at)
+            rec.hedge_replica = rep.replica_id
+            self._hedged.add(uid)
+            self._note_dispatch(rep.replica_id, rec.hashes)
+            self._count("hedges")
+            if self.flightrec.enabled:
+                self.flightrec.record(
+                    "hedge", uid=uid, primary=rec.replica,
+                    hedge=rep.replica_id,
+                    waited_ms=round((now - rec.t_dispatch) * 1e3, 3))
 
     # ------------------------------------------------------------------
     # disaggregated handoff
@@ -695,11 +929,16 @@ class ServingRouter:
             if rid in self._quarantined or rid in self._dead:
                 continue
             rep = self.replicas[rid]
+            t0 = self._clock()
             try:
                 for done in rep.step():
-                    self._complete(done, finished)
+                    self._complete(done, finished, rid=rid)
             except Exception as e:
                 self._quarantine(rid, e)
+                continue
+            self._watchdog_check(rid, rep, t0)
+        if self.config.hedge_after_ms is not None:
+            self._maybe_hedge(self._clock())
         if self.disaggregated:
             self._do_handoffs()
         if self.telemetry.enabled:
@@ -727,23 +966,39 @@ class ServingRouter:
         return len(self._pending)
 
     def _await_restart_or_raise(self, msg):
-        """Stalled with a replica restart pending backoff: sleep until the
-        clock reaches it. An INJECTED clock that never advances would spin
-        forever here, so a non-moving clock raises instead of hanging."""
-        if not self._quarantined:
+        """Stalled with recovery still possible — a replica restart pending
+        backoff, or a dispatched-but-silent request whose hedge window has
+        not expired yet (a hung primary makes no progress while the hedge
+        timer runs) — sleep until the clock reaches it. An INJECTED clock
+        that never advances would spin forever here, so a non-moving clock
+        raises instead of hanging."""
+        if not (self._quarantined or self._hedge_may_fire()):
             raise RuntimeError(msg)
         t0 = self._clock()
         time.sleep(0.005)
         if self._clock() <= t0:
             raise RuntimeError(
-                msg + " (a replica restart is scheduled but the injected "
-                "clock never advances — advance it or use backoff 0)")
+                msg + " (a replica restart or hedge is scheduled but the "
+                "injected clock never advances — advance it or use "
+                "backoff 0)")
+
+    def _hedge_may_fire(self):
+        """True while some dispatched request could still earn a hedge —
+        the watchdog-off recovery path: the pool looks stalled until
+        `hedge_after_ms` elapses, but it is WAITING, not wedged."""
+        if self.config.hedge_after_ms is None or self.disaggregated:
+            return False                 # _maybe_hedge's mixed-pool gate
+        return any(rec.replica is not None and rec.hedge_replica is None
+                   for rec in self._pending.values())
 
     def _progress_mark(self):
         live = self._healthy()
         work = sum(r.progress() for r in live)
+        # hedges count as progress: the launch itself changes no queue or
+        # token counter until the target's next admission, and run() must
+        # not mistake that one-step gap for a wedged pool
         return (len(self.queue), len(self._pending), len(self._done), work,
-                len(live), len(self._quarantined))
+                len(live), len(self._quarantined), self.counters["hedges"])
 
     def run(self, requests: Sequence[Request],
             ttl_s: Optional[float] = None) -> Dict[Any, CompletedRequest]:
@@ -813,6 +1068,21 @@ class ServingRouter:
                 "counters": dict(self.counters),
                 "disaggregated": self.disaggregated,
                 "replicas": reps}
+
+    def audit_pool(self, repair: bool = False) -> Dict[str, Any]:
+        """Run the KV-pool invariant auditor on every LIVE replica (the
+        chaos soak's final check, and an operator probe between waves).
+        Returns rid -> `AuditReport`; replicas with no in-process pool to
+        audit (remote backends) are skipped. With `repair=True` a dirty
+        pool is rebuilt from its slot tables in place; a replica whose
+        repair cannot reach a clean state raises through the caller —
+        quarantine it with `kill_replica` if serving must continue."""
+        out: Dict[str, Any] = {}
+        for rep in self._healthy():
+            report = rep.audit(repair=repair)
+            if report is not None:
+                out[rep.replica_id] = report
+        return out
 
     def dump_flight_recorder(self, reason="operator dump"):
         """Write the black box NOW (operator/test hook). For out-of-band

@@ -83,6 +83,13 @@ def pytest_configure(config):
                    "trace continuity, black-box dumps, recompile "
                    "detection, dstpu_trace) — fast and CPU-harness-safe, "
                    "rides in tier-1; run it alone with pytest -m tracing)")
+    config.addinivalue_line(
+        "markers", "chaos: self-healing serving pool suite "
+                   "(tests/test_selfheal.py — KV-pool invariant auditor + "
+                   "repair, hung-replica watchdog, hard deadlines, hedged "
+                   "dispatch, degradation ladder, and the chaos soak over "
+                   "testing/chaos.py) — fast and CPU-harness-safe, rides "
+                   "in tier-1; run it alone with pytest -m chaos)")
 
 
 # The slow tier, by measured duration (r5 full-suite run with --durations,

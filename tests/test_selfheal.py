@@ -1,0 +1,849 @@
+"""Self-healing serving pool: the KV-pool invariant auditor (+ repair and
+the `bin/dstpu_audit` CLI), hard per-request deadlines, the hung-replica
+watchdog, hedged dispatch, the graceful-degradation ladder — and the chaos
+soak that exercises all of it together through `testing/chaos.py`.
+
+Everything here rides the `chaos` marker (tier-1; run alone with
+`pytest -m chaos`).
+"""
+
+import json
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.config.core import MeshConfig
+from deepspeed_tpu.inference.audit import (PoolCorruptionError,
+                                           audit_main, audit_state_dict)
+from deepspeed_tpu.inference.engine import init_inference
+from deepspeed_tpu.inference.kv_cache import TRASH_BLOCK
+from deepspeed_tpu.inference.scheduler import Request
+from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_decode_model
+from deepspeed_tpu.serving import InProcessReplica, ServingRouter
+from deepspeed_tpu.serving.degradation import (LEVEL_NAMES,
+                                               PressureController)
+from deepspeed_tpu.testing.chaos import (ChaosClock, ChaosReplica,
+                                         ChaosSchedule, ChaosEvent,
+                                         SAFE_CORRUPTIONS, corrupt_pool)
+
+pytestmark = pytest.mark.chaos
+
+TINY = GPTConfig(n_layer=2, n_head=4, d_model=64, max_seq_len=256,
+                 vocab_size=256, dtype=jnp.float32, remat=False)
+BS = 16  # kv_block_size == prefill_chunk for every engine below
+
+
+@pytest.fixture(scope="module")
+def engine():
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    mesh_mod.init_mesh(MeshConfig(data=1, tensor=1, sequence=1, expert=1,
+                                  pipe=1))
+    spec = make_gpt_decode_model(cfg=TINY, name="tiny")
+    return init_inference(model=spec, config={
+        "dtype": "float32", "kv_cache_dtype": "float32", "greedy": True,
+        "kv_block_size": BS, "max_out_tokens": 64})
+
+
+def _replica(engine, **over):
+    kw = dict(max_slots=2, max_context=96, prefill_chunk=BS,
+              enable_prefix_caching=True)
+    kw.update(over)
+    return engine.serving(**kw)
+
+
+def _shared_prefix_trace(rng, n, prefix_blocks=2, vocab=TINY.vocab_size):
+    prefix = rng.integers(0, vocab, (prefix_blocks * BS,)).astype(np.int32)
+    tails = rng.integers(2, 14, (n,))
+    return [np.concatenate([prefix,
+                            rng.integers(0, vocab, (t,)).astype(np.int32)])
+            for t in tails]
+
+
+def _refs(engine, prompts, news):
+    return [engine.generate(p[None], max_new_tokens=n, stop_on_eos=False)[0]
+            for p, n in zip(prompts, news)]
+
+
+def _busy_engine(engine, n_active=2, **over):
+    """A serving engine with `n_active` slots mid-generation (the state
+    corruption tests break and the auditor must read through)."""
+    rng = np.random.default_rng(20)
+    serving = _replica(engine, max_slots=max(2, n_active), **over)
+    for i in range(n_active):
+        p = rng.integers(0, TINY.vocab_size, (5 + 3 * i,)).astype(np.int32)
+        serving.submit(Request(uid=f"busy{i}", tokens=p, max_new_tokens=24,
+                               stop_on_eos=False))
+    for _ in range(3):
+        serving.step()
+    assert serving.num_active == n_active
+    return serving
+
+
+# ----------------------------------------------------------------------
+# PoolAuditor: one unit per invariant class, then the repair path
+# ----------------------------------------------------------------------
+
+
+def test_audit_clean_on_live_engine(engine):
+    serving = _busy_engine(engine)
+    report = serving.audit()
+    assert report.ok and report.checked_slots == 2
+    assert report.checked_blocks == serving.allocator.num_blocks
+    # drain, then the shutdown audit is clean too and flushes telemetry
+    while serving.num_active or serving.queue_depth:
+        serving.step()
+    assert serving.close().ok
+
+
+@pytest.mark.parametrize("kind,expect", [
+    ("leak", "leak"),
+    ("refcount_over", "refcount_drift"),
+    ("refcount_under", "refcount_drift"),
+    ("double_ref", "free_referenced"),
+    ("free_dup", "free_list_corrupt"),
+    ("stale_hash", "stale_hash"),
+])
+def test_audit_detects_each_corruption_class_and_repairs(engine, kind,
+                                                         expect):
+    """Each injected corruption is caught under its invariant class, and
+    `repair()` — rebuilding refcounts/free list/reclaimable from the slot
+    tables — reaches a clean state the re-audit confirms."""
+    serving = _busy_engine(engine)
+    rng = np.random.default_rng(7)
+    done = corrupt_pool(serving, kind, rng)
+    assert done is not None, f"{kind}: nothing to corrupt in a busy pool"
+    report = serving.audit()
+    assert not report.ok and expect in report.by_kind(), \
+        (kind, report.summary())
+    summary = serving._auditor.repair()
+    assert summary["clean"], (kind, summary)
+    assert serving.audit().ok
+    # repaired bookkeeping still serves: drain to completion, blocks home
+    while serving.num_active or serving.queue_depth:
+        serving.step()
+    alloc = serving.allocator
+    assert alloc.num_free + alloc.num_reclaimable == alloc.capacity
+
+
+def test_audit_trash_and_table_invariants(engine):
+    """The two invariant classes no corrupt_pool kind produces: trash-block
+    references and device-table drift (checked straight on the state dict,
+    the same path `bin/dstpu_audit` takes for offline dumps)."""
+    serving = _busy_engine(engine)
+    state = serving.audit_state()
+    state["refs"][str(TRASH_BLOCK)] = 1
+    rep = audit_state_dict(state)
+    assert "trash_referenced" in rep.by_kind()
+    state = serving.audit_state()
+    state["tables"][serving.slots[0].idx][0] = 99
+    rep = audit_state_dict(state)
+    assert "table_mismatch" in rep.by_kind()
+    assert serving.audit().ok            # the dict mutations never touched
+    serving.cancel("busy0"), serving.cancel("busy1")   # the live engine
+
+
+def test_audit_repair_with_prefix_cache_reclaimable(engine):
+    """Prefix-cache-enabled variant: retired shared blocks sit refcount-0
+    on the reclaimable LRU; corruption + repair must preserve the
+    hash<->block bijection AND keep those blocks matchable (a repair that
+    wiped the cache would silently cost every future hit)."""
+    rng = np.random.default_rng(21)
+    serving = _replica(engine, max_slots=2)
+    prompts = _shared_prefix_trace(rng, 3)
+    out = serving.run([Request(uid=i, tokens=p, max_new_tokens=3,
+                               stop_on_eos=False)
+                       for i, p in enumerate(prompts)])
+    assert sorted(out) == [0, 1, 2]
+    assert serving.allocator.num_reclaimable > 0
+    cached_before = serving.prefix_cache.num_cached
+    assert corrupt_pool(serving, "stale_hash", rng) is not None
+    assert corrupt_pool(serving, "leak", rng) is not None
+    rep = serving.audit()
+    assert {"stale_hash", "leak"} <= set(rep.by_kind())
+    assert serving._auditor.repair()["clean"]
+    # the real registered blocks survived the rebuild (the stale entry may
+    # be adopted as a parked cached block — documented repair policy: a
+    # wrong assumption there costs a future miss, never wrong tokens)
+    assert serving.prefix_cache.num_cached >= cached_before
+    # and a warm rerun still hits the cache
+    out2 = serving.run([Request(uid="warm", tokens=prompts[0],
+                                max_new_tokens=3, stop_on_eos=False)])
+    assert out2["warm"].cached_prefix_tokens > 0
+
+
+def test_scheduled_audit_repairs_midtrace_with_parity(engine):
+    """audit_interval=1 + audit_action="repair": corruption injected
+    between steps is caught and repaired by the NEXT sync's scheduled
+    audit while the trace keeps running — outputs stay greedy-identical
+    and the final pool is clean."""
+    rng = np.random.default_rng(22)
+    prompts = [rng.integers(0, TINY.vocab_size, (L,)).astype(np.int32)
+               for L in (5, 9, 21, 4)]
+    news = [6] * len(prompts)
+    serving = _replica(engine, audit_interval=1)
+    for i, p in enumerate(prompts):
+        serving.submit(Request(uid=i, tokens=p, max_new_tokens=6,
+                               stop_on_eos=False))
+    out, k = {}, 0
+    while serving.num_active or serving.queue_depth:
+        for d in serving.step():
+            out[d.uid] = d
+        if k % 3 == 1:            # corrupt every few syncs, SAFE kinds only,
+            corrupt_pool(serving,  # cycling through the kinds
+                         SAFE_CORRUPTIONS[(k // 3) % len(SAFE_CORRUPTIONS)],
+                         rng)
+        k += 1
+    stats = serving.stats()["audit"]
+    assert stats["runs"] >= k and stats["repairs"] >= 1
+    assert stats["violations"] > 0
+    for i, ref in enumerate(_refs(engine, prompts, news)):
+        np.testing.assert_array_equal(out[i].tokens, ref)
+    assert serving.audit().ok
+
+
+def test_audit_action_raise_surfaces_pool_corruption(engine):
+    serving = _busy_engine(engine, audit_interval=1, audit_action="raise")
+    corrupt_pool(serving, "leak", np.random.default_rng(3))
+    with pytest.raises(PoolCorruptionError, match="leak"):
+        for _ in range(2):
+            serving.step()
+    serving._auditor.repair()            # leave the shared pool clean
+
+
+def test_router_quarantines_replica_on_audit_raise(engine):
+    """audit_action="raise" converges on the PR 6 failover path: the
+    corrupted replica's PoolCorruptionError quarantines it, its work
+    re-routes, the trace completes exactly once with correct tokens."""
+    rng = np.random.default_rng(23)
+    prompts = _shared_prefix_trace(rng, 5)
+    news = [5] * len(prompts)
+    bad = InProcessReplica(_replica(engine, audit_interval=1,
+                                    audit_action="raise"), replica_id="bad")
+    good = InProcessReplica(_replica(engine), replica_id="good")
+    router = ServingRouter(replicas=[bad, good])
+    for i, p in enumerate(prompts):
+        router.submit(Request(uid=i, tokens=p, max_new_tokens=5,
+                              stop_on_eos=False))
+    res = {}
+    for _ in range(2):
+        for d in router.step():
+            res[d.uid] = d
+    corrupt_pool(bad.engine, "leak", rng)
+    while router.in_flight:
+        for d in router.step():
+            res[d.uid] = d
+    assert sorted(res) == list(range(len(prompts)))
+    assert router.stats()["replicas"]["bad"]["health"] == "dead"
+    assert router.counters["replica_failures"] == 1
+    for i, ref in enumerate(_refs(engine, prompts, news)):
+        np.testing.assert_array_equal(res[i].tokens, ref)
+    assert router.audit_pool() and all(r.ok for r
+                                       in router.audit_pool().values())
+
+
+# ----------------------------------------------------------------------
+# bin/dstpu_audit
+# ----------------------------------------------------------------------
+
+
+def test_dstpu_audit_cli(engine, tmp_path, capsys):
+    serving = _busy_engine(engine)
+    clean = tmp_path / "clean.json"
+    clean.write_text(json.dumps(serving.audit_state()))
+    assert audit_main([str(clean)]) == 0
+    assert "clean" in capsys.readouterr().out
+
+    corrupt_pool(serving, "double_ref", np.random.default_rng(5))
+    dirty = tmp_path / "dirty.json"
+    # a flight-dump-shaped doc: the finder locates the nested state
+    dirty.write_text(json.dumps(
+        {"reason": "test", "state": {"audit_state": serving.audit_state()}}))
+    assert audit_main([str(dirty)]) == 1
+    assert "free_referenced" in capsys.readouterr().out
+    assert audit_main([str(dirty), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["states"][0]["ok"] is False
+    assert doc["states"][0]["by_kind"]["free_referenced"] == 1
+
+    junk = tmp_path / "junk.json"
+    junk.write_text(json.dumps({"not": "an audit state"}))
+    assert audit_main([str(junk)]) == 2
+    serving._auditor.repair()            # leave the shared pool clean
+
+
+# ----------------------------------------------------------------------
+# hard deadlines (engine sweep + router pass-through)
+# ----------------------------------------------------------------------
+
+
+def test_engine_deadline_mid_generation(engine):
+    clock = ChaosClock()
+    serving = _replica(engine, enable_prefix_caching=False, clock=clock)
+    rng = np.random.default_rng(30)
+    p = rng.integers(0, TINY.vocab_size, (6,)).astype(np.int32)
+    serving.submit(Request(uid="free", tokens=p, max_new_tokens=10,
+                           stop_on_eos=False))
+    serving.submit(Request(uid="dl", tokens=p, max_new_tokens=10,
+                           stop_on_eos=False, deadline_ms=100.0))
+    done = {}
+    for _ in range(4):
+        for d in serving.step():
+            done[d.uid] = d
+    assert not done                      # both mid-generation, both alive
+    clock.advance(0.2)                   # past "dl"'s budget only
+    while serving.num_active or serving.queue_depth:
+        for d in serving.step():
+            done[d.uid] = d
+    assert done["dl"].finish_reason == "deadline"
+    ref = engine.generate(p[None], max_new_tokens=10, stop_on_eos=False)[0]
+    n = len(done["dl"].tokens)
+    assert 0 < n < 10                    # partial output kept...
+    np.testing.assert_array_equal(done["dl"].tokens, ref[:n])  # ...and right
+    assert done["free"].finish_reason == "length"
+    np.testing.assert_array_equal(done["free"].tokens, ref)
+    assert serving.stats()["deadline_cancelled"] == 1
+    assert serving.allocator.num_free == serving.allocator.capacity
+    assert serving.audit().ok
+
+
+def test_engine_deadline_expires_in_queue(engine):
+    clock = ChaosClock()
+    serving = _replica(engine, max_slots=1, enable_prefix_caching=False,
+                       clock=clock)
+    rng = np.random.default_rng(31)
+    p = rng.integers(0, TINY.vocab_size, (6,)).astype(np.int32)
+    serving.submit(Request(uid="hog", tokens=p, max_new_tokens=16,
+                           stop_on_eos=False))
+    serving.step()                       # "hog" takes the only slot
+    serving.submit(Request(uid="q", tokens=p, max_new_tokens=4,
+                           stop_on_eos=False, deadline_ms=50.0))
+    clock.advance(0.1)
+    done = {}
+    while serving.num_active or serving.queue_depth:
+        for d in serving.step():
+            done[d.uid] = d
+    assert done["q"].finish_reason == "deadline" and not len(done["q"].tokens)
+    assert done["hog"].finish_reason == "length"
+    assert serving.stats()["prefill_chunks"] == 1, \
+        "expired-in-queue request must never burn prefill compute"
+
+
+def test_router_deadline_survives_redispatch(engine):
+    """The absolute deadline anchors at router submit: a failover rerun
+    re-dispatches with the SAME deadline_at, so recovery never extends
+    the budget — the rerun retires reason="deadline" on the survivor."""
+    clock = ChaosClock()
+    r0 = InProcessReplica(_replica(engine, enable_prefix_caching=False),
+                          replica_id="r0")
+    r1 = InProcessReplica(_replica(engine, enable_prefix_caching=False),
+                          replica_id="r1")
+    router = ServingRouter(replicas=[r0, r1], clock=clock)
+    rng = np.random.default_rng(32)
+    p = rng.integers(0, TINY.vocab_size, (6,)).astype(np.int32)
+    router.submit(Request(uid="x", tokens=p, max_new_tokens=40,
+                          stop_on_eos=False, deadline_ms=1000.0))
+    res = {}
+    for _ in range(3):                   # dispatched + generating
+        for d in router.step():
+            res[d.uid] = d
+    victim = router._pending["x"].replica
+    clock.advance(0.9)                   # 90% of the budget burned
+    router.kill_replica(victim)
+    clock.advance(0.2)                   # rerun would have 1.1s elapsed
+    while router.in_flight:
+        for d in router.step():
+            res[d.uid] = d
+    assert res["x"].finish_reason == "deadline"
+    assert router.counters["completed"] == 1
+
+
+# ----------------------------------------------------------------------
+# hung-replica watchdog
+# ----------------------------------------------------------------------
+
+
+def test_watchdog_tolerates_slow_but_alive_replica(engine):
+    """Strikes accrue on over-deadline steps, but a replica whose health
+    probe answers keeps serving (slow != dead) — and completes with
+    correct tokens."""
+    clock = ChaosClock()
+    rng = np.random.default_rng(40)
+    prompts = [rng.integers(0, TINY.vocab_size, (L,)).astype(np.int32)
+               for L in (5, 9)]
+    slow = ChaosReplica(
+        InProcessReplica(_replica(engine, enable_prefix_caching=False),
+                         replica_id="slow"),
+        ChaosSchedule([ChaosEvent(s, "delay", 0.5) for s in range(40)]),
+        clock=clock)
+    router = ServingRouter(replicas=[slow], clock=clock,
+                           step_deadline_ms=100.0, step_strike_budget=2)
+    res = router.run([Request(uid=i, tokens=p, max_new_tokens=4,
+                              stop_on_eos=False)
+                      for i, p in enumerate(prompts)])
+    assert router.counters["watchdog_strikes"] >= 2
+    assert router.counters["watchdog_quarantines"] == 0
+    assert router.stats()["replicas"]["slow"]["health"] == "up"
+    for i, ref in enumerate(_refs(engine, prompts, [4, 4])):
+        np.testing.assert_array_equal(res[i].tokens, ref)
+
+
+def test_watchdog_quarantines_hung_replica_and_reroutes(engine):
+    """A replica that HANGS (no exception, no progress, failing probe)
+    converges on the same quarantine/drain/reroute path a crash takes:
+    every request still completes exactly once with correct tokens."""
+    clock = ChaosClock()
+    rng = np.random.default_rng(41)
+    prompts = [rng.integers(0, TINY.vocab_size, (L,)).astype(np.int32)
+               for L in (5, 9, 3, 12)]
+    news = [4] * len(prompts)
+    hung = ChaosReplica(
+        InProcessReplica(_replica(engine, enable_prefix_caching=False),
+                         replica_id="hung"),
+        ChaosSchedule([ChaosEvent(2, "hang", 0.5)]), clock=clock)
+    ok = InProcessReplica(_replica(engine, enable_prefix_caching=False),
+                          replica_id="ok")
+    router = ServingRouter(replicas=[hung, ok], clock=clock,
+                           step_deadline_ms=100.0, step_strike_budget=2)
+    res = {}
+    counts = {}
+    for i, p in enumerate(prompts):
+        router.submit(Request(uid=i, tokens=p, max_new_tokens=4,
+                              stop_on_eos=False))
+    while router.in_flight:
+        for d in router.step():
+            res[d.uid] = d
+            counts[d.uid] = counts.get(d.uid, 0) + 1
+    assert sorted(res) == list(range(len(prompts)))
+    assert all(c == 1 for c in counts.values())          # exactly once
+    assert router.counters["watchdog_quarantines"] == 1
+    assert router.counters["reroutes"] > 0
+    assert router.stats()["replicas"]["hung"]["health"] == "dead"
+    for i, ref in enumerate(_refs(engine, prompts, news)):
+        np.testing.assert_array_equal(res[i].tokens, ref)
+    reports = router.audit_pool()
+    assert list(reports) == ["ok"] and reports["ok"].ok
+
+
+# ----------------------------------------------------------------------
+# hedged dispatch
+# ----------------------------------------------------------------------
+
+
+def test_hedged_dispatch_first_completion_wins(engine):
+    """A dispatched request with no first token past hedge_after_ms gets a
+    speculative duplicate; the duplicate completes (the primary is hung),
+    the loser is cancelled, the completion arrives exactly once."""
+    clock = ChaosClock()
+    rng = np.random.default_rng(42)
+    p = rng.integers(0, TINY.vocab_size, (6,)).astype(np.int32)
+    hung = ChaosReplica(
+        InProcessReplica(_replica(engine, enable_prefix_caching=False),
+                         replica_id="hung"),
+        ChaosSchedule([ChaosEvent(0, "hang", 0.3)]), clock=clock)
+    ok = InProcessReplica(_replica(engine, enable_prefix_caching=False),
+                          replica_id="ok")
+    router = ServingRouter(replicas=[hung, ok], clock=clock,
+                           hedge_after_ms=200.0)
+    router.submit(Request(uid="x", tokens=p, max_new_tokens=4,
+                          stop_on_eos=False))
+    res, n_done = {}, 0
+    for d in router.step():                         # dispatch + first step
+        res[d.uid] = d
+        n_done += 1
+    assert router._pending["x"].replica == "hung"   # rotation picks first
+    while router.in_flight:
+        for d in router.step():
+            res[d.uid] = d
+            n_done += 1
+    assert n_done == 1 and res["x"].finish_reason == "length"
+    ref = engine.generate(p[None], max_new_tokens=4, stop_on_eos=False)[0]
+    np.testing.assert_array_equal(res["x"].tokens, ref)
+    assert router.counters["hedges"] == 1
+    assert router.counters["hedge_wins"] == 1
+    assert router.counters["completed"] == 1
+    # the loser's copy was withdrawn from the hung replica's queue
+    assert hung.engine.stats()["cancelled"] == 1
+    assert ok.engine.allocator.num_free == ok.engine.allocator.capacity
+
+
+def test_hedging_only_recovery_through_run(engine):
+    """Watchdog OFF, primary hangs before its first token: `run()` must
+    WAIT out the hedge window (the pool is waiting, not wedged — the old
+    no-progress check raised here) and complete via the duplicate."""
+    clock = ChaosClock(tick=0.001)       # ticking clock: the stall check
+    rng = np.random.default_rng(44)      # needs time to move between steps
+    p = rng.integers(0, TINY.vocab_size, (6,)).astype(np.int32)
+    hung = ChaosReplica(
+        InProcessReplica(_replica(engine, enable_prefix_caching=False),
+                         replica_id="hung"),
+        ChaosSchedule([ChaosEvent(0, "hang", 0.3)]), clock=clock)
+    ok = InProcessReplica(_replica(engine, enable_prefix_caching=False),
+                          replica_id="ok")
+    router = ServingRouter(replicas=[hung, ok], clock=clock,
+                           hedge_after_ms=200.0)
+    res = router.run([Request(uid="x", tokens=p, max_new_tokens=4,
+                              stop_on_eos=False)])
+    assert res["x"].finish_reason == "length"
+    ref = engine.generate(p[None], max_new_tokens=4, stop_on_eos=False)[0]
+    np.testing.assert_array_equal(res["x"].tokens, ref)
+    assert router.counters["hedges"] == 1
+    assert router.counters["hedge_wins"] == 1
+
+
+def test_hedge_not_fired_when_first_token_arrives(engine):
+    """A healthy primary that emits within the hedge window is never
+    double-dispatched."""
+    clock = ChaosClock(tick=0.001)
+    r0 = InProcessReplica(_replica(engine, enable_prefix_caching=False),
+                          replica_id="r0")
+    r1 = InProcessReplica(_replica(engine, enable_prefix_caching=False),
+                          replica_id="r1")
+    router = ServingRouter(replicas=[r0, r1], clock=clock,
+                           hedge_after_ms=10_000.0)
+    rng = np.random.default_rng(43)
+    p = rng.integers(0, TINY.vocab_size, (6,)).astype(np.int32)
+    res = router.run([Request(uid="x", tokens=p, max_new_tokens=6,
+                              stop_on_eos=False)])
+    assert router.counters["hedges"] == 0
+    assert res["x"].finish_reason == "length"
+
+
+# ----------------------------------------------------------------------
+# graceful-degradation ladder
+# ----------------------------------------------------------------------
+
+
+class _FakeAlloc:
+    def __init__(self):
+        self.capacity = 100
+        self.available = 100
+        self.flushed = 0
+
+    def flush_reclaimable(self, keep=0):
+        self.flushed += 1
+        return 0
+
+
+class _FakeEngine:
+    """Just enough engine for PressureController: signals are driven by
+    the test, actions are recorded."""
+
+    def __init__(self):
+        self.allocator = _FakeAlloc()
+        self.queue = []
+        self.degradation_sheds = 0
+        self.shed_calls = 0
+
+        class _Off:
+            enabled = False
+        self.telemetry = _Off()
+        self.flightrec = _Off()
+
+    def shed_queued_below_priority(self, pr):
+        self.shed_calls += 1
+        return []
+
+
+def test_pressure_ladder_hysteresis_no_flapping():
+    """The core control-law claims, on exactly-controlled signals: one
+    rung per pressured eval; the band between watermarks holds the level
+    AND resets the calm streak; de-escalation takes `hold_steps`
+    consecutive calm evals; a signal oscillating across one threshold
+    cannot flap the level."""
+    from deepspeed_tpu.inference.config import DegradationConfig
+    eng = _FakeEngine()
+    cfg = DegradationConfig(enabled=True, eval_interval=1, queue_high=10,
+                            queue_low=2, free_block_low=0.0,
+                            free_block_high=0.0, hold_steps=2)
+    pc = PressureController(eng, cfg)
+
+    eng.queue = [None] * 20              # pressured
+    for _ in range(3):
+        pc.update([])
+    assert pc.level == 3                 # one rung per eval, no jumps
+    assert pc.draft_cap == 1 and pc.spec_disabled and pc.force_window_1
+
+    eng.queue = [None] * 5               # inside the band: hold
+    for _ in range(5):
+        pc.update([])
+    assert pc.level == 3 and pc.deescalations == 0
+
+    eng.queue = []                       # calm: 2 evals per rung down
+    pc.update([])
+    assert pc.level == 3                 # one calm eval is not enough
+    pc.update([])
+    assert pc.level == 2
+    # oscillation across the low watermark: calm streak keeps resetting,
+    # so the level sits still instead of toggling
+    for _ in range(6):
+        eng.queue = [None] * 5           # band
+        pc.update([])
+        eng.queue = []                   # calm (streak restarts at 1)
+        pc.update([])
+    assert pc.level == 2 and pc.escalations == 3
+    eng.queue = []
+    for _ in range(6):
+        pc.update([])
+    assert pc.level == 0                 # full recovery
+    occ = pc.stats()["level_occupancy"]
+    assert occ["window_1"] > 0 and sum(occ.values()) == pc.evals
+
+
+def test_degradation_ladder_engages_and_recovers_under_pressure(engine,
+                                                                tmp_path):
+    """End-to-end on a real engine: sustained queue pressure walks the
+    ladder up (visible in the gauge, the flight recorder, and per-level
+    occupancy), low-priority queued work is shed at the top rung, and the
+    pool fully recovers to level 0 with no flapping."""
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    mesh_mod.init_mesh(MeshConfig(data=1, tensor=1, sequence=1, expert=1,
+                                  pipe=1))
+    spec = make_gpt_decode_model(cfg=TINY, name="tiny")
+    eng = init_inference(model=spec, config={
+        "dtype": "float32", "kv_cache_dtype": "float32", "greedy": True,
+        "kv_block_size": BS, "max_out_tokens": 64,
+        "telemetry": {"enabled": True, "output_path": str(tmp_path),
+                      "flight_recorder": True}})
+    serving = eng.serving(
+        max_slots=1, max_context=96, prefill_chunk=BS,
+        enable_prefix_caching=True,
+        degradation={"enabled": True, "eval_interval": 1, "queue_high": 4,
+                     "queue_low": 1, "free_block_low": 0.0,
+                     "free_block_high": 0.0, "hold_steps": 2,
+                     "shed_below_priority": 1})
+    rng = np.random.default_rng(50)
+    p = rng.integers(0, TINY.vocab_size, (6,)).astype(np.int32)
+    reqs = [Request(uid=i, tokens=p, max_new_tokens=8, stop_on_eos=False,
+                    priority=1) for i in range(10)]
+    reqs += [Request(uid=f"low{i}", tokens=p, max_new_tokens=8,
+                     stop_on_eos=False, priority=0) for i in range(2)]
+    for r in reqs:
+        serving.submit(r)
+    done, levels = {}, []
+    while serving.num_active or serving.queue_depth:
+        for d in serving.step():
+            done[d.uid] = d
+        levels.append(serving.pressure.level)
+    assert len(done) == len(reqs)                       # nothing lost
+    assert max(levels) == 5                             # reached the top
+    sheds = [u for u, d in done.items()
+             if d.finish_reason == "cancelled"]
+    assert sorted(sheds) == ["low0", "low1"], \
+        "exactly the droppable-priority queued requests were shed"
+    # no flapping: once recovery starts the level never rises again
+    peak = levels.index(max(levels))
+    tail = levels[peak:]
+    assert all(a >= b for a, b in zip(tail, tail[1:]))
+    assert levels[-1] == 0                              # full recovery
+    st = serving.stats()["degradation"]
+    assert st["level"] == 0 and st["sheds"] == 2
+    assert st["escalations"] >= 5 and st["deescalations"] >= 5
+    assert st["level_occupancy"]["shed"] >= 1
+    # visible: the gauge and the flight-recorder level-change events
+    snap = serving.telemetry.registry.snapshot()
+    assert "serving/degradation_level" in snap
+    degr = [e for e in serving.flightrec.events() if e["kind"] == "degrade"]
+    assert [e["level"] for e in degr][:5] == [1, 2, 3, 4, 5]
+    assert {e["name"] for e in degr} <= set(LEVEL_NAMES)
+    assert serving.audit().ok
+    serving.telemetry.close()
+
+
+def test_degradation_disabled_leaves_hot_path_untouched(engine):
+    """Disabled-by-default contract: no controller object, no stats block,
+    and compile_stats reports exactly the same programs as ever — the
+    degraded 1-step decode variant is never built."""
+    rng = np.random.default_rng(51)
+    serving = _replica(engine, enable_prefix_caching=False,
+                       decode_steps_per_sync=4)
+    assert serving.pressure is None
+    p = rng.integers(0, TINY.vocab_size, (6,)).astype(np.int32)
+    out = serving.run([Request(uid="x", tokens=p, max_new_tokens=8,
+                               stop_on_eos=False)])
+    ref = engine.generate(p[None], max_new_tokens=8, stop_on_eos=False)[0]
+    np.testing.assert_array_equal(out["x"].tokens, ref)
+    cs = serving.compile_stats()
+    assert set(cs) == {"prefill_step", "decode_step"} and \
+        "decode_step_w1" not in cs
+    assert "degradation" not in serving.stats()
+
+
+# ----------------------------------------------------------------------
+# satellite: cancelling a parked handoff releases blocks on BOTH pools
+# ----------------------------------------------------------------------
+
+
+def test_cancel_parked_handoff_releases_source_blocks(engine):
+    """Regression: a slot parked in _HANDOFF holds exported blocks while
+    waiting for a decode replica. cancel(queued_only=True) — the router's
+    TTL mode — must treat it as cancellable and free them; skipping it
+    (the old behavior) leaked the blocks for as long as the handoff
+    stayed deferred."""
+    serving = _replica(engine, enable_prefix_caching=False)
+    rng = np.random.default_rng(60)
+    p = rng.integers(0, TINY.vocab_size, (6,)).astype(np.int32)
+    serving.submit(Request(uid="h", tokens=p, max_new_tokens=8,
+                           stop_on_eos=False), prefill_only=True)
+    while not serving.handoff_ready():
+        serving.step()
+    done = serving.cancel("h", queued_only=True)
+    assert done is not None and done.finish_reason == "cancelled"
+    assert len(done.tokens) == 1          # the first sampled token survives
+    assert serving.allocator.num_free == serving.allocator.capacity, \
+        "cancelled handoff leaked its exported blocks"
+    assert serving.audit().ok
+
+
+def test_router_ttl_cancels_parked_handoff_both_pools_clean(engine):
+    """Router-level: TTL fires on a request parked for handoff behind a
+    full decode replica — the source pool frees its blocks, the decode
+    pool never allocates any, and both audits come back clean."""
+    t = ChaosClock()
+    pre = InProcessReplica(_replica(engine, enable_prefix_caching=False),
+                           replica_id="pre")
+    dec = InProcessReplica(_replica(engine, max_slots=1, num_kv_blocks=7,
+                                    enable_prefix_caching=False),
+                           replica_id="dec")
+    router = ServingRouter(default_ttl_s=5.0, clock=t)
+    router.add_replica(pre, role="prefill")
+    router.add_replica(dec, role="decode")
+    rng = np.random.default_rng(61)
+    p = rng.integers(0, TINY.vocab_size, (6,)).astype(np.int32)
+    # "hog" fills the decode replica (slots AND most blocks) first; then
+    # "parked" prefills and has nowhere to go
+    router.submit(Request(uid="hog", tokens=p, max_new_tokens=24,
+                          stop_on_eos=False))
+    res = {}
+    while not dec.num_active:
+        for d in router.step():
+            res[d.uid] = d
+    router.submit(Request(uid="parked", tokens=p, max_new_tokens=24,
+                          stop_on_eos=False))
+    for _ in range(4):
+        for d in router.step():
+            res[d.uid] = d
+    assert pre.engine.handoff_ready() == ["parked"]
+    t.advance(6.0)                        # TTL fires; "hog" keeps its slot
+    while router.in_flight:
+        for d in router.step():
+            res[d.uid] = d
+    assert res["parked"].finish_reason == "cancelled"
+    assert res["hog"].finish_reason == "length"
+    assert router.counters["ttl_cancelled"] == 1
+    for rep in (pre, dec):
+        alloc = rep.engine.allocator
+        assert alloc.num_free == alloc.capacity, rep.replica_id
+    assert all(r.ok for r in router.audit_pool().values())
+
+
+# ----------------------------------------------------------------------
+# satellite: one injected clock drives the whole pool
+# ----------------------------------------------------------------------
+
+
+def test_unified_clock_reaches_every_replica_and_survives_restart(engine):
+    clock = ChaosClock()
+
+    def factory():
+        return _replica(engine, enable_prefix_caching=False)
+
+    router = ServingRouter(clock=clock, restart_backoff_s=0.0)
+    router.add_replica(InProcessReplica(factory=factory, replica_id="r0"))
+    router.add_replica(InProcessReplica(_replica(engine), replica_id="r1"))
+    for rep in router.replicas.values():
+        assert rep.engine._clock is clock
+    router.kill_replica("r0")
+    router.step()                         # backoff 0: rebuilt immediately
+    assert router.stats()["replicas"]["r0"]["health"] == "up"
+    assert router.replicas["r0"].engine._clock is clock, \
+        "a rebuilt replica must re-join the pool clock"
+
+
+# ----------------------------------------------------------------------
+# the chaos soak
+# ----------------------------------------------------------------------
+
+
+def test_chaos_soak_exactly_once_parity_clean_audit(engine):
+    """THE acceptance test: a ragged trace over three replicas under a
+    deterministic schedule of a crash (restart-backed), a permanent hang
+    (watchdog quarantine), slow steps, and repeated safe pool corruptions
+    (scheduled audit repairs). Every request completes exactly once, every
+    output is greedy-identical to the no-chaos single-engine reference,
+    and the final audit over every surviving replica is clean."""
+    clock = ChaosClock()
+    rng = np.random.default_rng(70)
+    prompts = _shared_prefix_trace(rng, 10)
+    news = [3 + i % 4 for i in range(len(prompts))]
+    refs = _refs(engine, prompts, news)
+
+    def factory():
+        return _replica(engine, audit_interval=1)
+
+    crashy = ChaosReplica(
+        InProcessReplica(factory=factory, replica_id="crashy"),
+        ChaosSchedule.seeded(70, 40, delay_rate=0.2, delay_s=0.3,
+                             crash_at=(4,)),
+        clock=clock, seed=700)
+    hangy = ChaosReplica(
+        InProcessReplica(_replica(engine, audit_interval=1),
+                         replica_id="hangy"),
+        ChaosSchedule.seeded(71, 40, hang_at=7, hang_s=0.4),
+        clock=clock, seed=701)
+    dirty = ChaosReplica(
+        InProcessReplica(_replica(engine, audit_interval=1),
+                         replica_id="dirty"),
+        ChaosSchedule.seeded(72, 40, corrupt_rate=0.5,
+                             corruptions=SAFE_CORRUPTIONS),
+        clock=clock, seed=702)
+    router = ServingRouter(replicas=[crashy, hangy, dirty], clock=clock,
+                           step_deadline_ms=150.0, step_strike_budget=2,
+                           restart_backoff_s=0.0, max_replica_restarts=2)
+    for i, p in enumerate(prompts):
+        router.submit(Request(uid=i, tokens=p, max_new_tokens=news[i],
+                              stop_on_eos=False))
+    res, counts = {}, {}
+    while router.in_flight:
+        for d in router.step():
+            res[d.uid] = d
+            counts[d.uid] = counts.get(d.uid, 0) + 1
+
+    # the chaos actually happened (else this test proves nothing)
+    sched = {r.replica_id: r.injected for r in (crashy, hangy, dirty)}
+    assert any(k == "crash" for _, k, _ in sched["crashy"]), sched
+    assert any(k == "hang" for _, k, _ in sched["hangy"]), sched
+    assert sum(k == "corrupt" for _, k, _ in sched["dirty"]) >= 3, sched
+    assert router.counters["replica_failures"] >= 2
+    assert router.counters["watchdog_quarantines"] >= 1
+    assert router.counters["reroutes"] > 0
+
+    # exactly once, nothing lost, nothing duplicated
+    assert sorted(res) == list(range(len(prompts)))
+    assert all(c == 1 for c in counts.values())
+    assert router.counters["completed"] == len(prompts)
+    # greedy parity for every completion (failover reruns are greedy too)
+    for i, ref in enumerate(refs):
+        np.testing.assert_array_equal(res[i].tokens, ref, err_msg=f"uid={i}")
+    # corruption was caught and repaired along the way...
+    audit_stats = dirty.engine.stats().get("audit", {})
+    assert audit_stats.get("repairs", 0) >= 1, audit_stats
+    # ...and the final pass over every surviving replica is clean
+    final = router.audit_pool(repair=True)
+    assert final and all(r is not None for r in final.values())
+    clean = router.audit_pool()
+    assert clean and all(r.ok for r in clean.values()), \
+        {rid: r.summary() for rid, r in clean.items()}
+
+
+def test_chaos_schedule_is_deterministic():
+    a = ChaosSchedule.seeded(9, 30, delay_rate=0.3, delay_s=0.1,
+                             corrupt_rate=0.3, crash_at=(3,), hang_at=5)
+    b = ChaosSchedule.seeded(9, 30, delay_rate=0.3, delay_s=0.1,
+                             corrupt_rate=0.3, crash_at=(3,), hang_at=5)
+    assert repr(a) == repr(b)
+    assert repr(a) != repr(ChaosSchedule.seeded(10, 30, delay_rate=0.3,
+                                                delay_s=0.1))
