@@ -203,9 +203,14 @@ def run_lane(model_name, batch, seq, gas, zero_stage, *, steps, warmup=3,
     # histogram + the engine's own achieved-MFU gauge ride into extra. The
     # analytic 6N numerator (measure_program_flops=False) avoids paying a
     # second full XLA compile of the train step just to read its flops.
+    # memscope rides along registry-only (programs off: the AOT
+    # memory_analysis pass would pay a second full train-step compile just
+    # to read temp bytes) — extra.memory gives future offload/quantized-KV
+    # PRs a byte baseline to beat
     ds_cfg["telemetry"] = {"enabled": True, "prometheus": False,
                            "jsonl": False, "monitor_bridge": False,
-                           "measure_program_flops": False}
+                           "measure_program_flops": False,
+                           "memscope": True, "memscope_programs": False}
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_cfg)
 
     rng = np.random.default_rng(0)
@@ -274,6 +279,9 @@ def run_lane(model_name, batch, seq, gas, zero_stage, *, steps, warmup=3,
             # uses the per-chip generation peak; step-time percentiles come
             # from the train/step_time_ms histogram over warmup+timed steps)
             "telemetry": _train_telemetry_extra(engine),
+            # HBM ledger snapshot (params/master/opt attribution + device
+            # watermarks where the runtime exposes them)
+            "memory": _memory_extra(engine),
         },
     }
     del engine, model
@@ -290,6 +298,16 @@ def _train_telemetry_extra(engine):
         out["step_time_p50_ms"] = round(st["p50"], 2)
         out["step_time_p99_ms"] = round(st["p99"], 2)
     return out
+
+
+def _memory_extra(owner):
+    """extra.memory for a bench lane: the owner's memscope ledger snapshot
+    (numeric fields only). {} when the lane runs without memscope."""
+    ms = getattr(owner, "memscope", None)
+    if ms is None:
+        return {}
+    return {k: v for k, v in ms.snapshot().items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)}
 
 
 def _latency_extra(serving):
@@ -457,9 +475,12 @@ def run_serving_lane(steps=1, warmup=1):
         "dtype": "bfloat16", "kv_cache_dtype": "bfloat16", "greedy": True,
         "kv_block_size": 128, "max_out_tokens": 1024,
         # registry-only telemetry: TTFT/TPOT/queue-wait histograms for the
-        # extra block, no exporter files from a bench run
+        # extra block, no exporter files from a bench run; memscope (pool/
+        # params byte ledger, programs off — no AOT recompile) feeds
+        # extra.memory so quantized-KV/offload PRs get a baseline
         "telemetry": {"enabled": True, "prometheus": False, "jsonl": False,
-                      "monitor_bridge": False}})
+                      "monitor_bridge": False,
+                      "memscope": True, "memscope_programs": False}})
     rng = np.random.default_rng(0)
     prompts, news = _serving_trace(rng, n_req, cfg.vocab_size)
     reqs = [Request(uid=i, tokens=p, max_new_tokens=n, stop_on_eos=False)
@@ -523,6 +544,9 @@ def run_serving_lane(steps=1, warmup=1):
             "scheduler": {k: v for k, v in serving.stats().items()
                           if k in ("decode_steps", "prefill_chunks",
                                    "peak_active")},
+            # HBM ledger: pool vs params bytes — the baseline trajectory
+            # the quantized-KV roadmap item has to beat
+            "memory": _memory_extra(serving),
         },
     }
     print(json.dumps(result))

@@ -270,6 +270,27 @@ class TelemetryConfig(ConfigModel):
                                      # on replica failure / sentinel trip /
                                      # dump signal
     flight_recorder_events: int = 256  # ring capacity (last-N events kept)
+    memscope: bool = False           # HBM memory ledger + OOM forensics
+                                     # (telemetry/memscope.py): per-subsystem
+                                     # mem/* byte-attribution gauges, a pre-
+                                     # flight capacity check at engine build,
+                                     # and a ledger+planner+flight dump on
+                                     # RESOURCE_EXHAUSTED at the dispatch
+                                     # boundaries
+    memscope_programs: bool = True   # ledger includes per-program temp/arg
+                                     # bytes from XLA memory_analysis() of
+                                     # the persistent jitted programs — one
+                                     # extra AOT compile per program, lazily
+                                     # at first export (the jit CALL caches,
+                                     # and so compile_stats(), are untouched)
+    memscope_capacity_bytes: int = 0  # per-device HBM capacity override for
+                                     # headroom/preflight math; 0 = read
+                                     # device.memory_stats()["bytes_limit"]
+                                     # (absent on the CPU harness)
+    memscope_preflight: str = "warn"  # capacity-planner verdict at engine
+                                     # build: "off" | "warn" | "refuse"
+                                     # (refuse raises PredictedOOMError
+                                     # before anything compiles)
 
 
 @dataclass

@@ -28,6 +28,24 @@ def main(args=None):
         stats = acc.memory_stats()
         if stats.get("bytes_limit"):
             print(f"HBM per device .......... {stats['bytes_limit']/2**30:.1f} GiB")
+        # per-device memory at a glance (capacity / in-use / peak) — the
+        # CPU harness exposes no allocator stats, so say so instead of 0s
+        from deepspeed_tpu.telemetry.memscope import fmt_bytes
+        for i, d in enumerate(devs[:8]):
+            try:
+                s = d.memory_stats() or {}
+            except Exception:
+                s = {}
+            label = f"  dev{i} HBM "
+            if s.get("bytes_limit") or s.get("bytes_in_use"):
+                print(f"{label:<26}"
+                      f"in-use {fmt_bytes(s.get('bytes_in_use', 0))} | "
+                      f"peak {fmt_bytes(s.get('peak_bytes_in_use', 0))} | "
+                      f"limit {fmt_bytes(s.get('bytes_limit', 0))}")
+            else:
+                print(f"{label:<26}allocator stats unavailable")
+        if len(devs) > 8:
+            print(f"  ... ({len(devs) - 8} more devices)")
         print(f"comm backend ............ {acc.communication_backend_name()}")
     except Exception as e:
         print(f"jax devices ............. unavailable ({e})")

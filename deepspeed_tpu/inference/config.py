@@ -88,6 +88,13 @@ class DegradationConfig(ConfigModel):
     hold_steps: int = 3           # consecutive calm evals per de-escalation
     shed_below_priority: int = 0  # level 5 sheds queued requests with
                                   # Request.priority strictly below this
+    headroom_low: float = 0.0     # mem/headroom_frac (telemetry/memscope.py
+                                  # ledger) below this => pressure (0 = off;
+                                  # needs telemetry.memscope + a known HBM
+                                  # capacity — the signal is omitted when
+                                  # either is missing)
+    headroom_high: float = 0.0    # ...and at/above this counts as calm
+                                  # (clamped up to headroom_low)
 
 
 @dataclass

@@ -228,6 +228,32 @@ class ServingEngine:
         self.flightrec = self.telemetry.flightrec
         self.trace_tid = 0
 
+        # memscope pre-flight runs BEFORE the pool device_put below: the
+        # plan is pure shape arithmetic (jax.eval_shape over
+        # init_paged_pool — no device memory touched), so a predicted-OOM
+        # config can warn or refuse ahead of the allocation that would
+        # otherwise crash a real chip with a raw RESOURCE_EXHAUSTED
+        tcfg = getattr(engine.config, "telemetry", None)
+        self._memscope_on = self.telemetry.enabled and \
+            getattr(tcfg, "memscope", False)
+        self._preflight_plan = None
+        if self._memscope_on:
+            from deepspeed_tpu.telemetry import memscope as _ms
+            mode = str(getattr(tcfg, "memscope_preflight", "warn"))
+            if mode != "off":
+                cap = int(getattr(tcfg, "memscope_capacity_bytes", 0) or 0) \
+                    or int(_ms.device_memory_stats().get("bytes_limit", 0)
+                           or 0)
+                plan = _ms.plan_serving_prealloc(
+                    spec, num_kv_blocks=num_blocks, kv_block_size=bs,
+                    kv_cache_dtype=engine.config.kv_cache_dtype,
+                    params=engine.params,
+                    draft_spec=draft_spec
+                    if scfg.spec_decode.drafter == "model" else None,
+                    param_dtype=engine.dtype, capacity_bytes=cap)
+                self._preflight_plan = _ms.preflight_check(
+                    plan, refuse=(mode == "refuse"))
+
         # place the pool with the engine mesh's (replicated) NamedSharding up
         # front: the step programs RETURN pools with exactly this sharding,
         # so a plain uncommitted jnp.zeros pool would give the very first
@@ -265,6 +291,19 @@ class ServingEngine:
         self.drafter = make_drafter(self, scfg.spec_decode,
                                     draft_spec=draft_spec) \
             if self.spec_on else None
+
+        # HBM memory ledger + OOM forensics (telemetry/memscope.py):
+        # per-subsystem byte attribution as mem/* gauges plus the
+        # ledger+planner+flight dump on RESOURCE_EXHAUSTED in step().
+        # Built AFTER the drafter so the draft mirror is on the ledger;
+        # the capacity verdict already ran pre-allocation above (its plan
+        # becomes last_plan — the OOM dump's "was this foreseeable" base);
+        # disabled default = no object, no gauges, untouched compile_stats
+        self.memscope = None
+        if self._memscope_on:
+            from deepspeed_tpu.telemetry.memscope import ServingMemScope
+            self.memscope = ServingMemScope(self)
+            self.memscope.last_plan = self._preflight_plan
 
         # self-healing: pool invariant auditor (inference/audit.py) — pure
         # host-side reads, run every `audit_interval` syncs / on demand /
@@ -1170,7 +1209,20 @@ class ServingEngine:
     # ------------------------------------------------------------------
 
     def step(self) -> List[CompletedRequest]:
-        """One scheduler iteration. Returns the requests that finished."""
+        """One scheduler iteration. Returns the requests that finished.
+
+        The try/except is the OOM-forensics dispatch boundary: a
+        RESOURCE_EXHAUSTED escaping the compiled calls dumps the memory
+        ledger + planner delta + flight-recorder ring (memscope enabled)
+        before re-raising — the error itself is never swallowed."""
+        try:
+            return self._step_impl()
+        except Exception as e:
+            if self.memscope is not None:
+                self.memscope.on_step_error(e)
+            raise
+
+    def _step_impl(self) -> List[CompletedRequest]:
         finished: List[CompletedRequest] = []
         self.steps += 1
         params = self.engine.params
@@ -1309,6 +1361,10 @@ class ServingEngine:
             self.telemetry.set_gauge("serving/active_slots", self.num_active)
             self.telemetry.set_gauge("serving/free_blocks",
                                      self.allocator.available)
+            if self.memscope is not None:
+                # mem/* ledger gauges; the first publish also runs the lazy
+                # per-program memory_analysis pass (AOT — no jit-cache hit)
+                self.memscope.publish()
             self.telemetry.maybe_export(self.steps)
 
         return finished
@@ -1402,6 +1458,8 @@ class ServingEngine:
                 "prefill_chunks_skipped": self.prefill_chunks_skipped,
                 "cached_blocks": self.prefix_cache.num_cached,
                 "evictions": self.allocator.evictions}
+        if self.memscope is not None:
+            out["memory"] = self.memscope.snapshot()
         if self.telemetry.enabled:
             out["latency"] = self.latency_snapshot()
             # compile watchdog: ONE warmup compile per program is the

@@ -110,6 +110,14 @@ class PressureController:
             p99 = eng.latency_snapshot().get("ttft_ms", {}).get("p99")
             if p99 is not None:
                 out["ttft_p99_ms"] = float(p99)
+        # optional HBM-headroom signal from the memscope ledger (needs
+        # telemetry.memscope AND a known capacity; omitted otherwise so
+        # the ladder falls back to its pool/queue/TTFT signals)
+        ms = getattr(eng, "memscope", None)
+        if self.config.headroom_low > 0 and ms is not None:
+            hf = ms.headroom_frac()
+            if hf is not None:
+                out["headroom_frac"] = float(hf)
         return out
 
     def _classify(self, sig) -> str:
@@ -118,13 +126,18 @@ class PressureController:
         the hysteresis band — neither escalate nor count toward
         de-escalation)."""
         cfg = self.config
+        # headroom hysteresis band mirrors the others (absent signal reads
+        # as fully calm: sig only carries it when the ledger can compute it)
+        hr_high = max(cfg.headroom_high, cfg.headroom_low)
         if (sig["free_frac"] < cfg.free_block_low
                 or sig["queue"] > cfg.queue_high
-                or sig.get("ttft_p99_ms", 0.0) > cfg.ttft_p99_ms > 0):
+                or sig.get("ttft_p99_ms", 0.0) > cfg.ttft_p99_ms > 0
+                or sig.get("headroom_frac", 1.0) < cfg.headroom_low):
             return "pressured"
         if (sig["free_frac"] >= cfg.free_block_high
                 and sig["queue"] <= cfg.queue_low
-                and not sig.get("ttft_p99_ms", 0.0) > cfg.ttft_p99_ms > 0):
+                and not sig.get("ttft_p99_ms", 0.0) > cfg.ttft_p99_ms > 0
+                and sig.get("headroom_frac", 1.0) >= hr_high):
             return "calm"
         return "hold"
 

@@ -142,6 +142,12 @@ class ReplicaHandle:
         `bin/dstpu_audit` consumes), or None for a remote backend."""
         return None
 
+    def memory_snapshot(self) -> Optional[Dict[str, Any]]:
+        """The replica's HBM ledger (telemetry/memscope.py snapshot), or
+        None when the engine runs without `telemetry.memscope` — the
+        router aggregates these into pool-level `mem/*` gauges."""
+        return None
+
     def stats(self) -> Dict[str, Any]:
         raise NotImplementedError
 
@@ -189,6 +195,10 @@ class InProcessReplica(ReplicaHandle):
 
     def set_clock(self, clock):
         self.engine.set_clock(clock)
+
+    def memory_snapshot(self):
+        ms = getattr(self.engine, "memscope", None)
+        return ms.snapshot() if ms is not None else None
 
     def cancel(self, uid, queued_only=False):
         return self.engine.cancel(uid, queued_only=queued_only)

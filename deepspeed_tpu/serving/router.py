@@ -946,6 +946,17 @@ class ServingRouter:
             self.telemetry.set_gauge("router/in_flight", len(self._pending))
             self.telemetry.set_gauge("router/live_replicas",
                                      len(self._healthy()))
+            mem = self.memory_snapshot()
+            if mem:
+                # pool-aggregate memory ledger (replicas run with
+                # telemetry.memscope): total attributed HBM across live
+                # replicas, and the TIGHTEST per-replica headroom — the
+                # pool is as close to OOM as its fullest member
+                self.telemetry.set_gauge("mem/pool_attributed_bytes",
+                                         mem["attributed_bytes"])
+                if mem.get("headroom_frac") is not None:
+                    self.telemetry.set_gauge("mem/pool_headroom_frac",
+                                             mem["headroom_frac"])
             self.telemetry.maybe_export(self.steps)
         return finished
 
@@ -1047,6 +1058,44 @@ class ServingRouter:
                 "p50": self._percentile(v, 0.50),
                 "p99": self._percentile(v, 0.99)}
 
+    def memory_snapshot(self) -> Dict[str, Any]:
+        """Aggregate the live replicas' HBM ledgers (memscope snapshots):
+        replica-owned byte categories (params, pools, temps) summed across
+        the pool, headroom as the MINIMUM per-replica fraction (the
+        binding constraint), and allocator-global watermarks
+        (bytes_in_use/peak/capacity/unattributed) as the MAX — in-process
+        replicas all read the same device allocator, so summing those
+        would multiply one device by the replica count. Per-replica
+        detail under "replicas". {} when no replica runs with
+        `telemetry.memscope`."""
+        per: Dict[str, Dict[str, Any]] = {}
+        for rid, rep in self.replicas.items():
+            if rid in self._dead or rid in self._quarantined:
+                continue
+            try:
+                snap = rep.memory_snapshot()
+            except Exception:
+                snap = None
+            if snap:
+                per[rid] = snap
+        if not per:
+            return {}
+        device_global = {"bytes_in_use", "peak_bytes", "capacity_bytes",
+                         "unattributed_bytes"}
+        out: Dict[str, Any] = {"replicas": per}
+        for snap in per.values():
+            for k, v in snap.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                if k == "headroom_frac":
+                    cur = out.get(k)
+                    out[k] = v if cur is None else min(cur, v)
+                elif k in device_global:
+                    out[k] = max(out.get(k, 0), v)
+                else:
+                    out[k] = out.get(k, 0) + v
+        return out
+
     def stats(self) -> Dict[str, Any]:
         """RouterStats: routing-decision counters, queue depth, and a
         per-replica block (role/health/load/TTFT + the engine's own
@@ -1063,11 +1112,15 @@ class ServingRouter:
                              available_blocks=rep.available_blocks,
                              engine=rep.stats())
             reps[rid] = entry
-        return {"steps": self.steps, "queue_depth": len(self.queue),
-                "in_flight": len(self._pending),
-                "counters": dict(self.counters),
-                "disaggregated": self.disaggregated,
-                "replicas": reps}
+        out = {"steps": self.steps, "queue_depth": len(self.queue),
+               "in_flight": len(self._pending),
+               "counters": dict(self.counters),
+               "disaggregated": self.disaggregated,
+               "replicas": reps}
+        mem = self.memory_snapshot()
+        if mem:
+            out["memory"] = mem
+        return out
 
     def audit_pool(self, repair: bool = False) -> Dict[str, Any]:
         """Run the KV-pool invariant auditor on every LIVE replica (the

@@ -48,11 +48,19 @@ from deepspeed_tpu.telemetry.tracing import (NULL_TRACER, TraceContext,
 from deepspeed_tpu.telemetry.flight_recorder import (NULL_RECORDER,
                                                      CompileWatchdog,
                                                      FlightRecorder)
+from deepspeed_tpu.telemetry.memscope import (MemoryPlan, PredictedOOMError,
+                                              ServingMemScope, TrainMemScope,
+                                              fmt_bytes, max_kv_blocks,
+                                              plan_serving, plan_training,
+                                              tree_bytes)
 
 __all__ = ["Telemetry", "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "PrometheusFileExporter", "JsonlExporter", "MonitorBridge",
            "prometheus_text", "ChromeTraceSink", "Span", "Tracer",
-           "TraceContext", "FlightRecorder", "CompileWatchdog"]
+           "TraceContext", "FlightRecorder", "CompileWatchdog",
+           "MemoryPlan", "PredictedOOMError", "ServingMemScope",
+           "TrainMemScope", "plan_training", "plan_serving", "max_kv_blocks",
+           "fmt_bytes", "tree_bytes"]
 
 _NULL_SPAN = contextlib.nullcontext()
 

@@ -86,7 +86,16 @@ def render(record, prev=None):
         else:
             rate = counter_rate(name, record, prev) \
                 if m.get("type") == "counter" else None
-            rows.append((name, m.get("type", "?"), f"{m.get('value', 0):g}",
+            val = m.get("value", 0)
+            if "bytes" in name.replace("/", "_").split("_"):
+                # byte-valued gauges/counters (the memscope ledger, HBM
+                # watermarks) render human-readably in the table; --json
+                # keeps the raw integer untouched
+                from deepspeed_tpu.telemetry.memscope import fmt_bytes
+                shown = fmt_bytes(val)
+            else:
+                shown = f"{val:g}"
+            rows.append((name, m.get("type", "?"), shown,
                          "" if rate is None else f"{rate:.3g}/s",
                          "", "", "", ""))
     widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]

@@ -2,6 +2,10 @@
 
 Analog of the reference's `see_memory_usage` (sprinkled through engine/ZeRO). On TPU we
 read per-device HBM stats from `device.memory_stats()` plus host RSS from /proc.
+When handed a `Telemetry` object the reading also lands in the metrics registry
+(`mem/bytes_in_use` / `mem/peak_bytes`), so scraping dashboards see the same
+numbers the log line prints; the full byte-attribution ledger lives in
+`deepspeed_tpu/telemetry/memscope.py`.
 """
 
 import os
@@ -10,8 +14,13 @@ from deepspeed_tpu.utils.logging import logger
 
 
 def _host_rss_gb():
+    """Host resident-set size in GiB, from procfs. Platforms without /proc
+    (macOS, some sandboxes) report 0.0 — never a crash."""
+    path = f"/proc/{os.getpid()}/status"
+    if not os.path.exists(path):
+        return 0.0
     try:
-        with open(f"/proc/{os.getpid()}/status") as f:
+        with open(path) as f:
             for line in f:
                 if line.startswith("VmRSS"):
                     return int(line.split()[1]) / (1024**2)
@@ -36,14 +45,21 @@ def device_memory_stats(device=None):
     return stats
 
 
-def see_memory_usage(message, force=False, ranks=None):
-    """Log device HBM + host RSS. `force` gate mirrors the reference's signature."""
+def see_memory_usage(message, force=False, ranks=None, telemetry=None):
+    """Log device HBM + host RSS. `force` gate mirrors the reference's
+    signature. With `telemetry` (an enabled `Telemetry`), the same reading
+    sets the `mem/bytes_in_use` / `mem/peak_bytes` gauges — the call sites
+    sprinkled through the engine become scrape points, not just log lines."""
     if not force:
         return
     import jax
     if ranks is not None and jax.process_index() not in ranks:
         return
     stats = device_memory_stats()
+    if telemetry is not None and getattr(telemetry, "enabled", False):
+        telemetry.set_gauge("mem/bytes_in_use", stats.get("bytes_in_use", 0))
+        telemetry.set_gauge("mem/peak_bytes",
+                            stats.get("peak_bytes_in_use", 0))
     gb = 1024**3
     logger.info(
         f"{message} | HBM in use: {stats.get('bytes_in_use', 0)/gb:.2f} GB | "

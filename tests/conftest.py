@@ -84,6 +84,13 @@ def pytest_configure(config):
                    "detection, dstpu_trace) — fast and CPU-harness-safe, "
                    "rides in tier-1; run it alone with pytest -m tracing)")
     config.addinivalue_line(
+        "markers", "memscope: HBM memory observability suite "
+                   "(tests/test_memscope.py — byte-attribution ledger, "
+                   "pre-flight capacity planner vs XLA memory_analysis, "
+                   "OOM forensics dumps, dstpu_memscope CLI) — fast and "
+                   "CPU-harness-safe, rides in tier-1; run it alone with "
+                   "pytest -m memscope)")
+    config.addinivalue_line(
         "markers", "chaos: self-healing serving pool suite "
                    "(tests/test_selfheal.py — KV-pool invariant auditor + "
                    "repair, hung-replica watchdog, hard deadlines, hedged "
