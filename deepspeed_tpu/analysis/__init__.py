@@ -1,0 +1,34 @@
+"""`deepspeed_tpu.analysis` — the `dstpu_lint` static-analysis subsystem.
+
+A stdlib-`ast` rule framework that mechanically enforces the invariants
+the serving/training stack runs on (see docs/static_analysis.md):
+
+====== ===================== ==========================================
+DT001  host-sync-in-hot-path no `.item()` / `jax.device_get` /
+                             `block_until_ready` / `np.asarray`-on-
+                             device-values in the dispatch paths
+DT002  clock-injection       serving-tier time flows through the
+                             injectable clock the chaos harness swaps
+DT003  donation-safety       a donated buffer is never read again
+                             before being rebound
+DT004  recompile-hazard      `jax.jit` is constructed once per program
+                             lifetime, not per step/loop iteration
+DT005  metric-catalog        docs/profiling.md and the recording sites
+                             agree (one implementation, shared with
+                             tests/test_telemetry.py)
+====== ===================== ==========================================
+
+DT000 is reserved for the framework itself (pragma hygiene, unparsable
+files). Suppress a finding with `# dstpu: ignore[DTnnn]: reason` (the
+reason is mandatory); grandfathered findings live in the shrink-only
+`lint_baseline.json`. CLI: `bin/dstpu_lint` (`--json`, `--baseline`,
+`--rules`); the tier-1 self-check is `tests/test_lint.py`.
+"""
+
+from deepspeed_tpu.analysis.core import (     # noqa: F401
+    Finding, LintReport, ModuleContext, ProjectContext, Rule, all_rules,
+    register, run_lint)
+from deepspeed_tpu.analysis import baseline   # noqa: F401
+
+__all__ = ["Finding", "LintReport", "ModuleContext", "ProjectContext",
+           "Rule", "all_rules", "register", "run_lint", "baseline"]

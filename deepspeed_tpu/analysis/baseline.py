@@ -1,0 +1,102 @@
+"""Ratcheting baseline for `dstpu_lint`.
+
+`lint_baseline.json` grandfathers findings that predate the linter (or a
+new rule): a finding whose (rule, path, stripped-line-text) fingerprint
+matches a baseline entry does not fail the build. The file is a RATCHET
+— it may only shrink:
+
+* `dstpu_lint` exits 1 on any NON-baselined finding; baselining it by
+  hand means editing the checked-in JSON, which a reviewer sees.
+* `dstpu_lint --baseline` rewrites the file as the INTERSECTION of the
+  old baseline and the current findings — fixed findings fall out,
+  new findings are refused (they stay failing).
+* stale entries (baselined findings that no longer occur) also exit 1,
+  with instructions to shrink — a rotting entry would silently
+  grandfather the same finding if it were ever reintroduced.
+
+Fingerprints use the source line TEXT, not the line NUMBER, so edits
+elsewhere in a file do not churn the baseline; identical lines in one
+file share an entry with a count.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Dict, List, Tuple
+
+from deepspeed_tpu.analysis.core import Finding
+
+BASELINE_NAME = "lint_baseline.json"
+_HEADER = (
+    "dstpu_lint ratcheting baseline — grandfathered findings that "
+    "predate the rule that catches them. This file may only SHRINK: "
+    "fix a finding and run `dstpu_lint --baseline` to drop its entry. "
+    "New findings are never added here — fix them or suppress them "
+    "with a reasoned `# dstpu: ignore[...]` pragma.")
+
+Key = Tuple[str, str, str]            # (rule, path, snippet)
+
+
+def default_path() -> pathlib.Path:
+    return pathlib.Path(__file__).parent / BASELINE_NAME
+
+
+def load(path=None) -> Dict[Key, int]:
+    """Baseline entries as fingerprint -> grandfathered count. A missing
+    file is an empty baseline."""
+    p = pathlib.Path(path) if path else default_path()
+    if not p.exists():
+        return {}
+    data = json.loads(p.read_text())
+    out: Dict[Key, int] = {}
+    for e in data.get("entries", []):
+        out[(e["rule"], e["path"], e["snippet"])] = int(e.get("count", 1))
+    return out
+
+
+def split(findings: List[Finding], baseline: Dict[Key, int]
+          ) -> Tuple[List[Finding], List[Finding], List[Key]]:
+    """(new, grandfathered, stale-keys). Per fingerprint, up to the
+    baselined COUNT of occurrences is grandfathered (sorted order keeps
+    the choice deterministic); the surplus is new."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in sorted(findings, key=Finding.sort_key):
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    # any unused allowance is stale: the ratchet wants exact counts, so
+    # fixing ONE of three identical baselined findings already requires
+    # (and permits only) a shrink
+    stale = [k for k, n in sorted(budget.items()) if n > 0]
+    return new, old, stale
+
+
+def shrink(findings: List[Finding], old_baseline: Dict[Key, int]
+           ) -> Dict[Key, int]:
+    """The `--baseline` update: per key, min(old count, current count);
+    keys with no current finding drop out; keys not already baselined
+    never enter (the ratchet)."""
+    current: Dict[Key, int] = {}
+    for f in findings:
+        current[f.key()] = current.get(f.key(), 0) + 1
+    out: Dict[Key, int] = {}
+    for k, n in old_baseline.items():
+        have = current.get(k, 0)
+        if have > 0:
+            out[k] = min(n, have)
+    return out
+
+
+def write(baseline: Dict[Key, int], path=None) -> pathlib.Path:
+    p = pathlib.Path(path) if path else default_path()
+    entries = [{"rule": r, "path": pa, "snippet": s, "count": n}
+               for (r, pa, s), n in sorted(baseline.items())]
+    p.write_text(json.dumps({"_comment": _HEADER, "entries": entries},
+                            indent=2, sort_keys=False) + "\n")
+    return p

@@ -1,0 +1,181 @@
+"""`dstpu_lint` — run the DT rule set over the repo.
+
+Usage::
+
+    dstpu_lint                       # full rule set, package tree
+    dstpu_lint deepspeed_tpu/serving # scope to a subtree / file
+    dstpu_lint --rules DT001,DT004   # subset of rules
+    dstpu_lint --json                # stable, sorted machine output
+    dstpu_lint --baseline            # shrink lint_baseline.json
+    dstpu_lint --list-rules
+
+Exit codes: 0 = clean (every finding fixed, pragma'd with a reason, or
+baselined); 1 = non-baselined findings OR stale baseline entries (the
+ratchet: run `--baseline` to shrink); 2 = usage error.
+
+JSON output is deterministic — findings sorted by (path, line, col,
+rule) — so CI diffs and golden tests are reviewable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from deepspeed_tpu.analysis import baseline as baseline_mod
+from deepspeed_tpu.analysis.core import all_rules, run_lint
+
+SCHEMA_VERSION = 1
+
+
+def repo_root_default() -> pathlib.Path:
+    """The tree the package was imported from: <root>/deepspeed_tpu/
+    analysis/cli.py -> <root>. Running from a source checkout (the only
+    place linting makes sense) this is the repo root."""
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dstpu_lint",
+        description="TPU/JAX-aware static analysis for deepspeed_tpu "
+                    "(rules DT001-DT005; see docs/static_analysis.md)")
+    ap.add_argument("targets", nargs="*",
+                    help="repo-relative files/dirs to scan "
+                         "(default: deepspeed_tpu)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: the tree this package "
+                         "was imported from)")
+    ap.add_argument("--rules", default=None, metavar="DT001,DT002",
+                    help="comma-separated rule subset")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine output (stable, sorted)")
+    ap.add_argument("--baseline", action="store_true", dest="update",
+                    help="shrink the ratcheting baseline to the "
+                         "still-present findings (never grows it)")
+    ap.add_argument("--baseline-file", default=None,
+                    help=f"baseline path (default: "
+                         f"analysis/{baseline_mod.BASELINE_NAME})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for rule in rules.values():
+            scope = ", ".join(rule.paths) if rule.paths else "whole tree"
+            kind = "project" if rule.project_level else "per-file"
+            print(f"{rule.id}  {rule.name}  [{kind}; {scope}]")
+            print(f"       {rule.description}")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rule_ids if r not in rules]
+        if unknown:
+            print(f"dstpu_lint: unknown rule id(s) {unknown}; known: "
+                  f"{list(rules)}", file=sys.stderr)
+            return 2
+
+    if args.update and args.no_baseline:
+        print("dstpu_lint: --baseline and --no-baseline are "
+              "contradictory", file=sys.stderr)
+        return 2
+
+    root = pathlib.Path(args.root).resolve() if args.root \
+        else repo_root_default()
+    targets = args.targets or None
+    try:
+        report = run_lint(root, targets=targets, rule_ids=rule_ids)
+    except (FileNotFoundError, KeyError) as e:
+        print(f"dstpu_lint: {e}", file=sys.stderr)
+        return 2
+
+    bl_path = args.baseline_file or baseline_mod.default_path()
+    baseline = {} if args.no_baseline else baseline_mod.load(bl_path)
+    # a scoped run (--rules / path targets) only sees part of the tree:
+    # baseline entries outside that scope are neither stale nor
+    # shrinkable — partition them out before diffing (project-level
+    # rules scan the whole tree, so their entries are always in scope)
+    project_ran = {rid for rid in report.rules_run
+                   if rules[rid].project_level}
+    scanned = set(report.scanned)
+    in_scope = {k: v for k, v in baseline.items()
+                if k[0] in report.rules_run
+                and (k[1] in scanned or k[0] in project_ran)}
+    out_scope = {k: v for k, v in baseline.items() if k not in in_scope}
+    new, grandfathered, stale = baseline_mod.split(
+        report.sorted_findings(), in_scope)
+
+    if args.update:
+        if not pathlib.Path(bl_path).exists():
+            # initial adoption: the one time the file may be CREATED
+            # from current findings; from then on it only shrinks
+            seed = {}
+            for f in report.sorted_findings():
+                seed[f.key()] = seed.get(f.key(), 0) + 1
+            baseline_mod.write(seed, bl_path)
+            print(f"dstpu_lint: seeded baseline {bl_path} with "
+                  f"{sum(seed.values())} grandfathered finding(s) — "
+                  f"the file only shrinks from here")
+            new, grandfathered = [], report.sorted_findings()
+        else:
+            shrunk = baseline_mod.shrink(report.sorted_findings(),
+                                         in_scope)
+            merged = {**out_scope, **shrunk}
+            baseline_mod.write(merged, bl_path)
+            dropped = sum(in_scope.values()) - sum(shrunk.values())
+            kept = sum(merged.values())
+            print(f"dstpu_lint: baseline {bl_path}: "
+                  f"{kept} entr{'y' if kept == 1 else 'ies'} kept, "
+                  f"{dropped} dropped (shrink-only: new findings are "
+                  f"never added; out-of-scope entries untouched)")
+        stale = []                        # just shrunk/seeded away
+
+    if args.as_json:
+        payload = {
+            "schema_version": SCHEMA_VERSION,
+            "rules_run": report.rules_run,
+            "findings": [f.to_dict() for f in new],
+            "grandfathered": [f.to_dict() for f in grandfathered],
+            "stale_baseline": [{"rule": r, "path": p, "snippet": s}
+                               for r, p, s in stale],
+            "suppressed": len(report.suppressed),
+            "ok": not new and not stale,
+        }
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        for f in new:
+            print(f.render())
+        if grandfathered:
+            print(f"dstpu_lint: {len(grandfathered)} grandfathered "
+                  f"finding(s) in the baseline (shrink with --baseline "
+                  f"after fixing)")
+        for r, p, s in stale:
+            print(f"dstpu_lint: stale baseline entry {r} at {p} "
+                  f"({s!r}) — the finding is gone; run "
+                  f"`dstpu_lint --baseline` to shrink", file=sys.stderr)
+        if new:
+            by_rule = {}
+            for f in new:
+                by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+            summary = ", ".join(f"{k}: {v}"
+                                for k, v in sorted(by_rule.items()))
+            print(f"dstpu_lint: {len(new)} finding(s) ({summary}); fix "
+                  f"them or suppress with "
+                  f"`# dstpu: ignore[DTnnn]: reason`", file=sys.stderr)
+        elif not stale:
+            supp = len(report.suppressed)
+            print(f"dstpu_lint: clean ({', '.join(report.rules_run)}; "
+                  f"{supp} reasoned suppression(s), "
+                  f"{len(grandfathered)} baselined)")
+
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

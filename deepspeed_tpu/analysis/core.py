@@ -1,0 +1,345 @@
+"""`dstpu_lint` framework core — findings, pragmas, rule registry, driver.
+
+The serving/training stack runs on conventions that nothing enforced
+mechanically until this package: injectable clocks in the serving tier
+(the chaos harness swaps them), buffer donation on every persistent
+jitted program (use-after-donation is a silent wrong-answer bug on TPU),
+no host syncs or per-call `jax.jit` construction in hot paths, and a
+docs-synced metric catalog. Each convention is a `Rule` here; the CLI
+(`bin/dstpu_lint`) and the tier-1 self-check test share this driver.
+
+Everything in this package is stdlib-only (`ast`, `re`, `json`) — the
+linter must import in milliseconds and run anywhere, including
+environments without jax. DT005 is the one exception: it resolves
+dynamically composed metric names by importing the package, lazily,
+inside its check.
+
+Suppression grammar (one finding class, one reason, same line or the
+line directly above)::
+
+    x.item()   # dstpu: ignore[DT001]: completion fence, cold path
+    # dstpu: ignore[DT001,DT003]: reason covering the next line
+    y = donated_read(y)
+
+A pragma without a reason string does NOT suppress — it becomes a DT000
+finding itself, as does a pragma naming an unknown rule or suppressing
+nothing (when the full rule set runs). The checked-in baseline
+(`lint_baseline.json`, see baseline.py) grandfathers pre-existing
+findings; it may only shrink.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import pathlib
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# framework-reserved id: pragma hygiene + unparsable files
+FRAMEWORK_RULE = "DT000"
+
+_RULE_ID_RE = re.compile(r"^DT\d{3}$")
+
+# the pragma grammar: a comment `dstpu: ignore[DT001]: reason text`
+# (multiple ids comma-separated; the reason clause is mandatory)
+PRAGMA_RE = re.compile(
+    r"#\s*dstpu:\s*ignore\[([^\]]*)\]\s*(?::\s*(\S.*))?\s*$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location.
+
+    `snippet` (the stripped source line) is the baseline fingerprint
+    anchor: line numbers drift with every edit above a finding, the line
+    text itself only changes when the finding's code changes."""
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int          # 1-based
+    col: int           # 0-based
+    message: str
+    snippet: str = ""
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.snippet)
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule, self.message)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: " \
+               f"{self.rule} {self.message}"
+
+
+@dataclasses.dataclass
+class Pragma:
+    line: int                  # line the pragma comment sits on
+    rules: Tuple[str, ...]
+    reason: str
+    standalone: bool           # comment-only line: covers the NEXT line
+    used: bool = False
+
+    def covers(self) -> Tuple[int, ...]:
+        # a standalone pragma anchors the line below it; a trailing one
+        # anchors its own line
+        return (self.line + 1,) if self.standalone else (self.line,)
+
+
+def _comment_tokens(source: str):
+    """(line, col, text) of every real COMMENT token — pragma grammar in
+    a docstring or f-string (this package documents itself!) must not
+    parse as a pragma."""
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        return [(t.start[0], t.start[1], t.string) for t in toks
+                if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError):   # pragma: no cover
+        return []
+
+
+def scan_pragmas(source: str, lines: List[str], path: str,
+                 known_rules: Iterable[str]) -> Tuple[List[Pragma],
+                                                      List[Finding]]:
+    """Parse every suppression pragma in a file; malformed ones (no
+    reason, empty/unknown rule list) come back as DT000 findings and
+    suppress nothing."""
+    known = set(known_rules)
+    pragmas: List[Pragma] = []
+    findings: List[Finding] = []
+    for i, col, comment in _comment_tokens(source):
+        m = PRAGMA_RE.search(comment)
+        if not m:
+            continue
+        text = lines[i - 1] if i <= len(lines) else comment
+        snippet = text.strip()
+        ids = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        reason = (m.group(2) or "").strip()
+        bad = [r for r in ids if not _RULE_ID_RE.match(r)
+               or (known and r not in known)]
+        if not ids or bad:
+            findings.append(Finding(
+                FRAMEWORK_RULE, path, i, col, "malformed pragma: "
+                f"unknown or empty rule list {list(ids) or '[]'} — use "
+                f"`# dstpu: ignore[DTnnn]: reason`", snippet))
+            continue
+        if not reason:
+            findings.append(Finding(
+                FRAMEWORK_RULE, path, i, col,
+                f"pragma for {','.join(ids)} has no reason string — a "
+                f"suppression must say WHY the finding is intentional "
+                f"(`# dstpu: ignore[{','.join(ids)}]: reason`); it "
+                f"suppresses nothing until it does", snippet))
+            continue
+        standalone = text.strip().startswith("#")
+        pragmas.append(Pragma(i, ids, reason, standalone))
+    return pragmas, findings
+
+
+@dataclasses.dataclass
+class ModuleContext:
+    """Everything a per-file rule sees: one parsed module."""
+    path: str                  # repo-relative posix path
+    source: str
+    lines: List[str]
+    tree: ast.Module
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(rule, self.path, node.lineno, node.col_offset,
+                       message, self.snippet(node.lineno))
+
+
+@dataclasses.dataclass
+class ProjectContext:
+    """What a project-level rule sees: the repo root plus every module
+    the driver already parsed (path -> ModuleContext). `full_scan` says
+    the default roots were scanned — a rule may then reuse `modules`
+    instead of re-reading the tree."""
+    repo_root: pathlib.Path
+    modules: Dict[str, ModuleContext]
+    full_scan: bool = True
+
+
+class Rule:
+    """Base class. Subclasses set `id`/`name`/`description`, optionally
+    scope themselves with `paths`/`exclude` (repo-relative prefixes), and
+    implement `check_module` (per-file) or `check_project` (once per run,
+    `project_level = True`)."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+    paths: Tuple[str, ...] = ()
+    exclude: Tuple[str, ...] = ()
+    project_level: bool = False
+
+    def applies(self, path: str) -> bool:
+        if any(path.startswith(e) for e in self.exclude):
+            return False
+        return not self.paths or any(path.startswith(p)
+                                     for p in self.paths)
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, ctx: ProjectContext) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: Dict[str, Rule] = {}
+_LOADED = False
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the global rule registry."""
+    rule = cls()
+    assert _RULE_ID_RE.match(rule.id), f"bad rule id {rule.id!r}"
+    assert rule.id not in _REGISTRY, f"duplicate rule id {rule.id}"
+    _REGISTRY[rule.id] = rule
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    """id -> rule, importing the rule modules on first use."""
+    global _LOADED
+    if not _LOADED:
+        from deepspeed_tpu.analysis import (  # noqa: F401
+            rules_hostsync, rules_clock, rules_donation,
+            rules_recompile, rules_catalog)
+        _LOADED = True
+    return dict(sorted(_REGISTRY.items()))
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: List[Finding]                      # active (not suppressed)
+    suppressed: List[Tuple[Finding, Pragma]]
+    rules_run: List[str]
+    scanned: List[str] = dataclasses.field(default_factory=list)
+
+    def sorted_findings(self) -> List[Finding]:
+        return sorted(self.findings, key=Finding.sort_key)
+
+
+# directories under the repo root the driver scans by default; tests and
+# docs are rule inputs (DT005 reads docs/), not lint targets
+DEFAULT_SCAN_ROOTS = ("deepspeed_tpu",)
+
+
+def iter_source_files(repo_root: pathlib.Path,
+                      targets: Optional[List[str]] = None):
+    """Yield (repo-relative posix path, absolute path) for every python
+    file in scope, sorted for deterministic output."""
+    roots = [repo_root / t for t in (targets or DEFAULT_SCAN_ROOTS)]
+    seen = set()
+    for root in roots:
+        if not root.exists():
+            # a typo'd CI target must fail, not green-light zero files
+            raise FileNotFoundError(f"lint target does not exist: {root}")
+        if root.is_file():
+            files = [root]
+        else:
+            files = sorted(root.rglob("*.py"))
+        for f in files:
+            if "__pycache__" in f.parts or f in seen:
+                continue
+            seen.add(f)
+            yield f.relative_to(repo_root).as_posix(), f
+
+
+def analyze_module(ctx: ModuleContext, rules: Iterable[Rule],
+                   known_ids: Iterable[str],
+                   check_unused: bool = True) -> Tuple[List[Finding],
+                                                       List[Tuple[Finding,
+                                                                  Pragma]]]:
+    """Run per-file rules over one parsed module and apply its pragmas.
+    Returns (active findings incl. DT000 hygiene, suppressed pairs)."""
+    raw: List[Finding] = []
+    for rule in rules:
+        if not rule.project_level and rule.applies(ctx.path):
+            raw.extend(rule.check_module(ctx))
+    pragmas, hygiene = scan_pragmas(ctx.source, ctx.lines, ctx.path,
+                                    known_ids)
+    by_line: Dict[int, List[Pragma]] = {}
+    for p in pragmas:
+        for ln in p.covers():
+            by_line.setdefault(ln, []).append(p)
+    active: List[Finding] = []
+    suppressed: List[Tuple[Finding, Pragma]] = []
+    for f in raw:
+        hit = next((p for p in by_line.get(f.line, ())
+                    if f.rule in p.rules), None)
+        if hit is not None:
+            hit.used = True
+            suppressed.append((f, hit))
+        else:
+            active.append(f)
+    if check_unused:
+        for p in pragmas:
+            if not p.used:
+                active.append(Finding(
+                    FRAMEWORK_RULE, ctx.path, p.line, 0,
+                    f"unused pragma: no {','.join(p.rules)} finding on "
+                    f"the line it covers — delete it (dead suppressions "
+                    f"hide future regressions)", ctx.snippet(p.line)))
+    return active + hygiene, suppressed
+
+
+def run_lint(repo_root, targets: Optional[List[str]] = None,
+             rule_ids: Optional[List[str]] = None,
+             check_unused: Optional[bool] = None) -> LintReport:
+    """Parse every file in scope once, run the per-file rules, apply
+    pragmas, then run the project-level rules. Pure function of the
+    tree — no baseline logic here (see baseline.py / cli.py)."""
+    repo_root = pathlib.Path(repo_root).resolve()
+    registry = all_rules()
+    if rule_ids is not None:
+        unknown = [r for r in rule_ids if r not in registry]
+        if unknown:
+            raise KeyError(f"unknown rule id(s): {unknown}; "
+                           f"known: {list(registry)}")
+        rules = [registry[r] for r in rule_ids]
+    else:
+        rules = list(registry.values())
+    known_ids = list(registry) + [FRAMEWORK_RULE]
+    # unused-pragma hygiene only makes sense against the full rule set —
+    # under --rules filtering, every other rule's pragmas look unused
+    if check_unused is None:
+        check_unused = rule_ids is None
+
+    findings: List[Finding] = []
+    suppressed: List[Tuple[Finding, Pragma]] = []
+    modules: Dict[str, ModuleContext] = {}
+    scanned: List[str] = []
+    for rel, abspath in iter_source_files(repo_root, targets):
+        scanned.append(rel)
+        source = abspath.read_text()
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            findings.append(Finding(FRAMEWORK_RULE, rel, e.lineno or 1, 0,
+                                    f"file does not parse: {e.msg}"))
+            continue
+        ctx = ModuleContext(rel, source, source.splitlines(), tree)
+        modules[rel] = ctx
+        active, supp = analyze_module(ctx, rules, known_ids, check_unused)
+        findings.extend(active)
+        suppressed.extend(supp)
+
+    pctx = ProjectContext(repo_root, modules, full_scan=targets is None)
+    for rule in rules:
+        if rule.project_level:
+            findings.extend(rule.check_project(pctx))
+
+    return LintReport(sorted(findings, key=Finding.sort_key), suppressed,
+                      [r.id for r in rules], scanned)

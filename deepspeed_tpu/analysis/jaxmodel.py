@@ -1,0 +1,230 @@
+"""Light AST model of a module's jit/donation structure.
+
+DT001 (host-sync), DT003 (donation-safety) and DT004 (recompile-hazard)
+all need the same facts about a module: which callables are persistent
+jitted programs, which argument positions they donate, and which local
+names hold device values. This module derives those facts from the three
+idioms the codebase actually uses:
+
+1. direct assignment — ``self._decode = jax.jit(fn, donate_argnums=(3,))``
+   (the watchdog-wrapped form ``wd.wrap("name", jax.jit(...))`` counts:
+   the jit call is found anywhere inside the assigned expression, and
+   `CompileWatchdog.wrap` preserves the wrapped signature);
+2. module-level rebinding — ``_fn = jax.jit(_fn, donate_argnums=(2,))``;
+3. factories — a function/method whose ``return`` expression contains a
+   ``jax.jit(...)`` call registers assignments from its call sites:
+   ``self._draft_steps = build_draft_program(...)``.
+
+This is intentionally a heuristic model, not an import-time one: it never
+executes the module, so dynamically constructed programs (dict registries
+of jitted fns, cross-module factories) are invisible. The rules err on
+the side of silence for what the model cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, Optional, Tuple
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'self.pool' / 'jax.jit' / 'np' for Name/Attribute chains, else
+    None (subscripts, calls and literals have no stable name)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def find_jax_jit(expr: ast.AST) -> Optional[ast.Call]:
+    """The first `jax.jit(...)` call inside `expr`, if any."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and dotted(node.func) == "jax.jit":
+            return node
+    return None
+
+
+def find_returned_jit(expr: ast.AST) -> Optional[ast.Call]:
+    """A `jax.jit(...)` call inside `expr` whose CALLABLE flows out —
+    i.e. not immediately invoked. `return jax.jit(f)` and
+    `return wrap(jax.jit(f))` qualify; `return jax.jit(f)(x)` returns
+    the invocation RESULT, so the wrapper dies with the call."""
+    jit = find_jax_jit(expr)
+    if jit is None:
+        return None
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and node.func is jit:
+            return None                   # immediately invoked
+    return jit
+
+
+def donate_argnums_of(jit_call: ast.Call) -> Tuple[int, ...]:
+    """Literal donate_argnums of a jax.jit call — (3,), 3, or absent.
+    Non-literal values come back empty (the model stays silent)."""
+    for kw in jit_call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for el in v.elts:
+                if not (isinstance(el, ast.Constant)
+                        and isinstance(el.value, int)):
+                    return ()
+                out.append(el.value)
+            return tuple(out)
+    return ()
+
+
+def static_argnums_of(jit_call: ast.Call) -> Tuple[int, ...]:
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(el.value for el in v.elts
+                             if isinstance(el, ast.Constant)
+                             and isinstance(el.value, int))
+    return ()
+
+
+@dataclasses.dataclass
+class JitProgram:
+    name: str                       # dotted callee name ('self._decode')
+    donate: Tuple[int, ...]
+    line: int
+
+
+class JitRegistry:
+    """Dotted callee name -> JitProgram for one module."""
+
+    def __init__(self):
+        self.programs: Dict[str, JitProgram] = {}
+        # factory fn name -> donate tuple of the jit it returns
+        self.factories: Dict[str, Tuple[int, ...]] = {}
+
+    @classmethod
+    def collect(cls, tree: ast.Module) -> "JitRegistry":
+        reg = cls()
+        # pass 1: factories — any def whose return contains jax.jit
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for ret in ast.walk(node):
+                    if isinstance(ret, ast.Return) and ret.value is not None:
+                        jit = find_returned_jit(ret.value)
+                        if jit is not None:
+                            d = donate_argnums_of(jit)
+                            reg.factories[node.name] = d
+                            reg.factories[f"self.{node.name}"] = d
+                            break
+        # pass 2: assignments binding a jitted program to a stable name
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            jit = find_jax_jit(value)
+            donate: Optional[Tuple[int, ...]] = None
+            if jit is not None:
+                donate = donate_argnums_of(jit)
+            elif isinstance(value, ast.Call):
+                callee = dotted(value.func)
+                if callee in reg.factories:
+                    donate = reg.factories[callee]
+            if donate is None:
+                continue
+            for tgt in node.targets:
+                name = dotted(tgt)
+                if name:
+                    reg.programs[name] = JitProgram(name, donate,
+                                                    node.lineno)
+        return reg
+
+    def lookup(self, call: ast.Call) -> Optional[JitProgram]:
+        name = dotted(call.func)
+        return self.programs.get(name) if name else None
+
+
+def iter_functions(tree: ast.Module) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def assign_target_names(stmt: ast.stmt) -> Tuple[str, ...]:
+    """Dotted names (re)bound by an assignment statement, tuple targets
+    flattened: `a, self.pool = ...` -> ('a', 'self.pool')."""
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    out = []
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(n for el in t.elts if (n := dotted(el)))
+        else:
+            n = dotted(t)
+            if n:
+                out.append(n)
+    return tuple(out)
+
+
+def statements_in_order(fn: ast.FunctionDef):
+    """Flatten a function body to (statement, loop_depth) in source
+    order, recursing into compound statements but NOT into nested
+    function/class definitions (their scopes are analyzed separately)."""
+    out = []
+
+    def visit(stmts, depth):
+        for s in stmts:
+            out.append((s, depth))
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                visit(getattr(s, field, []) or [], depth
+                      + (1 if isinstance(s, (ast.For, ast.While))
+                         and field == "body" else 0))
+            for h in getattr(s, "handlers", []) or []:
+                visit(h.body, depth)
+    visit(fn.body, 0)
+    return out
+
+
+def own_calls(stmt: ast.stmt):
+    """Every Call node in one statement's OWN expressions, in source
+    order — child statements and nested lambda scopes excluded (the
+    former are visited separately, the latter run in another scope)."""
+    def walk(node):
+        for ch in ast.iter_child_nodes(node):
+            if isinstance(ch, (ast.stmt, ast.Lambda)):
+                continue
+            if isinstance(ch, ast.Call):
+                yield ch
+            yield from walk(ch)
+    yield from walk(stmt)
+
+
+def loads_in(stmt: ast.stmt):
+    """Every dotted-name Load in one statement's OWN expressions (with
+    the node). Child statements of compound statements are skipped —
+    `statements_in_order` visits them separately — as are nested
+    function/lambda/class scopes."""
+    def walk(node):
+        for ch in ast.iter_child_nodes(node):
+            if isinstance(ch, (ast.stmt, ast.Lambda)):
+                continue
+            name = dotted(ch)
+            if name is not None and isinstance(
+                    getattr(ch, "ctx", None), ast.Load):
+                yield name, ch
+                # don't descend into an Attribute chain we already named
+                continue
+            yield from walk(ch)
+    yield from walk(stmt)

@@ -1,0 +1,156 @@
+"""DT005 — metric-catalog drift.
+
+The docs/profiling.md "Metric catalog" section and the source tree must
+agree: every literal metric name recorded through the telemetry facade
+(or a registry handle) appears in the catalog, and every catalog row
+names a metric that still exists (no dead rows). Dynamically composed
+names — f-string router counters, per-replica TTFT, `record_events`
+routing, the memscope `LEDGER_GAUGES` loop — cannot be seen by a static
+scan, so they are enumerated explicitly below: growing one means growing
+its doc row, and the enumeration is the escape hatch a new dynamic
+emitter must join.
+
+This is the ONE implementation of the check (migrated from the former
+inline body of `tests/test_telemetry.py::test_metric_catalog_lint`,
+which now calls `catalog_findings`). The CLI runs it as rule DT005; the
+tier-1 test asserts it returns nothing.
+
+Resolving the dynamic names imports `deepspeed_tpu` (the only rule that
+does); the import is lazy so `dstpu_lint --rules DT001..DT004` stays
+jax-free.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+from typing import List, Optional
+
+from deepspeed_tpu.analysis.core import Finding, Rule, register
+
+# literal names recorded through the facade (inc / observe / set_gauge)
+# or a registry handle (histogram / gauge / counter) with a quoted
+# "<subsystem>/<metric>" first argument
+_RECORD_RE = re.compile(
+    r'\.(?:inc|observe|set_gauge|histogram|gauge|counter)'
+    r'\(\s*"([^"\s]+/[^"\s]+)"')
+
+# names composed at runtime that no static scan can see; each entry is
+# documented in the catalog like a literal one. ServingRouter counters
+# and the memscope LEDGER_GAUGES list are pulled from the live package
+# so this module cannot drift from them.
+_STATIC_DYNAMIC_NAMES = (
+    "router/replica/<rid>/ttft_ms",   # per-replica, rid interpolated
+    "train/hbm_bytes_in_use",         # gauge set via a (src, dst) table
+    "train/hbm_peak_bytes",
+    "Checkpoint/save_ms",             # routed through record_events
+)
+
+
+def _dynamic_names() -> set:
+    """Runtime-composed metric names (imports the package, lazily)."""
+    from deepspeed_tpu.serving import ServingRouter
+    from deepspeed_tpu.telemetry import memscope as memscope_mod
+    dynamic = {f"router/{k}"
+               for k in ServingRouter(replicas=[]).counters}
+    dynamic |= set(_STATIC_DYNAMIC_NAMES)
+    dynamic |= {f"mem/{k}" for k in memscope_mod.LEDGER_GAUGES}
+    return dynamic
+
+
+def catalog_findings(repo_root,
+                     docs_path: Optional[pathlib.Path] = None,
+                     package_root: Optional[pathlib.Path] = None,
+                     sources: Optional[dict] = None) -> List[Finding]:
+    """The metric-catalog check. Returns [] when docs and code agree.
+
+    `docs_path`/`package_root` exist for the fixture tests (point the
+    doc side at a synthetic catalog); `sources` ({rel path: text}) lets
+    the lint driver hand over the files it already read instead of a
+    second tree walk. Production test callers pass only `repo_root`."""
+    repo_root = pathlib.Path(repo_root)
+    pkg = package_root or repo_root / "deepspeed_tpu"
+    docs = docs_path or repo_root / "docs" / "profiling.md"
+
+    if sources is None:
+        sources = {}
+        for p in sorted(pkg.rglob("*.py")):
+            if "__pycache__" not in p.parts:
+                sources[p.relative_to(repo_root).as_posix()] = \
+                    p.read_text()
+    code_names = {}                       # name -> (rel path, line)
+    for rel in sorted(sources):
+        text = sources[rel]
+        for m in _RECORD_RE.finditer(text):
+            line = text.count("\n", 0, m.start()) + 1
+            code_names.setdefault(m.group(1), (rel, line))
+    if not code_names:
+        return [Finding("DT005", pkg.name, 1, 0,
+                        "metric scan found no recording sites — did the "
+                        "telemetry facade move? (the scan regex no "
+                        "longer matches anything)")]
+
+    try:
+        dynamic = _dynamic_names()
+    except Exception as e:                # pragma: no cover - env-specific
+        return [Finding("DT005", "deepspeed_tpu", 1, 0,
+                        f"dynamic metric-name resolution failed "
+                        f"({type(e).__name__}: {e}) — the catalog check "
+                        f"needs an importable package")]
+
+    doc_rel = docs.relative_to(repo_root).as_posix() \
+        if docs.is_relative_to(repo_root) else str(docs)
+    if not docs.exists():
+        return [Finding("DT005", doc_rel, 1, 0,
+                        "metric catalog document is missing")]
+    doc_text = docs.read_text()
+    if "### Metric catalog" not in doc_text:
+        return [Finding("DT005", doc_rel, 1, 0,
+                        'no "### Metric catalog" section in the metric '
+                        'catalog document')]
+    section = doc_text.split("### Metric catalog")[1].split("###")[0]
+    sec_start = doc_text[:doc_text.index("### Metric catalog")] \
+        .count("\n") + 1
+    doc_names = {}                        # name -> doc line
+    # backticked repo paths in the section's prose are cross-links, not
+    # catalog rows
+    link_prefixes = ("docs/", "bin/", "tests/", "deepspeed_tpu/",
+                     "examples/")
+    for i, line in enumerate(section.splitlines(), start=sec_start):
+        for m in re.finditer(r"`([^`\s]+/[^`\s]+)`", line):
+            if not m.group(1).startswith(link_prefixes):
+                doc_names.setdefault(m.group(1), i)
+
+    findings = []
+    for name in sorted(set(code_names) - set(doc_names)):
+        path, line = code_names[name]
+        findings.append(Finding(
+            "DT005", path, line, 0,
+            f"metric '{name}' is recorded here but missing from the "
+            f"{doc_rel} catalog — add a row (name, unit, meaning)"))
+    for name in sorted(set(doc_names) - set(code_names) - dynamic):
+        findings.append(Finding(
+            "DT005", doc_rel, doc_names[name], 0,
+            f"catalog row '{name}' has no recording site left in the "
+            f"tree — delete the dead row (dynamic names belong in "
+            f"analysis/rules_catalog.py's enumeration)"))
+    return findings
+
+
+@register
+class MetricCatalogRule(Rule):
+    id = "DT005"
+    name = "metric-catalog"
+    description = (
+        "docs/profiling.md metric catalog and the recording sites in "
+        "the tree must agree — no undocumented metrics, no dead rows "
+        "(dynamic names are enumerated in rules_catalog.py)")
+    project_level = True
+
+    def check_project(self, ctx):
+        # a full default scan already read every package file — reuse it;
+        # a scoped run (explicit targets) must still scan the WHOLE tree,
+        # or unscanned recording sites would read as dead catalog rows
+        sources = ({p: m.source for p, m in ctx.modules.items()}
+                   if ctx.full_scan else None)
+        return catalog_findings(ctx.repo_root, sources=sources)

@@ -1,0 +1,76 @@
+"""DT002 — clock-injection.
+
+Every latency decision in the serving tier — TTL cancellation, hard
+deadlines, hedged dispatch, hung-replica strikes, TTFT/TPOT histograms —
+runs off an injectable clock (`ServingEngine(clock=...)`,
+`ServingRouter(clock=...)`), because the PR 9 chaos harness proves the
+self-healing behavior by swapping that clock for a `ChaosClock`. A
+direct `time.time()`/`time.monotonic()`/`time.perf_counter()` CALL in
+`serving/` or `inference/` bypasses the injection point: the code under
+it becomes untestable under chaos and silently exempt from the
+deadline/hedging proofs.
+
+The sanctioned default-binding idiom does not fire — it references the
+function without calling it::
+
+    self._clock = clock if clock is not None else time.monotonic
+
+Out of scope by design: `telemetry/` (it IS the wall-clock layer),
+checkpointing, launchers. Known evasion this heuristic cannot see:
+aliasing (`t = time.time; t()`) — the fixture tests document it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import Rule, register
+from deepspeed_tpu.analysis.jaxmodel import dotted
+
+_WALL_CLOCKS = ("time", "monotonic", "perf_counter", "monotonic_ns",
+                "perf_counter_ns", "time_ns")
+
+
+@register
+class ClockInjectionRule(Rule):
+    id = "DT002"
+    name = "clock-injection"
+    description = (
+        "direct wall-clock call in the serving tier — route through the "
+        "injectable clock the chaos harness swaps")
+    paths = ("deepspeed_tpu/serving/", "deepspeed_tpu/inference/")
+
+    def check_module(self, ctx):
+        findings = []
+        # alias maps: `import time as t` and `from time import monotonic`
+        module_aliases = set()
+        fn_aliases = {}                      # local name -> time.<attr>
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        module_aliases.add(a.asname or a.name)
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name in _WALL_CLOCKS:
+                        fn_aliases[a.asname or a.name] = f"time.{a.name}"
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name is None:
+                continue
+            hit = None
+            parts = name.split(".")
+            if (len(parts) == 2 and parts[0] in module_aliases
+                    and parts[1] in _WALL_CLOCKS):
+                hit = f"time.{parts[1]}"
+            elif name in fn_aliases:
+                hit = fn_aliases[name]
+            if hit:
+                findings.append(ctx.finding(
+                    self.id, node, f"direct wall-clock call {hit}() — "
+                    f"serving-tier code must read time through the "
+                    f"injectable clock (`self._clock`), or the chaos "
+                    f"harness cannot drive it"))
+        return findings

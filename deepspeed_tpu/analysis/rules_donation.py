@@ -1,0 +1,137 @@
+"""DT003 — donation-safety.
+
+Every persistent jitted program in the stack donates its big buffer
+(`donate_argnums`): the paged KV pool into the decode/prefill/verify
+steps, the train state into the train step, the destination pool into
+the handoff transplant. Donation lets XLA alias the update in place —
+and makes the PYTHON-side argument a dead reference the moment the call
+returns. Reading it afterwards is not an error on CPU (jax warns at
+most); on TPU it can silently read clobbered memory: the classic
+wrong-answer-no-crash bug.
+
+The rule: a name (local or `self.attr`) passed at a donated argument
+position of a known-donating callable (see jaxmodel.JitRegistry — direct
+`jax.jit(..., donate_argnums=...)` bindings and factory returns) must
+not be READ again in the same function scope unless it was rebound
+first. The sanctioned idiom rebinds at the donation site itself::
+
+    tok, self.pool = self._prefill_step(..., self.pool, ...)   # clean
+
+Donating inside a loop without a same-statement rebind flags even when
+the read is textually BEFORE the call — the back edge makes it a
+read-after-donation on iteration two.
+
+Blind spots (documented, not silent): donated subscripts
+(`caches[i][0]`) and cross-module program handles are not tracked.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import Rule, register
+from deepspeed_tpu.analysis.jaxmodel import (
+    JitRegistry, assign_target_names, dotted, iter_functions, loads_in,
+    own_calls, statements_in_order)
+
+
+def _reads_name(loaded: str, name: str) -> bool:
+    return loaded == name or loaded.startswith(name + ".")
+
+
+@register
+class DonationSafetyRule(Rule):
+    id = "DT003"
+    name = "donation-safety"
+    description = (
+        "a buffer passed at a donated argument position of a jitted "
+        "program is read again before being rebound — use-after-donation "
+        "is silent wrong-answer territory on TPU")
+
+    def check_module(self, ctx):
+        registry = JitRegistry.collect(ctx.tree)
+        if not any(p.donate for p in registry.programs.values()):
+            return []
+        findings = []
+        for fn in iter_functions(ctx.tree):
+            findings.extend(self._check_function(ctx, fn, registry))
+        return findings
+
+    def _check_function(self, ctx, fn, registry):
+        findings = []
+        stmts = statements_in_order(fn)
+        # donated: name -> (donation stmt, loop depth at donation)
+        donated = {}
+        for stmt, depth in stmts:
+            # 1) reads of still-donated names in this statement
+            for loaded, node in loads_in(stmt):
+                for name, (dsite, _dd) in list(donated.items()):
+                    if dsite is stmt:
+                        continue              # the donation call itself
+                    if _reads_name(loaded, name):
+                        findings.append(ctx.finding(
+                            self.id, node,
+                            f"'{name}' was donated to a jitted program "
+                            f"at line {dsite.lineno} and is read again "
+                            f"here without being rebound — the buffer "
+                            f"is dead after donation"))
+                        del donated[name]     # one report per donation
+            # 2) donations made by this statement
+            rebound = assign_target_names(stmt)
+            new_donations = []
+            for call in own_calls(stmt):
+                prog = registry.lookup(call)
+                if prog is None or not prog.donate:
+                    continue
+                for pos in prog.donate:
+                    if pos < len(call.args):
+                        name = dotted(call.args[pos])
+                        if name is not None:
+                            new_donations.append(name)
+            # 3) rebinds clear old donations; a same-statement rebind of
+            #    a new donation is the sanctioned `x, pool = f(pool)`
+            for name in rebound:
+                donated.pop(name, None)
+            for name in new_donations:
+                if name not in rebound:
+                    donated[name] = (stmt, depth)
+        # 4) loop back edges: a donation inside a loop, never rebound,
+        #    where the SAME loop body also reads the name — iteration
+        #    two reads a donated buffer even if the read is textually
+        #    above the call
+        for name, (dsite, ddepth) in donated.items():
+            if ddepth == 0:
+                continue
+            loop = self._enclosing_loop(fn, dsite)
+            if loop is None:
+                continue
+            # the donation statement itself counts: passing the name to
+            # the program again next iteration IS the read-after-donation
+            for stmt in ast.walk(loop):
+                if not isinstance(stmt, ast.stmt):
+                    continue
+                for loaded, node in loads_in(stmt):
+                    if _reads_name(loaded, name):
+                        findings.append(ctx.finding(
+                            self.id, node,
+                            f"'{name}' is donated at line "
+                            f"{dsite.lineno} inside this loop and never "
+                            f"rebound — the next iteration reads a "
+                            f"donated buffer"))
+                        break
+                else:
+                    continue
+                break
+        return findings
+
+    @staticmethod
+    def _enclosing_loop(fn, target_stmt):
+        """Innermost For/While in `fn` containing `target_stmt`."""
+        best = None
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.For, ast.While)):
+                for ch in ast.walk(node):
+                    if ch is target_stmt:
+                        best = node       # ast.walk is outer-to-inner
+                        break
+        return best

@@ -1,0 +1,128 @@
+"""DT001 — host-sync-in-hot-path.
+
+A host sync (`.item()`, `jax.device_get`, `block_until_ready`,
+`np.asarray` on a device value) inside the serving/inference step path
+stalls the dispatch pipeline: the host blocks until the device drains,
+and the next program launch can't overlap the current one. The serving
+tier's whole design budget is ONE host roundtrip per decode window /
+verify step (see `ServingEngine._step_impl`); an accidental extra sync
+is invisible in tests on CPU and a throughput cliff on a real TPU.
+
+Scope: the serving tier, the inference tier, and the training engines'
+dispatch files — plus the modules whose *deliberate* syncs (host-offload
+tiers, timing fences) carry `# dstpu: ignore[DT001]: reason` pragmas so
+the review-time question "is this sync on purpose?" is answered in the
+source, once.
+
+Device-value detection for `np.asarray`/`np.array` is a local taint:
+names assigned from a call to a known persistent jitted program (see
+jaxmodel.JitRegistry) are device values until rebound. `np.asarray`
+applied to the result of `jax.device_get(...)` does NOT double-report —
+the device_get is the sync and the only finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from deepspeed_tpu.analysis.core import Rule, register
+from deepspeed_tpu.analysis.jaxmodel import (
+    JitRegistry, assign_target_names, dotted, iter_functions, own_calls,
+    statements_in_order)
+
+_SYNC_CALLS = {
+    "jax.device_get": "jax.device_get() blocks until the device value "
+                      "is materialized on the host",
+    "jax.block_until_ready": "jax.block_until_ready() is a full device "
+                             "fence",
+}
+_NP_CONVERT = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+@register
+class HostSyncRule(Rule):
+    id = "DT001"
+    name = "host-sync-in-hot-path"
+    description = (
+        "host synchronization (.item(), jax.device_get, "
+        "block_until_ready, np.asarray on a device value) in a "
+        "dispatch-latency-sensitive path; intentional syncs carry a "
+        "reasoned pragma")
+    paths = (
+        "deepspeed_tpu/serving/",
+        "deepspeed_tpu/inference/",
+        "deepspeed_tpu/runtime/engine.py",
+        "deepspeed_tpu/runtime/hybrid_engine.py",
+        "deepspeed_tpu/runtime/cpu_optimizer.py",
+        "deepspeed_tpu/runtime/infinity.py",
+        "deepspeed_tpu/launcher/comm_bench.py",
+        "deepspeed_tpu/comm/comm.py",
+    )
+
+    def check_module(self, ctx):
+        findings = []
+        registry = JitRegistry.collect(ctx.tree)
+
+        def check_call(call: ast.Call, tainted):
+            name = dotted(call.func)
+            if name in _SYNC_CALLS:
+                findings.append(ctx.finding(
+                    self.id, call, f"host sync: {_SYNC_CALLS[name]}"))
+                return
+            if (isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "item" and not call.args):
+                findings.append(ctx.finding(
+                    self.id, call, "host sync: .item() forces a "
+                    "device->host transfer and drains the pipeline"))
+                return
+            if (isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "block_until_ready"):
+                findings.append(ctx.finding(
+                    self.id, call, "host sync: .block_until_ready() is "
+                    "a device fence"))
+                return
+            if name in _NP_CONVERT and call.args:
+                arg = call.args[0]
+                argname = dotted(arg)
+                if argname is not None and argname in tainted:
+                    findings.append(ctx.finding(
+                        self.id, call, f"host sync: {name}() on "
+                        f"'{argname}', a device value produced by the "
+                        f"jitted program at line {tainted[argname]} — "
+                        f"this transfers and blocks"))
+                elif isinstance(arg, ast.Call):
+                    prog = registry.lookup(arg)
+                    if prog is not None:
+                        findings.append(ctx.finding(
+                            self.id, call, f"host sync: {name}() "
+                            f"directly on the result of jitted program "
+                            f"'{prog.name}'"))
+
+        # module-level statements: no taint, but direct syncs still count
+        class TopVisitor(ast.NodeVisitor):
+            def visit_FunctionDef(self, node):
+                pass                      # handled per-function below
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Call(self, node):
+                check_call(node, {})
+                self.generic_visit(node)
+
+        TopVisitor().visit(ctx.tree)
+
+        for fn in iter_functions(ctx.tree):
+            tainted = {}                 # dotted name -> taint line
+            for stmt, _depth in statements_in_order(fn):
+                for node in own_calls(stmt):
+                    check_call(node, tainted)
+                # taint update from this statement's assignment
+                if isinstance(stmt, ast.Assign):
+                    value = stmt.value
+                    is_device = (isinstance(value, ast.Call)
+                                 and registry.lookup(value) is not None)
+                    for name in assign_target_names(stmt):
+                        if is_device:
+                            tainted[name] = stmt.lineno
+                        else:
+                            tainted.pop(name, None)
+        return findings

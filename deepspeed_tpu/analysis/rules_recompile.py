@@ -1,0 +1,156 @@
+"""DT004 — recompile-hazard.
+
+`jax.jit` is cheap to CALL and ruinously expensive to CONSTRUCT-and-miss:
+a fresh `jax.jit(fn)` wrapper starts with an empty compile cache, so
+building one per step / per request / per loop iteration recompiles the
+program every time (seconds of XLA work on a real chip, and exactly the
+failure mode the PR 8 compile watchdog catches at RUNTIME — this rule
+catches it at review time). The codebase's sanctioned patterns:
+
+* construct at module level, in `__init__`, or in a `_build_*`/`_make_*`
+  builder called once per engine lifetime;
+* construct lazily under a caching guard (`if self._prog is None:`), the
+  degradation ladder's `decode_step_w1` idiom;
+* a factory that RETURNS the jitted callable (`build_draft_program`) —
+  its call sites hold the persistent handle.
+
+Anything else — a `jax.jit(...)` in a loop body, or in a plain function
+that is re-entered per step/request — fires. A jitted function whose
+`static_argnums` parameter carries an unhashable (list/dict/set) default
+also fires: every call with the default raises or misses the cache.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from deepspeed_tpu.analysis.core import Rule, register
+from deepspeed_tpu.analysis.jaxmodel import dotted, static_argnums_of
+
+# function names that mean "runs once per engine/program lifetime"
+_BUILD_CONTEXT = re.compile(
+    r"^(__init__|__post_init__|__new__)$"
+    r"|^_?(build|make|init|create|setup|register|compile|factory|wrap)")
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp)
+
+
+def _is_cache_guard(test: ast.expr) -> bool:
+    """`if X is None:` / `if not X:` — the lazy-build idiom."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return True
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and isinstance(test.ops[0], ast.Is) \
+            and isinstance(test.comparators[0], ast.Constant) \
+            and test.comparators[0].value is None:
+        return True
+    return False
+
+
+@register
+class RecompileHazardRule(Rule):
+    id = "DT004"
+    name = "recompile-hazard"
+    description = (
+        "jax.jit constructed where it is re-built per call (loop body / "
+        "per-step function without a caching guard), or jitted with an "
+        "unhashable static_argnums default — each one recompiles")
+
+    def check_module(self, ctx):
+        findings = []
+        # parent links + enclosing chains, one pass
+        parents = {}
+        for node in ast.walk(ctx.tree):
+            for ch in ast.iter_child_nodes(node):
+                parents[ch] = node
+
+        local_defs = {n.name: n for n in ast.walk(ctx.tree)
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and dotted(node.func) == "jax.jit"):
+                continue
+            findings.extend(self._check_static_defaults(ctx, node,
+                                                        local_defs))
+            # climb to find enclosing functions / loops / guards
+            chain = []
+            cur = node
+            while cur in parents:
+                cur = parents[cur]
+                chain.append(cur)
+            funcs = [n for n in chain
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+            if not funcs:
+                continue                      # module level: persistent
+            guarded = any(isinstance(n, ast.If) and _is_cache_guard(n.test)
+                          for n in chain)
+            if guarded:
+                continue                      # lazy-build idiom
+            in_loop = any(isinstance(n, (ast.For, ast.While))
+                          for n in chain[:chain.index(funcs[0])])
+            if in_loop:
+                findings.append(ctx.finding(
+                    self.id, node,
+                    f"jax.jit constructed inside a loop body in "
+                    f"'{funcs[0].name}' — a fresh wrapper per iteration "
+                    f"recompiles every time; hoist it or guard it "
+                    f"(`if prog is None:`)"))
+                continue
+            if any(_BUILD_CONTEXT.match(f.name) for f in funcs):
+                continue                      # builder/ctor: once per life
+            if self._returns_this_jit(funcs[0], node):
+                continue                      # factory: caller holds it
+            findings.append(ctx.finding(
+                self.id, node,
+                f"jax.jit constructed inside '{funcs[0].name}', which "
+                f"is not a builder (`_build_*`/`_make_*`/`__init__`), "
+                f"has no caching guard, and does not return the jitted "
+                f"callable — if this function runs per step/request, "
+                f"every call recompiles"))
+        return findings
+
+    @staticmethod
+    def _returns_this_jit(fn, jit_call):
+        """Factory exemption: the RETURNED value carries the jit
+        callable out (possibly wrapped). `return jax.jit(f)(x)` does
+        not qualify — that returns the invocation result and rebuilds
+        the wrapper per call."""
+        from deepspeed_tpu.analysis.jaxmodel import find_returned_jit
+        for ret in ast.walk(fn):
+            if isinstance(ret, ast.Return) and ret.value is not None:
+                if find_returned_jit(ret.value) is jit_call:
+                    return True
+        return False
+
+    def _check_static_defaults(self, ctx, jit_call, local_defs):
+        statics = static_argnums_of(jit_call)
+        if not statics or not jit_call.args:
+            return []
+        target = jit_call.args[0]
+        name = dotted(target)
+        fn = local_defs.get(name) if name else None
+        if fn is None:
+            return []
+        args = fn.args
+        params = list(args.posonlyargs) + list(args.args)
+        # defaults align to the TAIL of the positional params
+        offset = len(params) - len(args.defaults)
+        findings = []
+        for i in statics:
+            if i < offset or i >= len(params):
+                continue
+            default = args.defaults[i - offset]
+            if isinstance(default, _UNHASHABLE):
+                findings.append(ctx.finding(
+                    self.id, default,
+                    f"static_argnums position {i} "
+                    f"('{params[i].arg}' of '{fn.name}') has an "
+                    f"unhashable default — jit hashes static args; "
+                    f"calls relying on this default fail or miss the "
+                    f"cache"))
+        return findings

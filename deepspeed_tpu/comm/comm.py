@@ -108,6 +108,7 @@ def barrier():
     if jax.process_count() > 1:
         # cross-host sync: tiny psum over all devices
         x = jnp.zeros((jax.device_count(),))
+        # dstpu: ignore[DT001]: barrier() IS the sync — the cross-host fence is this function's contract
         jax.block_until_ready(
             jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh_mod.get_mesh(), P()))(x)
             if mesh_mod.has_mesh() else x.sum())
@@ -165,6 +166,7 @@ def _timed(op_name, fn, x, *args, **kwargs):
         return fn(x, *args, **kwargs)
     t0 = time.perf_counter()
     out = fn(x, *args, **kwargs)
+    # dstpu: ignore[DT001]: comms-logger timing fence — only runs when logging is enabled, and a fence is what makes the timing honest
     jax.block_until_ready(out)
     comms_logger.append(op_name, _nbytes(x), time.perf_counter() - t0)
     return out
@@ -454,6 +456,7 @@ def _coalesced(op_name, tensors, op, axis, group):
     t0 = time.perf_counter()
     outs = fn(*[jnp.asarray(t) for t in tensors])
     if comms_logger.enabled:
+        # dstpu: ignore[DT001]: comms-logger timing fence — enabled-only, honest timing needs the drain
         jax.block_until_ready(outs)
         comms_logger.append(op_name, sum(_nbytes(t) for t in tensors),
                             time.perf_counter() - t0)

@@ -327,6 +327,7 @@ class InferenceEngine:
         out = self._generate_jit(self.params, tokens, cache, prompt_len,
                                  max_new_bucket, rng,
                                  jnp.int32(eos), jnp.int32(pad_token_id))
+        # dstpu: ignore[DT001]: generate() API boundary — the whole rollout returns to the host caller in one transfer
         return np.asarray(jax.device_get(out))[:, :max_new_tokens]
 
     def serving(self, **overrides):

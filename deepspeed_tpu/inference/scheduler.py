@@ -1156,6 +1156,7 @@ class ServingEngine:
             tgt, self.pool = self._verify_step(self.engine.params, toks,
                                                pos, self.pool, tables,
                                                self._next_rng())
+            # dstpu: ignore[DT001]: THE one host roundtrip per verify step — acceptance runs host-side, amortized over k+1 tokens x all slots
             tgt = np.asarray(jax.device_get(tgt))       # [S, draft_k+1]
         t1 = self._clock() if tr_on else 0.0
         self.verify_calls += 1
@@ -1287,6 +1288,7 @@ class ServingEngine:
                     # the first sampled token is EOS or max_new == 1 — the
                     # router then sees a normal completion from this engine
                     slot.state = _HANDOFF if slot.prefill_only else _DECODE
+                    # dstpu: ignore[DT001]: first-token readback at prefill completion — one scalar per prompt, the TTFT emission point
                     self._emit(slot, int(np.asarray(tok)[0]), finished)
 
         # decode: ONE fixed-shape call for every slot; non-decoding slots
@@ -1329,6 +1331,7 @@ class ServingEngine:
                     nxt, self.pool = step_fn(params, tok, pos,
                                              self.pool, tables,
                                              self._next_rng())
+                    # dstpu: ignore[DT001]: THE one host roundtrip per decode window — EOS/retirement decisions are host-side, amortized over `win` tokens
                     nxt = np.asarray(jax.device_get(nxt))   # [S, win]
                 t1 = self._clock() if tr_on else 0.0
                 self.decode_steps += 1

@@ -244,6 +244,7 @@ class DraftModelDrafter(Drafter):
         drafts, self.pool = self._draft_steps(self.params, jnp.asarray(tok0),
                                               jnp.asarray(pos), self.pool,
                                               jnp.asarray(tables))
+        # dstpu: ignore[DT001]: drafts are consumed host-side by accept_greedy — one readback per verify, amortized over k drafts x all slots
         drafts = np.asarray(jax.device_get(drafts))
         lens = np.zeros((tok0.shape[0],), np.int32)
         for s in dec_slots:

@@ -46,6 +46,7 @@ def _peak_flops():
 def cost_analysis(fn, *args, **kwargs):
     """Compile `fn` for the given args and return XLA's cost analysis dict."""
     import jax
+    # dstpu: ignore[DT004]: the profiler's job is a fresh lower+compile — it MEASURES compilation, it doesn't serve from it
     jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
     compiled = jitted.lower(*args, **kwargs).compile()
     try:
@@ -86,6 +87,7 @@ class FlopsProfiler:
         """Cost-analyze + wall-clock a jitted callable."""
         import jax
         self.analysis = cost_analysis(fn, *args, **kwargs)
+        # dstpu: ignore[DT004]: one-shot profiling wrapper — lives for exactly n_timing_runs calls
         jitted = fn if callable(getattr(fn, "lower", None)) else jax.jit(fn)
         out = jitted(*args, **kwargs)          # compile+warm
         jax.tree_util.tree_map(lambda x: None, out)
@@ -189,6 +191,7 @@ class ModuleProfile:
     def of(cls, name, fn, abstract_args, multiplier=1, params=0):
         """Cost-analyze `fn` lowered against ShapeDtypeStructs."""
         import jax
+        # dstpu: ignore[DT004]: abstract cost analysis — lowered against ShapeDtypeStructs once, never executed
         analysis = cost_analysis(jax.jit(fn), *abstract_args)
         return cls(name, analysis.get("flops", 0.0), params, multiplier)
 

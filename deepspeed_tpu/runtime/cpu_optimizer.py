@@ -40,6 +40,7 @@ class HostOffloadOptimizer:
 
         leaves, self.treedef = jax.tree_util.tree_flatten(params)
         self.shapes = [l.shape for l in leaves]
+        # dstpu: ignore[DT001]: host-offload tier — the fp32 master lives in host RAM by design (built once)
         self.master = [np.asarray(jax.device_get(l), np.float32).copy() for l in leaves]
 
         self.nvme = None
@@ -69,6 +70,7 @@ class HostOffloadOptimizer:
         master params as a pytree of numpy fp32."""
         self.step_count += 1
         lr = self._current_lr()
+        # dstpu: ignore[DT001]: host-offload tier — grads MUST land in host RAM for the C++ optimizer; the sync is the design
         grads = [np.asarray(jax.device_get(g), np.float32)
                  for g in jax.tree_util.tree_flatten(grads_tree)[0]]
 

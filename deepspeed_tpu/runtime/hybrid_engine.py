@@ -111,6 +111,7 @@ class HybridEngine(Engine):
                 self.generate_count)
         self._gen_timer("generate").start()
         out = self._generate_fn(self.state.params, tokens, cache, prompt_len, rng)
+        # dstpu: ignore[DT001]: rollout API boundary — RLHF consumers take host tokens, one transfer per generate()
         out = np.asarray(jax.device_get(out))
         self._gen_timer("generate").stop()
         self.generate_count += 1

@@ -28,6 +28,7 @@ def materialize_sharded(init_fn, shardings, *args, **kwargs):
     `shardings`: pytree of NamedSharding matching init_fn's output (e.g. from
     ZeroShardingPolicy.param_shardings over abstract_init's result).
     """
+    # dstpu: ignore[DT004]: one-shot sharded-init program — runs once per engine build, sharded placement at creation is the point
     return jax.jit(init_fn, out_shardings=shardings)(*args, **kwargs)
 
 

@@ -91,6 +91,13 @@ def pytest_configure(config):
                    "CPU-harness-safe, rides in tier-1; run it alone with "
                    "pytest -m memscope)")
     config.addinivalue_line(
+        "markers", "lint: dstpu_lint static-analysis suite "
+                   "(tests/test_lint.py — per-rule firing + near-miss "
+                   "fixtures, pragma grammar, baseline ratchet, and the "
+                   "repo self-check that fails on any non-baselined "
+                   "DT001-DT005 finding) — fast and CPU-harness-safe, "
+                   "rides in tier-1; run it alone with pytest -m lint)")
+    config.addinivalue_line(
         "markers", "chaos: self-healing serving pool suite "
                    "(tests/test_selfheal.py — KV-pool invariant auditor + "
                    "repair, hung-replica watchdog, hard deadlines, hedged "

@@ -1,0 +1,557 @@
+"""`dstpu_lint` static-analysis suite (deepspeed_tpu/analysis/).
+
+Per-rule fixture pairs — one known-bad snippet that MUST fire, one
+near-miss that must NOT — plus pragma-grammar units, baseline-ratchet
+units, CLI output stability, and the repo self-check: the full DT001-
+DT005 rule set over this very tree must produce zero non-baselined
+findings (fix it, pragma it with a reason, or shrink the baseline).
+
+Everything rides the `lint` marker (tier-1; run alone with
+`pytest -m lint`).
+"""
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.analysis import baseline as baseline_mod
+from deepspeed_tpu.analysis.core import all_rules, run_lint
+from deepspeed_tpu.analysis.cli import main as lint_main
+from deepspeed_tpu.analysis.rules_catalog import catalog_findings
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = pathlib.Path(deepspeed_tpu.__file__).resolve().parent.parent
+
+
+# the per-file AST rules — fixture trees use these (DT005's
+# project-level scan belongs to the real repo, not a synthetic one)
+AST_RULES = ["DT001", "DT002", "DT003", "DT004"]
+
+
+def lint_tree(tmp_path, files, rules, check_unused=None):
+    """Write {repo-relative path: source} under tmp_path and lint it
+    with an explicit rule subset."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_lint(tmp_path, targets=["deepspeed_tpu"], rule_ids=rules,
+                    check_unused=check_unused)
+
+
+def rules_of(report):
+    return [f.rule for f in report.sorted_findings()]
+
+
+# ----------------------------------------------------------------------
+# DT001 host-sync-in-hot-path
+# ----------------------------------------------------------------------
+
+
+def test_dt001_fires_on_syncs_in_hot_path(tmp_path):
+    report = lint_tree(tmp_path, {"deepspeed_tpu/inference/x.py": """
+        import jax
+        import numpy as np
+
+        class Eng:
+            def __init__(self, f):
+                self._step = jax.jit(f, donate_argnums=(0,))
+
+            def run(self, pool, y):
+                out, pool = self._step(pool)
+                a = y.item()                  # sync 1
+                b = jax.device_get(out)       # sync 2
+                jax.block_until_ready(out)    # sync 3
+                c = np.asarray(out)           # sync 4: tainted name
+                return a, b, c
+        """}, rules=["DT001"])
+    assert rules_of(report) == ["DT001"] * 4
+    msgs = " | ".join(f.message for f in report.findings)
+    assert ".item()" in msgs and "device_get" in msgs
+    assert "block_until_ready" in msgs and "'out'" in msgs
+
+
+def test_dt001_near_misses_stay_silent(tmp_path):
+    report = lint_tree(tmp_path, {
+        # same constructs OUTSIDE the hot paths: allowed by scope
+        "deepspeed_tpu/telemetry/x.py": """
+        import jax
+        def snapshot(v):
+            return v.item(), jax.device_get(v)
+        """,
+        # host-data np.asarray in scope: no taint, no finding; and
+        # np.asarray(jax.device_get(x)) reports the device_get ONCE,
+        # not an extra asarray finding
+        "deepspeed_tpu/serving/y.py": """
+        import jax
+        import numpy as np
+        def pack(tokens, dev):
+            host = np.asarray(tokens, np.int32)
+            once = np.asarray(jax.device_get(dev))
+            return host, once
+        """,
+        # a rebind clears the taint: asarray on the rebound host value
+        # is clean
+        "deepspeed_tpu/inference/z.py": """
+        import jax
+        import numpy as np
+        _step = jax.jit(lambda p: p, donate_argnums=(0,))
+        def go(pool):
+            out = _step(pool)
+            out = np.zeros((4,), np.int32)
+            return np.asarray(out)
+        """}, rules=["DT001"])
+    assert rules_of(report) == ["DT001"]          # only the device_get
+    assert "device_get" in report.findings[0].message
+
+
+# ----------------------------------------------------------------------
+# DT002 clock-injection
+# ----------------------------------------------------------------------
+
+
+def test_dt002_fires_on_wall_clock_calls(tmp_path):
+    report = lint_tree(tmp_path, {"deepspeed_tpu/serving/r.py": """
+        import time
+        from time import monotonic as mono
+
+        def admit(self, req):
+            req.t0 = time.time()
+            req.t1 = mono()
+        """}, rules=["DT002"])
+    assert rules_of(report) == ["DT002", "DT002"]
+    assert "injectable clock" in report.findings[0].message
+
+
+def test_dt002_near_misses_stay_silent(tmp_path):
+    report = lint_tree(tmp_path, {
+        # the sanctioned default-binding idiom REFERENCES the function
+        "deepspeed_tpu/inference/s.py": """
+        import time
+        class Engine:
+            def __init__(self, clock=None):
+                self._clock = clock if clock is not None else time.monotonic
+            def now(self):
+                return self._clock()
+        """,
+        # wall clocks outside serving//inference/ are allowed: the
+        # telemetry layer IS the wall-clock layer
+        "deepspeed_tpu/telemetry/t.py": """
+        import time
+        def stamp():
+            return time.time()
+        """}, rules=["DT002"])
+    assert report.findings == []
+
+
+# ----------------------------------------------------------------------
+# DT003 donation-safety
+# ----------------------------------------------------------------------
+
+
+def test_dt003_fires_on_read_after_donation(tmp_path):
+    report = lint_tree(tmp_path, {"deepspeed_tpu/inference/d.py": """
+        import jax
+        _step = jax.jit(lambda p, t: (t, p), donate_argnums=(0,))
+
+        def bad(pool, tok):
+            out = _step(pool, tok)
+            return pool.sum()          # pool was donated: dead buffer
+        """}, rules=["DT003"])
+    assert rules_of(report) == ["DT003"]
+    f = report.findings[0]
+    assert "'pool'" in f.message and "donated" in f.message
+    assert f.snippet == "return pool.sum()          # pool was donated: dead buffer"
+
+
+def test_dt003_rebind_before_reread_is_clean(tmp_path):
+    report = lint_tree(tmp_path, {"deepspeed_tpu/inference/d2.py": """
+        import jax
+        _step = jax.jit(lambda p, t: (t, p), donate_argnums=(0,))
+
+        class Eng:
+            def good(self, tok):
+                # the sanctioned idiom: donate + rebind in one statement
+                tok, self.pool = _step(self.pool, tok)
+                tok, self.pool = _step(self.pool, tok)
+                return self.pool.shape
+        """}, rules=["DT003"])
+    assert report.findings == []
+
+
+def test_dt003_loop_backedge_donation(tmp_path):
+    report = lint_tree(tmp_path, {"deepspeed_tpu/inference/d3.py": """
+        import jax
+        _step = jax.jit(lambda p: p, donate_argnums=(0,))
+
+        def bad_loop(pool, n):
+            outs = []
+            for _ in range(n):
+                outs.append(_step(pool))   # donated, never rebound:
+            return outs                    # iteration 2 reads a corpse
+        """}, rules=["DT003"])
+    assert rules_of(report) == ["DT003"]
+    assert "loop" in report.findings[0].message
+
+
+def test_dt003_factory_registered_program(tmp_path):
+    # a factory returning jax.jit(..., donate_argnums=...) registers its
+    # call-site assignments as donating callables (build_draft_program)
+    report = lint_tree(tmp_path, {"deepspeed_tpu/inference/d4.py": """
+        import jax
+
+        def build(fn, k):
+            return jax.jit(fn, donate_argnums=(1,))
+
+        class Drafter:
+            def __init__(self, fn):
+                self._draft = build(fn, 4)
+
+            def bad(self, params, pool):
+                drafts = self._draft(params, pool)
+                return pool.mean()
+        """}, rules=["DT003"])
+    assert rules_of(report) == ["DT003"]
+
+
+# ----------------------------------------------------------------------
+# DT004 recompile-hazard
+# ----------------------------------------------------------------------
+
+
+def test_dt004_fires_on_loop_and_per_step_jit(tmp_path):
+    report = lint_tree(tmp_path, {"deepspeed_tpu/models/m.py": """
+        import jax
+
+        def sweep(fns, x):
+            outs = []
+            for f in fns:
+                outs.append(jax.jit(f)(x))        # loop body
+            return outs
+
+        class Eng:
+            def step(self, batch):
+                return jax.jit(self._fwd)(batch)  # per-step, no guard
+        """}, rules=["DT004"])
+    assert rules_of(report) == ["DT004", "DT004"]
+    assert "loop body" in report.findings[0].message
+    assert "'step'" in report.findings[1].message
+
+
+def test_dt004_sanctioned_construction_sites_are_clean(tmp_path):
+    report = lint_tree(tmp_path, {"deepspeed_tpu/models/ok.py": """
+        import jax
+
+        _mod_level = jax.jit(lambda x: x)         # module level
+
+        def build_program(fn):
+            return jax.jit(fn)                    # factory returns it
+
+        class Eng:
+            def __init__(self, fn):
+                self._step = jax.jit(fn)          # ctor
+                self._lazy = None
+
+            def _make_variant(self, fn):
+                return jax.jit(fn)                # builder name
+
+            def degraded(self, fn):
+                if self._lazy is None:            # caching guard
+                    self._lazy = jax.jit(fn)
+                return self._lazy
+        """}, rules=["DT004"])
+    assert report.findings == []
+
+
+def test_dt004_unhashable_static_default(tmp_path):
+    report = lint_tree(tmp_path, {"deepspeed_tpu/models/s.py": """
+        import jax
+
+        def fwd(x, shapes=[1, 2, 3]):
+            return x
+
+        def build():
+            return jax.jit(fwd, static_argnums=(1,))
+        """}, rules=["DT004"])
+    assert rules_of(report) == ["DT004"]
+    assert "unhashable" in report.findings[0].message
+
+
+# ----------------------------------------------------------------------
+# DT005 metric-catalog (the shared implementation)
+# ----------------------------------------------------------------------
+
+
+def test_dt005_detects_drift_against_synthetic_catalog(tmp_path):
+    # real code tree + a synthetic catalog that misses every metric and
+    # carries one dead row -> both drift directions fire
+    fake = tmp_path / "profiling.md"
+    fake.write_text("### Metric catalog\n\n| `ghost/metric` | a row "
+                    "with no recording site |\n\n### Next section\n")
+    findings = catalog_findings(REPO_ROOT, docs_path=fake)
+    assert findings, "synthetic catalog must drift"
+    msgs = [f.message for f in findings]
+    assert any("ghost/metric" in m and "no recording site" in m
+               for m in msgs)
+    assert any("missing from" in m for m in msgs)
+    # and the real catalog is clean — same code path the CLI runs
+    assert catalog_findings(REPO_ROOT) == []
+
+
+def test_dt005_is_the_single_implementation():
+    """The telemetry test must consume the rule, not a private copy: the
+    old inline scan body (regex + dynamic-set assembly) may exist in
+    exactly one place, deepspeed_tpu/analysis/rules_catalog.py."""
+    tel = (REPO_ROOT / "tests" / "test_telemetry.py").read_text()
+    assert "catalog_findings" in tel
+    assert "set_gauge|histogram" not in tel     # the scan regex moved out
+
+
+# ----------------------------------------------------------------------
+# pragmas
+# ----------------------------------------------------------------------
+
+
+def test_pragma_suppresses_with_reason_trailing_and_standalone(tmp_path):
+    report = lint_tree(tmp_path, {"deepspeed_tpu/inference/p.py": """
+        import jax
+
+        def fence(v):
+            jax.block_until_ready(v)  # dstpu: ignore[DT001]: test fence
+            # dstpu: ignore[DT001]: standalone form covers the next line
+            return jax.device_get(v)
+        """}, rules=["DT001"])
+    assert report.findings == []
+    assert len(report.suppressed) == 2
+    assert all(p.reason for _, p in report.suppressed)
+
+
+def test_pragma_without_reason_does_not_suppress(tmp_path):
+    report = lint_tree(tmp_path, {"deepspeed_tpu/inference/p2.py": """
+        import jax
+
+        def fence(v):
+            return jax.device_get(v)  # dstpu: ignore[DT001]
+        """}, rules=AST_RULES)
+    rules = rules_of(report)
+    assert "DT001" in rules                      # still fires
+    assert "DT000" in rules                      # and the pragma is flagged
+    assert any("no reason string" in f.message for f in report.findings)
+
+
+def test_pragma_unknown_rule_and_unused_are_dt000(tmp_path):
+    report = lint_tree(tmp_path, {"deepspeed_tpu/inference/p3.py": """
+        def a():
+            return 1  # dstpu: ignore[DT999]: no such rule
+        def b():
+            return 2  # dstpu: ignore[DT001]: nothing to suppress here
+        """}, rules=AST_RULES, check_unused=True)
+    assert rules_of(report) == ["DT000", "DT000"]
+    msgs = " | ".join(f.message for f in report.findings)
+    assert "unknown" in msgs and "unused pragma" in msgs
+
+
+def test_pragma_grammar_in_strings_is_inert(tmp_path):
+    # the grammar quoted in a docstring or f-string is documentation,
+    # not a pragma — only real COMMENT tokens parse
+    report = lint_tree(tmp_path, {"deepspeed_tpu/inference/p4.py": '''
+        DOC = """use `# dstpu: ignore[DT001]: reason` to suppress"""
+
+        def render(rule):
+            return f"# dstpu: ignore[{rule}]"
+        '''}, rules=AST_RULES, check_unused=True)
+    assert report.findings == []
+
+
+# ----------------------------------------------------------------------
+# baseline ratchet
+# ----------------------------------------------------------------------
+
+
+def _findings(tmp_path, src):
+    return lint_tree(tmp_path, {"deepspeed_tpu/inference/b.py": src},
+                     rules=["DT001"]).sorted_findings()
+
+
+_TWO_SYNCS = """
+    import jax
+    def f(v, w):
+        a = jax.device_get(v)
+        b = jax.device_get(w)
+        return a, b
+"""
+
+
+def test_baseline_grandfathers_and_ratchets(tmp_path):
+    findings = _findings(tmp_path, _TWO_SYNCS)
+    assert len(findings) == 2
+    baseline = {}
+    for f in findings:
+        baseline[f.key()] = baseline.get(f.key(), 0) + 1
+
+    # grandfathered: identical findings pass
+    new, old, stale = baseline_mod.split(findings, baseline)
+    assert (len(new), len(old), stale) == (0, 2, [])
+
+    # a THIRD occurrence of a baselined fingerprint is NEW, not covered
+    f3 = findings[0]
+    import dataclasses
+    extra = dataclasses.replace(f3, line=f3.line + 40)
+    new, old, stale = baseline_mod.split(findings + [extra], baseline)
+    assert len(new) == 1 and len(old) == 2
+
+    # stale: fixing one finding leaves unused allowance -> must shrink
+    new, old, stale = baseline_mod.split(findings[:1], baseline)
+    assert len(stale) == 1
+
+    # shrink: drops the fixed entry, keeps the live one, refuses to add
+    novel = dataclasses.replace(f3, rule="DT004", snippet="zzz")
+    shrunk = baseline_mod.shrink(findings[:1] + [novel], baseline)
+    assert shrunk == {findings[0].key(): 1}      # novel never enters
+
+
+def test_baseline_write_load_round_trip_and_determinism(tmp_path):
+    findings = _findings(tmp_path, _TWO_SYNCS)
+    baseline = {f.key(): 1 for f in findings}
+    p1, p2 = tmp_path / "a.json", tmp_path / "b.json"
+    baseline_mod.write(baseline, p1)
+    baseline_mod.write(dict(reversed(list(baseline.items()))), p2)
+    assert p1.read_text() == p2.read_text()      # key order irrelevant
+    assert baseline_mod.load(p1) == baseline
+    assert baseline_mod.load(tmp_path / "missing.json") == {}
+
+
+# ----------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------
+
+
+def _write_bad_tree(tmp_path):
+    (tmp_path / "deepspeed_tpu" / "inference").mkdir(parents=True)
+    (tmp_path / "deepspeed_tpu" / "inference" / "bad.py").write_text(
+        textwrap.dedent("""
+        import jax
+        def f(v):
+            return jax.device_get(v)
+        """))
+
+
+def test_cli_exit_codes_and_baseline_seed_then_shrink(tmp_path, capsys):
+    _write_bad_tree(tmp_path)
+    bl = tmp_path / "bl.json"
+    args = ["--root", str(tmp_path), "--rules", "DT001",
+            "--baseline-file", str(bl)]
+
+    assert lint_main(args) == 1                  # finding, no baseline
+    assert lint_main(args + ["--baseline"]) == 0  # seeds
+    assert json.loads(bl.read_text())["entries"][0]["rule"] == "DT001"
+    assert lint_main(args) == 0                  # grandfathered now
+
+    # fix the finding -> stale entry fails until --baseline shrinks
+    (tmp_path / "deepspeed_tpu" / "inference" / "bad.py").write_text(
+        "def f(v):\n    return v\n")
+    assert lint_main(args) == 1
+    capsys.readouterr()
+    assert lint_main(args + ["--baseline"]) == 0
+    assert json.loads(bl.read_text())["entries"] == []   # shrunk empty
+    assert lint_main(args) == 0
+
+
+def test_cli_json_output_is_stable_and_sorted(tmp_path, capsys):
+    _write_bad_tree(tmp_path)
+    (tmp_path / "deepspeed_tpu" / "inference" / "bad2.py").write_text(
+        textwrap.dedent("""
+        import jax
+        def g(v):
+            v.item()
+            return jax.device_get(v)
+        """))
+    args = ["--root", str(tmp_path), "--rules", "DT001", "--json",
+            "--no-baseline"]
+    assert lint_main(args) == 1
+    out1 = capsys.readouterr().out
+    assert lint_main(args) == 1
+    out2 = capsys.readouterr().out
+    assert out1 == out2                          # byte-stable
+    payload = json.loads(out1)
+    locs = [(f["path"], f["line"], f["col"]) for f in payload["findings"]]
+    assert locs == sorted(locs)
+    assert payload["ok"] is False
+    assert payload["schema_version"] == 1
+
+
+def test_cli_unknown_rule_is_usage_error(tmp_path, capsys):
+    assert lint_main(["--root", str(tmp_path), "--rules", "DT777"]) == 2
+
+
+def test_cli_nonexistent_target_is_usage_error(capsys):
+    # a typo'd CI path must fail loudly, not scan zero files and pass
+    assert lint_main(["--root", str(REPO_ROOT),
+                      "deepspeed_tpu/sevring", "--no-baseline"]) == 2
+    assert "does not exist" in capsys.readouterr().err
+
+
+def test_cli_scoped_runs_leave_out_of_scope_baseline_alone(tmp_path,
+                                                           capsys):
+    # two findings of different rules in different files, both baselined
+    _write_bad_tree(tmp_path)                    # DT001 in inference/
+    (tmp_path / "deepspeed_tpu" / "models").mkdir(parents=True)
+    (tmp_path / "deepspeed_tpu" / "models" / "m.py").write_text(
+        textwrap.dedent("""
+        import jax
+        def step(self, b):
+            return jax.jit(self._f)(b)
+        """))
+    bl = tmp_path / "bl.json"
+    base = ["--root", str(tmp_path), "--baseline-file", str(bl)]
+    assert lint_main(base + ["--rules", "DT001,DT004", "--baseline"]) == 0
+    assert len(json.loads(bl.read_text())["entries"]) == 2
+
+    # a rule-filtered run must NOT call the DT004 entry stale (exit 0),
+    # and a path-scoped run must NOT call the other file's entry stale
+    assert lint_main(base + ["--rules", "DT001"]) == 0
+    assert lint_main(base + ["--rules", "DT004",
+                             "deepspeed_tpu/models"]) == 0
+
+    # a scoped --baseline update must not destroy out-of-scope entries
+    assert lint_main(base + ["--rules", "DT001", "--baseline"]) == 0
+    kept = {e["rule"] for e in json.loads(bl.read_text())["entries"]}
+    assert kept == {"DT001", "DT004"}
+
+    # and --baseline with --no-baseline is refused outright
+    assert lint_main(base + ["--baseline", "--no-baseline"]) == 2
+
+
+# ----------------------------------------------------------------------
+# the repo self-check: the acceptance gate for every future PR
+# ----------------------------------------------------------------------
+
+
+def test_repo_self_check_full_rule_set():
+    """The whole tree, all rules, the checked-in baseline: zero
+    non-baselined findings and zero stale entries. A new finding means
+    fix it, pragma it with a reason, or (outside serving//inference/)
+    grandfather it by hand-editing lint_baseline.json — which a
+    reviewer sees."""
+    report = run_lint(REPO_ROOT)
+    baseline = baseline_mod.load()
+    new, grandfathered, stale = baseline_mod.split(
+        report.sorted_findings(), baseline)
+    assert not new, "non-baselined lint findings:\n" + "\n".join(
+        f.render() for f in new)
+    assert not stale, (
+        f"stale lint_baseline.json entries (the finding is gone — run "
+        f"`bin/dstpu_lint --baseline` to shrink): {stale}")
+    # the suppressions that keep this green are all reasoned
+    assert all(p.reason for _, p in report.suppressed)
+
+
+def test_registry_has_the_five_rules():
+    rules = all_rules()
+    assert sorted(rules) == ["DT001", "DT002", "DT003", "DT004", "DT005"]
+    assert rules["DT005"].project_level
+    assert all(r.description for r in rules.values())

@@ -431,49 +431,19 @@ def test_metric_catalog_lint():
     """The docs/profiling.md metric catalog and the source tree must agree:
     every literal metric name recorded through the telemetry facade (or a
     registry handle) appears in the catalog, and every catalog row names a
-    metric that still exists (no dead rows). Dynamically composed names
-    (f-string router counters, per-replica TTFT, record_events routing)
-    are enumerated explicitly — growing one means growing its doc row."""
+    metric that still exists (no dead rows). The check itself lives in ONE
+    place — `deepspeed_tpu.analysis.rules_catalog` (rule DT005), shared
+    with `bin/dstpu_lint` — so the CLI and this test can never drift; the
+    dynamic-name escape hatch (router counters, LEDGER_GAUGES, record_events
+    routing) is enumerated there."""
     import pathlib
-    import re
 
-    root = pathlib.Path(deepspeed_tpu.__file__).parent
-    pat = re.compile(
-        r'\.(?:inc|observe|set_gauge|histogram|gauge|counter)'
-        r'\(\s*"([^"\s]+/[^"\s]+)"')
-    code_names = set()
-    for p in root.rglob("*.py"):
-        code_names |= {m.group(1) for m in pat.finditer(p.read_text())}
-    assert code_names, "the scan regex found nothing — did the facade move?"
+    from deepspeed_tpu.analysis.rules_catalog import catalog_findings
 
-    # names the regex cannot see because they are composed at runtime
-    from deepspeed_tpu.serving import ServingRouter
-    router_counters = ServingRouter(replicas=[]).counters
-    dynamic = {f"router/{k}" for k in router_counters}
-    dynamic |= {
-        "router/replica/<rid>/ttft_ms",   # per-replica, rid interpolated
-        "train/hbm_bytes_in_use",         # gauge set via a (src, dst) table
-        "train/hbm_peak_bytes",
-        "Checkpoint/save_ms",             # routed through record_events
-    }
-    # the memscope ledger publishes its gauges through one loop over the
-    # snapshot dict; LEDGER_GAUGES is its authoritative name list
-    from deepspeed_tpu.telemetry import memscope as memscope_mod
-    dynamic |= {f"mem/{k}" for k in memscope_mod.LEDGER_GAUGES}
-
-    doc = (root.parent / "docs" / "profiling.md").read_text()
-    section = doc.split("### Metric catalog")[1].split("###")[0]
-    doc_names = set(re.findall(r"`([^`\s]+/[^`\s]+)`", section))
-    doc_names -= {n for n in doc_names if n.startswith("docs/")}  # links
-
-    undocumented = code_names - doc_names
-    assert not undocumented, \
-        f"metrics recorded in code but missing from the " \
-        f"docs/profiling.md catalog: {sorted(undocumented)}"
-    dead_rows = doc_names - code_names - dynamic
-    assert not dead_rows, \
-        f"docs/profiling.md catalog rows with no recording site left in " \
-        f"the tree: {sorted(dead_rows)}"
+    repo_root = pathlib.Path(deepspeed_tpu.__file__).parent.parent
+    findings = catalog_findings(repo_root)
+    assert not findings, "metric catalog drift:\n" + "\n".join(
+        f.render() for f in findings)
 
 
 def test_disabled_telemetry_is_total_noop(tmp_path, monkeypatch):
