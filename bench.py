@@ -53,10 +53,13 @@ Four lanes per run:
      generate() on the SAME ragged mixed prompt/output-length trace;
      vs_baseline is the aggregate-tokens/s speedup of continuous over
      static (the convoy + recompile tax made visible). The same gate also
-     carries the prefix-cache, spec-decode, router, and robustness
-     sub-lanes (the last: a fixed chaos schedule through the self-healing
-     pool — completion rate, hedge wins, deadline cancellations,
-     degradation-level occupancy, watchdog-vs-hedging recovery TTFT).
+     carries the quantized (BENCH_QUANT=0 to disable: int8 KV pool + int8
+     weight-only vs bf16 — before/after memory ledgers, planner
+     max_kv_blocks ratio, tokens/s), prefix-cache, spec-decode, router,
+     and robustness sub-lanes (the last: a fixed chaos schedule through
+     the self-healing pool — completion rate, hedge wins, deadline
+     cancellations, degradation-level occupancy, watchdog-vs-hedging
+     recovery TTFT).
   1c. bert (BENCH_BERT=0 to disable): bert-large MLM on the reference's
      fastest-BERT shapes (seq 128 / mbs 128 and seq 512 / mbs 16) — raw
      samples/s vs the V100 272/52 headline plus MFU on both chips' own
@@ -547,6 +550,118 @@ def run_serving_lane(steps=1, warmup=1):
             # HBM ledger: pool vs params bytes — the baseline trajectory
             # the quantized-KV roadmap item has to beat
             "memory": _memory_extra(serving),
+        },
+    }
+    print(json.dumps(result))
+    return result
+
+
+def run_quant_serving_lane():
+    """QUANTIZED-SERVING lane (BENCH_SERVING + BENCH_QUANT gates): the same
+    ragged trace through a bf16-resident engine and through a fully
+    quantized one (int8 KV pool + int8 weight-only), reporting tokens/s
+    for both plus the before/after `extra.memory` ledgers — the direct
+    proof of the quantized-serving tentpole's two claims: (1) CAPACITY —
+    the planner's `max_kv_blocks` at a fixed budget roughly doubles
+    (extra.max_kv_blocks_*: the exact ratio is 2/(1+4/g), ~1.94x at group
+    128 — scales are not free), measured next to the real pools' byte
+    ledgers; (2) SPEED — decode is HBM-bandwidth-bound, so on real HBM the
+    quantized residents stream ~half the bytes per step (the CPU harness
+    emulates none of that; its vs_baseline mostly shows the quantize/
+    dequantize compute overhead, which is what fuses away on TPU).
+    Greedy parity between the two engines rides in extra.parity_fraction
+    (int8 KV is lossy; tier-1 pins the kernel-vs-oracle identity instead)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.inference.engine import init_inference
+    from deepspeed_tpu.inference.scheduler import Request
+    from deepspeed_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                          make_gpt_decode_model)
+    from deepspeed_tpu.telemetry.memscope import max_kv_blocks
+
+    n_req = int(os.environ.get("BENCH_QUANT_REQUESTS", "16"))
+    slots = int(os.environ.get("BENCH_QUANT_SLOTS", "8"))
+    # leaner than the serving lane's model (spec-decode-lane precedent):
+    # this lane pays the trace twice (bf16 + quantized), and the byte
+    # ledgers/planner ratios it exists to record are geometry-exact at any
+    # size — only the tokens/s column prefers bulk
+    cfg = GPTConfig(n_layer=4, n_head=8, n_kv_head=4, d_model=512,
+                    max_seq_len=1024, vocab_size=50304, remat=False,
+                    use_rotary=True)
+    params = init_gpt_params(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    prompts, news = _serving_trace(rng, n_req, cfg.vocab_size)
+    reqs = [Request(uid=i, tokens=p, max_new_tokens=n, stop_on_eos=False)
+            for i, (p, n) in enumerate(zip(prompts, news))]
+
+    def run_engine(quantization):
+        mesh_mod._CURRENT_MESH = None
+        mesh_mod._CURRENT_SPEC = None
+        spec = make_gpt_decode_model(cfg=cfg, params=jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16), params))
+        engine = init_inference(model=spec, config={
+            "dtype": "bfloat16", "kv_cache_dtype": "bfloat16",
+            "greedy": True, "kv_block_size": 128, "max_out_tokens": 1024,
+            "telemetry": {"enabled": True, "prometheus": False,
+                          "jsonl": False, "monitor_bridge": False,
+                          "memscope": True, "memscope_programs": False}})
+        serving = engine.serving(max_slots=slots, max_context=1024,
+                                 prefill_chunk=256,
+                                 quantization=quantization)
+        t0 = time.perf_counter()
+        res = serving.run(reqs)
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in res.values())
+        return {"tokens_per_sec": round(toks / dt, 1),
+                "wall_s": round(dt, 2),
+                "memory": _memory_extra(serving),
+                "compiles": serving.compile_stats(),
+                "quant": serving.stats().get("quantization"),
+                "tokens": {u: r.tokens for u, r in res.items()}}
+
+    base = run_engine({})
+    quant = run_engine({"kv_cache_dtype": "int8", "weights": "int8"})
+    parity = np.mean([
+        float(np.mean(np.asarray(base["tokens"][u])
+                      == np.asarray(quant["tokens"][u])))
+        for u in base["tokens"]])
+    for r in (base, quant):
+        del r["tokens"]
+
+    # the capacity headline at a fixed budget, planner-math exact: same
+    # HBM, same weights, how many more KV blocks does int8 buy
+    cap = 16 * 2**30
+    plan_kw = dict(n_layer=cfg.n_layer, n_kv_head=cfg.n_kv_head,
+                   head_dim=cfg.head_dim, kv_block_size=128,
+                   params_bytes=base["memory"].get("params_bytes", 0))
+    blocks_bf16 = max_kv_blocks(cap, kv_cache_dtype="bfloat16", **plan_kw)
+    blocks_int8 = max_kv_blocks(cap, kv_cache_dtype="int8", **plan_kw)
+
+    result = {
+        "metric": "gpt_quant_serving_tokens_per_sec",
+        "value": quant["tokens_per_sec"],
+        "unit": "tokens/s",
+        # quantized-over-bf16 end-to-end tokens/s on identical work (see
+        # the docstring caveat: meaningful on real HBM, compute-skewed on
+        # the CPU harness)
+        "vs_baseline": round(quant["tokens_per_sec"]
+                             / base["tokens_per_sec"], 4),
+        "extra": {
+            "requests": n_req, "slots": slots,
+            "bf16": base, "int8": quant,
+            "kv_pool_bytes_ratio": round(
+                base["memory"].get("kv_pool_bytes", 0)
+                / max(1, quant["memory"].get("kv_pool_bytes", 1)), 3),
+            "weight_bytes_ratio": round(
+                base["memory"].get("params_bytes", 0)
+                / max(1, quant["memory"].get("params_bytes", 1)), 3),
+            "max_kv_blocks_bf16_at_16G": blocks_bf16,
+            "max_kv_blocks_int8_at_16G": blocks_int8,
+            "max_kv_blocks_ratio": round(blocks_int8 / max(1, blocks_bf16),
+                                         3),
+            "parity_fraction": round(float(parity), 4),
         },
     }
     print(json.dumps(result))
@@ -1118,6 +1233,9 @@ def main():
     if env("BENCH_SERVING_CHILD") == "1":  # serving sub-lane child process
         run_serving_lane()
         return
+    if env("BENCH_QUANT_CHILD") == "1":   # quantized-serving sub-lane child
+        run_quant_serving_lane()
+        return
     if env("BENCH_PREFIX_CHILD") == "1":  # prefix-cache sub-lane child
         run_prefix_cache_lane()
         return
@@ -1247,6 +1365,19 @@ def main():
                                                     "8"))
         if serving is not None:
             print(json.dumps(serving))
+
+    # quantized-serving lane (BENCH_QUANT knob under the serving gate):
+    # int8 KV + int8 weights vs bf16 on the same trace — tokens/s and the
+    # before/after memory ledgers + planner max_kv_blocks ratio
+    quant = None
+    if env("BENCH_SERVING", "1") == "1" and env("BENCH_QUANT", "1") == "1" \
+            and "BENCH_MODEL" not in os.environ:
+        quant = sub_lane(
+            "quant", BENCH_QUANT_CHILD="1",
+            BENCH_QUANT_REQUESTS=env("BENCH_QUANT_REQUESTS", "16"),
+            BENCH_QUANT_SLOTS=env("BENCH_QUANT_SLOTS", "8"))
+        if quant is not None:
+            print(json.dumps(quant))
 
     # prefix-cache lane (same gate as serving): cold-vs-warm tokens/s +
     # prefill chunks saved on a shared-system-prompt trace

@@ -1,5 +1,7 @@
 from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
-from deepspeed_tpu.inference.config import TpuInferenceConfig, ServingConfig
+from deepspeed_tpu.inference.config import (ServingConfig,
+                                            ServingQuantizationConfig,
+                                            TpuInferenceConfig)
 from deepspeed_tpu.inference.scheduler import (CompletedRequest, Request,
                                                ServingEngine)
 from deepspeed_tpu.inference.kv_cache import BlockAllocator

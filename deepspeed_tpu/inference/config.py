@@ -54,6 +54,44 @@ class SpecDecodeConfig(ConfigModel):
 
 
 @dataclass
+class ServingQuantizationConfig(ConfigModel):
+    """Quantized serving (`inference/quantization.py`, the int8 paged pool).
+
+    Decode is HBM-bandwidth-bound at serving batch sizes: every step reads
+    the whole weight set plus the live KV prefix. Quantizing the RESIDENT
+    bytes therefore buys two things at once — capacity (an int8 pool holds
+    ~2x the blocks per HBM byte: more concurrent users, a bigger prefix
+    cache; int8/int4 weights let one chip hold a 2-4x-over-bf16 model, the
+    ZeRO-Inference direction) and tokens/s (the decode step streams half
+    the bytes). Both knobs change ONLY what is stored: K/V quantize at
+    cache-write time and dequantize inside the paged kernel's KV-grid walk
+    (or the gather fallback), weights dequantize inside the jitted step
+    where XLA fuses the dequant into the consuming matmul — program shapes,
+    and therefore the one-compile-per-program contract, are untouched.
+    """
+    kv_cache_dtype: str = ""      # "" = inherit the engine's kv_cache_dtype;
+                                  # "bf16"/"bfloat16" | "int8". int8 stores
+                                  # the pool as symmetric per-group int8 with
+                                  # f32 scales riding the same physical-block
+                                  # axis (scales travel with blocks through
+                                  # prefix sharing / handoff / transplant)
+    kv_group_size: int = 0        # elements per K/V scale group along
+                                  # head_dim; 0 = head_dim (one scale per
+                                  # written vector per head). Must divide
+                                  # head_dim; smaller = tighter quant, more
+                                  # scale overhead (4/g bytes per element)
+    weights: str = "off"          # "off" | "int8" | "int4": pytree-wide
+                                  # weight-only quantization at serving-
+                                  # engine build (dequantize-on-use view;
+                                  # int4 packs two values per byte). Applies
+                                  # to the ENGINE's resident params — the
+                                  # dense copy is dropped, generate() serves
+                                  # the quantized tree too
+    weight_group_size: int = 64   # elements per weight scale group (last
+                                  # dim); leaves it does not tile stay dense
+
+
+@dataclass
 class DegradationConfig(ConfigModel):
     """Graceful-degradation ladder (`serving/degradation.py`).
 
@@ -159,6 +197,12 @@ class ServingConfig(ConfigModel):
                                   # graceful-degradation ladder under
                                   # sustained pressure (see
                                   # DegradationConfig); off by default
+    quantization: ServingQuantizationConfig = field(
+        default_factory=ServingQuantizationConfig)
+                                  # quantized serving: int8 KV pool +
+                                  # weight-only int8/int4 (see
+                                  # ServingQuantizationConfig); off by
+                                  # default — bf16 pool, dense weights
     prefix_cache_policy: str = "lru"  # what happens to a cached block when
                                   # its last reader retires: "lru" parks it
                                   # on the reclaimable list (evicted oldest-

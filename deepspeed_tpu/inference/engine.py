@@ -97,7 +97,13 @@ class DecodeModelSpec:
     #     final prompt token on the last chunk; ignored on earlier chunks)
     #   decode_paged_fn(params, token[B], pos[B], pool, block_tables[B,nb])
     #       -> (logits[B,V], pool)
-    #   init_paged_pool(num_blocks, block_size, dtype) -> pool pytree
+    #   init_paged_pool(num_blocks, block_size, dtype[, kv_group_size])
+    #       -> pool pytree. dtype int8 selects the QUANTIZED pool: the
+    #     k/v payload leaves stay [L, N, Hkv, block, hd] but int8, and the
+    #     pool grows k_scale/v_scale f32 leaves [L, N, Hkv, block, hd//g]
+    #     (g = kv_group_size, 0 = head_dim) — the serving scheduler passes
+    #     the 4th arg only for int8, so 3-arg implementations keep working
+    #     for fp pools
     #   verify_paged_fn(params, tokens[B,C], pos[B], pool, block_tables[B,nb])
     #       -> (logits[B,C,V], pool)
     #     speculative-decoding verify: writes ALL C tokens' k/v at absolute
@@ -145,21 +151,21 @@ class InferenceEngine:
                 lambda _: NamedSharding(self.mesh, P()), model.params)
         params = jax.device_put(tree_cast(model.params, dtype), shardings)
 
+        self.quant_stats = None
+        self._weight_quant = None      # (bits, group_size) once quantized
+        self._fn_transform = lambda fn: fn
+        self.params = params
         if config.quant.enabled:
             # weight-only quantization: HBM keeps int8/int4, XLA fuses dequant
-            # into consumers (inference/quantization.py)
-            from deepspeed_tpu.inference.quantization import (quantize_param_tree,
-                                                              wrap_fn_dequant)
-            params, self.quant_stats = quantize_param_tree(
-                params, bits=config.quant.bits, group_size=config.quant.group_size)
-            self._fn_transform = wrap_fn_dequant
+            # into consumers (inference/quantization.py). enable_weight_quant
+            # builds the resident programs against the quantized tree, so the
+            # dense-path builds below are skipped
+            self.enable_weight_quant(bits=config.quant.bits,
+                                     group_size=config.quant.group_size)
         else:
-            self.quant_stats = None
-            self._fn_transform = lambda fn: fn
-        self.params = params
-
-        self._prefill = jax.jit(self._fn_transform(model.prefill_fn))
-        self._decode = jax.jit(self._fn_transform(model.decode_fn), donate_argnums=(3,))
+            self._prefill = jax.jit(self._fn_transform(model.prefill_fn))
+            self._decode = jax.jit(self._fn_transform(model.decode_fn),
+                                   donate_argnums=(3,))
         self._generate_jit = None
         # engine-owned KV cache: forward()/generate() reuse the zeros
         # template when (B, max_len, dtype) matches the previous call
@@ -176,6 +182,44 @@ class InferenceEngine:
                  f"quant={'int%d' % config.quant.bits if config.quant.enabled else 'off'}",
                  ranks=[0])
 
+    def enable_weight_quant(self, bits=8, group_size=64):
+        """Pytree-wide weight-only quantization of the RESIDENT params
+        (ZeroQuant-style WOQ, `inference/quantization.py`): every large
+        float matrix leaf becomes int8 (or int4 packed two-per-byte) with
+        per-group scales, and every program factory switches to the
+        dequantize-on-use view — XLA fuses the dequant into the consuming
+        matmul, so HBM holds the quantized tree and compute still runs in
+        the engine dtype. The dense tree is DROPPED (this is where the
+        2x/4x weight-memory saving comes from); the resident prefill/decode
+        programs are re-jitted against the new param pytree and the
+        generate program rebuilds lazily.
+
+        Called at engine build for `config.quant.enabled`, and by the
+        serving scheduler for `ServingConfig.quantization.weights` —
+        idempotent for matching settings, an error for conflicting ones
+        (re-quantizing already-quantized leaves would compound the error)."""
+        if self._weight_quant is not None:
+            if self._weight_quant == (int(bits), int(group_size)):
+                return self.quant_stats
+            raise ValueError(
+                f"params already quantized as int{self._weight_quant[0]} "
+                f"(group {self._weight_quant[1]}) — cannot re-quantize as "
+                f"int{bits} (group {group_size}); pick one of config.quant "
+                f"and serving.quantization.weights, or make them agree")
+        from deepspeed_tpu.inference.quantization import (quantize_param_tree,
+                                                          wrap_fn_dequant)
+        self.params, self.quant_stats = quantize_param_tree(
+            self.params, bits=int(bits), group_size=int(group_size))
+        self._weight_quant = (int(bits), int(group_size))
+        self._fn_transform = wrap_fn_dequant
+        # dstpu: ignore[DT004]: one-shot re-jit — the _weight_quant guard above makes this method run at most once per engine, exactly like __init__'s builds
+        self._prefill = jax.jit(self._fn_transform(self.model_spec.prefill_fn))
+        # dstpu: ignore[DT004]: same one-shot rebuild as the line above
+        self._decode = jax.jit(self._fn_transform(self.model_spec.decode_fn),
+                               donate_argnums=(3,))
+        self._generate_jit = None
+        return self.quant_stats
+
     def _cache_len(self, min_len):
         """Blocked KV-cache sizing: round up to whole kv_block_size blocks
         (the streaming decode kernel's DMA unit — see init_kv_cache). The
@@ -190,6 +234,13 @@ class InferenceEngine:
         HBM allocation + zero-fill per generate()); a shape change replaces
         the single retained template, so peak HBM never exceeds the old
         behavior by more than one cache."""
+        if jnp.dtype(self.config.kv_cache_dtype) == jnp.int8:
+            raise ValueError(
+                "kv_cache_dtype='int8' is a paged-pool serving feature "
+                "(ServingConfig.quantization / engine.serving()): the "
+                "contiguous generate() cache has no scale storage — serve "
+                "through the continuous-batching scheduler, or keep "
+                "kv_cache_dtype float for generate()")
         key = (int(batch), int(max_len), str(self.config.kv_cache_dtype))
         if self._cache_entry is not None and self._cache_entry[0] == key:
             self._cache_hits += 1

@@ -291,3 +291,23 @@ def gather_block_kv(pool_k_l, pool_v_l, block_tables):
     k = jnp.moveaxis(pool_k_l[block_tables], 2, 1).reshape(B, Hkv, nb * bm, hd)
     v = jnp.moveaxis(pool_v_l[block_tables], 2, 1).reshape(B, Hkv, nb * bm, hd)
     return k, v
+
+
+def gather_block_kv_dequant(pool_l, block_tables, dtype):
+    """Dequantizing gather for an INT8 paged pool layer — the quantized
+    path's XLA fallback AND the quantized kernel's parity oracle, in one
+    definition (the same role `gather_block_kv` plays for the fp pool).
+
+    `pool_l` is one layer's quantized pool slice: ``k``/``v`` int8
+    [N, Hkv, block, hd] plus ``k_scale``/``v_scale`` f32
+    [N, Hkv, block, hd//g] (the `init_paged_kv_pool` int8 layout — scales
+    ride the SAME physical-block axis as the payload, which is what lets
+    `transplant_blocks` move a block's scales with its bytes for free).
+    Gathers payload and scales through the table with the ordinary block
+    gather, then dequantizes via `quantization.dequantize_kv` — int8 × f32
+    scale, narrowed to `dtype` last, exactly the in-kernel ordering."""
+    from deepspeed_tpu.inference.quantization import dequantize_kv
+    k, v = gather_block_kv(pool_l["k"], pool_l["v"], block_tables)
+    ks, vs = gather_block_kv(pool_l["k_scale"], pool_l["v_scale"],
+                             block_tables)
+    return dequantize_kv(k, ks, dtype), dequantize_kv(v, vs, dtype)

@@ -49,11 +49,28 @@ class QuantizedTensor:
 
 
 def quantize_tensor(x, bits=8, group_size=64):
-    """x: [..., D] float → QuantizedTensor. Symmetric per-group."""
-    assert bits in (4, 8)
+    """x: [..., D] float → QuantizedTensor. Symmetric per-group.
+
+    Raises `ValueError` (not a bare assert) on inadmissible geometry so a
+    config typo surfaces as a clear message at quantize time instead of an
+    opaque traceback inside a reshape: the last dim must tile into whole
+    `group_size` groups (each group shares one scale — a ragged tail would
+    need a second scale grid), and the int4 path packs two values per byte,
+    so D must additionally be even."""
+    if bits not in (4, 8):
+        raise ValueError(f"quantize_tensor: bits must be 4 or 8 (got {bits})")
     orig_shape = tuple(x.shape)
     D = orig_shape[-1]
-    assert D % group_size == 0
+    if group_size < 1 or D % group_size != 0:
+        raise ValueError(
+            f"quantize_tensor: last dim {D} does not tile into groups of "
+            f"{group_size} (shape {orig_shape}) — pick a group_size that "
+            f"divides it, or leave this tensor dense "
+            f"(quantize_param_tree skips non-tiling leaves automatically)")
+    if bits == 4 and D % 2 != 0:
+        raise ValueError(
+            f"quantize_tensor: int4 packs two values per byte — last dim "
+            f"{D} must be even (shape {orig_shape})")
     qmax = 127.0 if bits == 8 else 7.0
     xg = x.astype(jnp.float32).reshape(-1, D // group_size, group_size)
     amax = jnp.max(jnp.abs(xg), axis=-1)
@@ -133,3 +150,51 @@ def wrap_fn_dequant(fn):
     def wrapped(qparams, *args, **kw):
         return fn(dequantize_param_tree(qparams), *args, **kw)
     return wrapped
+
+
+# ----------------------------------------------------------------------
+# int8 KV-cache quantization (the paged pool's write/read primitives)
+# ----------------------------------------------------------------------
+#
+# The serving pool stores K/V as int8 with per-group fp32 scales along the
+# head dim (`models/gpt.init_paged_kv_pool` grows `k_scale`/`v_scale` leaves
+# [L, N, Hkv, block, hd//g] beside the payload). These two functions are the
+# SINGLE definition of that scheme's numerics, shared by the cache-write
+# scatter inside the jitted prefill/decode/verify programs, the dequantizing
+# gather oracle (`kv_cache.gather_block_kv_dequant`), and the parity tests
+# against the Pallas kernels (`ops/pallas/quant.py` uses the same
+# scale = max|x|/127, clip ±127 rule — tests pin the two against each other
+# so the schemes cannot drift).
+
+
+def quantize_kv(x, group_size):
+    """x: [..., D] float → (q int8 [..., D], scale f32 [..., D//group_size]).
+
+    Symmetric per-group int8, identical semantics to
+    `ops/pallas/quant.quantize_int8` and `quantize_tensor(bits=8)`:
+    scale = max(|x|, eps)/127 per group, round-half-even, clip at ±127."""
+    D = x.shape[-1]
+    if group_size < 1 or D % group_size != 0:
+        raise ValueError(f"quantize_kv: last dim {D} does not tile into "
+                         f"groups of {group_size}")
+    g = D // group_size
+    xg = x.astype(jnp.float32).reshape(x.shape[:-1] + (g, group_size))
+    amax = jnp.max(jnp.abs(xg), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xg / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q.reshape(x.shape), scale
+
+
+def dequantize_kv(q, scale, dtype=jnp.bfloat16):
+    """Inverse of `quantize_kv`: int8 payload × fp32 group scale → `dtype`.
+
+    q: [..., D] int8; scale: [..., D//g] f32. The fp32 product is narrowed
+    to `dtype` LAST — the in-kernel dequant in
+    `ops/pallas/decode_attention.paged_decode_attention_quant` applies the
+    exact same ordering, so the kernel and this oracle see bit-identical
+    K/V tiles."""
+    D = q.shape[-1]
+    g = scale.shape[-1]
+    xf = q.astype(jnp.float32).reshape(q.shape[:-1] + (g, D // g)) \
+        * scale[..., None]
+    return xf.reshape(q.shape).astype(dtype)

@@ -179,7 +179,53 @@ class ServingEngine:
             from deepspeed_tpu.inference.config import DegradationConfig
             scfg = dataclasses.replace(
                 scfg, degradation=DegradationConfig.from_dict(scfg.degradation))
+        if isinstance(scfg.quantization, dict):
+            # `serving(quantization={"kv_cache_dtype": "int8", ...})` overrides
+            from deepspeed_tpu.inference.config import ServingQuantizationConfig
+            scfg = dataclasses.replace(
+                scfg,
+                quantization=ServingQuantizationConfig.from_dict(
+                    scfg.quantization))
         self.serving_config = scfg
+
+        # quantized serving (inference/quantization.py). Weight-only quant
+        # runs FIRST — it replaces the engine's resident param tree (and its
+        # dequantize-on-use fn transform), which everything below snapshots:
+        # the step programs close over the transform, memscope's preflight
+        # sizes params_bytes from the live tree, and the pool capacity math
+        # should see the post-quant weights footprint.
+        qcfg = scfg.quantization
+        weights = str(qcfg.weights or "off")
+        if weights not in ("off", "int8", "int4"):
+            raise ValueError(
+                f"unknown serving.quantization.weights {weights!r} "
+                f"(expected 'off', 'int8' or 'int4')")
+        self.weight_quant = weights
+        self.weight_quant_stats = None
+        if weights != "off":
+            self.weight_quant_stats = engine.enable_weight_quant(
+                bits=8 if weights == "int8" else 4,
+                group_size=int(qcfg.weight_group_size))
+        # effective KV-pool dtype: the quantization block wins, else the
+        # engine-level kv_cache_dtype (so a plain engine config can still
+        # select the int8 pool for every serving engine it builds)
+        kvd = str(qcfg.kv_cache_dtype or "") or str(engine.config.kv_cache_dtype)
+        # ONE alias table for dtype spellings (bf16/fp16/torch.* etc.):
+        # the engine config's legacy map, not a second copy that drifts
+        kvd = getattr(type(engine.config), "_LEGACY_DTYPES", {}).get(kvd, kvd)
+        # int8 is the ONE quantized layout (scale leaves + quantized write
+        # path); every other integer dtype would silently truncate float
+        # K/V into a handful of levels through the fp write path's cast —
+        # refuse it here instead of serving garbage with a happy log line
+        if kvd != "int8" and not jnp.issubdtype(jnp.dtype(kvd),
+                                                jnp.floating):
+            raise ValueError(
+                f"unsupported KV-cache dtype {kvd!r}: expected a float "
+                f"dtype or 'int8' (the quantized paged pool — "
+                f"serving.quantization.kv_cache_dtype)")
+        self.kv_cache_dtype = kvd
+        self.kv_quant = kvd == "int8"
+        self.kv_group_size = int(qcfg.kv_group_size or 0)
         # injectable clock (tests pin TTFT/TPOT interpolation with it; the
         # router injects its own for TTL — this one stamps request timing)
         self._clock = clock if clock is not None else time.monotonic
@@ -246,7 +292,8 @@ class ServingEngine:
                            or 0)
                 plan = _ms.plan_serving_prealloc(
                     spec, num_kv_blocks=num_blocks, kv_block_size=bs,
-                    kv_cache_dtype=engine.config.kv_cache_dtype,
+                    kv_cache_dtype=self.kv_cache_dtype,
+                    kv_group_size=self.kv_group_size,
                     params=engine.params,
                     draft_spec=draft_spec
                     if scfg.spec_decode.drafter == "model" else None,
@@ -261,10 +308,34 @@ class ServingEngine:
         # call — one phantom extra compile, which the serving compile-count
         # guarantee (and its test) would flag
         from jax.sharding import NamedSharding, PartitionSpec
+        if self.kv_quant:
+            # int8 pool: payload + per-group scale leaves. The 4-arg call is
+            # part of the quantized paged contract — a 3-arg legacy spec
+            # raises TypeError right here, and a spec that accepts the group
+            # arg but returns a scale-less pool is caught just below; both
+            # get the same pointer at the contract instead of a bare
+            # arity/shape error
+            try:
+                pool = spec.init_paged_pool(num_blocks, bs, jnp.int8,
+                                            self.kv_group_size)
+            except TypeError as e:
+                raise ValueError(
+                    f"model spec '{spec.name}' init_paged_pool does not "
+                    f"accept the 4-arg quantized form "
+                    f"(num_blocks, block_size, dtype, kv_group_size) — it "
+                    f"does not implement the quantized-pool contract "
+                    f"(init_paged_kv_pool in models/gpt.py is the "
+                    f"reference): {e}") from e
+            if not (isinstance(pool, dict) and "k_scale" in pool):
+                raise ValueError(
+                    f"model spec '{spec.name}' init_paged_pool returned no "
+                    f"k_scale/v_scale leaves for dtype int8 — it does not "
+                    f"implement the quantized-pool contract "
+                    f"(init_paged_kv_pool in models/gpt.py is the reference)")
+        else:
+            pool = spec.init_paged_pool(num_blocks, bs, jnp.dtype(kvd))
         self.pool = jax.device_put(
-            spec.init_paged_pool(num_blocks, bs,
-                                 jnp.dtype(engine.config.kv_cache_dtype)),
-            NamedSharding(engine.mesh, PartitionSpec()))
+            pool, NamedSharding(engine.mesh, PartitionSpec()))
         self.allocator = BlockAllocator(
             num_blocks, policy=str(scfg.prefix_cache_policy or "lru"))
         self.prefix_cache = None
@@ -358,8 +429,9 @@ class ServingEngine:
         pool_mb = sum(x.size * x.dtype.itemsize
                       for x in jax.tree_util.tree_leaves(self.pool)) / 2**20
         log_dist(f"serving engine: {spec.name} slots={self.max_slots} "
-                 f"blocks={num_blocks}x{bs} ({pool_mb:.0f} MB pool) "
-                 f"table_width={self.nb} prefill_chunk={self.chunk}",
+                 f"blocks={num_blocks}x{bs} ({pool_mb:.0f} MB pool, "
+                 f"kv={self.kv_cache_dtype}) table_width={self.nb} "
+                 f"prefill_chunk={self.chunk} weights={self.weight_quant}",
                  ranks=[0])
 
     # ------------------------------------------------------------------
@@ -1448,6 +1520,17 @@ class ServingEngine:
                                     max(1, self.drafted_tokens)),
                 "accepted_tokens_per_step": (self.spec_emitted_tokens /
                                              max(1, self.verify_slot_steps))}
+        if self.kv_quant or self.weight_quant != "off":
+            q = {"kv_cache_dtype": self.kv_cache_dtype,
+                 "weights": self.weight_quant}
+            if self.kv_quant:
+                g = self.pool["k_scale"].shape[-1]
+                q["kv_group_size"] = int(self.pool["k"].shape[-1] // g)
+            if self.weight_quant_stats is not None:
+                # the pytree-wide WOQ ratio (bytes_before/bytes_after incl.
+                # scales) — the weight-memory saving actually realized
+                q["weight_quant"] = dict(self.weight_quant_stats)
+            out["quantization"] = q
         if self.audits_run:
             out["audit"] = {"runs": self.audits_run,
                             "violations": self.audit_violations_total,

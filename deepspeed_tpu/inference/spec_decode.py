@@ -218,11 +218,39 @@ class DraftModelDrafter(Drafter):
             tree_cast(draft_spec.params, engine.dtype), sharding)
         # mirror the target pool's placement story (scheduler __init__):
         # committed sharding up front so the first call of each program has
-        # the same arg signature as every later call — no phantom compile
-        self.pool = jax.device_put(
-            draft_spec.init_paged_pool(
+        # the same arg signature as every later call — no phantom compile.
+        # The mirror takes the serving engine's EFFECTIVE kv dtype (the
+        # quantization block may have picked int8 over the engine config),
+        # so a quantized target gets an equally-quantized draft mirror —
+        # the draft model's resident bytes halve along with the target's
+        if serving.kv_quant:
+            # same contract story as the scheduler's own pool build: a
+            # legacy 3-arg draft init_paged_pool (or one that returns a
+            # scale-less tree) gets the quantized-pool-contract pointer
+            # instead of a bare arity/shape error
+            try:
+                pool = draft_spec.init_paged_pool(
+                    serving.allocator.num_blocks, serving.block_size,
+                    jnp.int8, serving.kv_group_size)
+            except TypeError as e:
+                raise ValueError(
+                    f"draft model spec '{getattr(draft_spec, 'name', '?')}'"
+                    f" init_paged_pool does not accept the 4-arg quantized "
+                    f"form (num_blocks, block_size, dtype, kv_group_size) "
+                    f"— it does not implement the quantized-pool contract "
+                    f"(init_paged_kv_pool in models/gpt.py is the "
+                    f"reference): {e}") from e
+            if not (isinstance(pool, dict) and "k_scale" in pool):
+                raise ValueError(
+                    f"draft model spec '{getattr(draft_spec, 'name', '?')}'"
+                    f" init_paged_pool returned no k_scale/v_scale leaves "
+                    f"for dtype int8 — it does not implement the "
+                    f"quantized-pool contract")
+        else:
+            pool = draft_spec.init_paged_pool(
                 serving.allocator.num_blocks, serving.block_size,
-                jnp.dtype(engine.config.kv_cache_dtype)), sharding)
+                jnp.dtype(serving.kv_cache_dtype))
+        self.pool = jax.device_put(pool, sharding)
         self._draft_steps = build_draft_program(draft_spec.decode_paged_fn,
                                                 self.k)
 

@@ -1011,21 +1011,24 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
     # the contiguous cache path, so layer count stays out of compile time
 
     def _scan_paged(params, x, pool, block_tables, positions):
+        # the pool rides the scan as a PYTREE of [L, ...] leaves (k/v, plus
+        # the int8 pool's k_scale/v_scale), so the quantized and fp layouts
+        # share one scan body — the per-layer slice arrives as a dict
         flags = _layer_local_flags(cfg)
 
         def body(x, inputs, flag=None):
-            p, pk, pv = inputs
-            x, pk, pv = _block_paged(x, p, pk, pv, positions, block_tables,
+            p, pool_l = inputs
+            x, pool_l = _block_paged(x, p, pool_l, positions, block_tables,
                                      cfg, local_flag=flag)
-            return x, (pk, pv)
+            return x, pool_l
 
-        layers = (params["blocks"], pool["k"], pool["v"])
+        layers = (params["blocks"], pool)
         if flags is None:
-            x, (ks, vs) = jax.lax.scan(body, x, layers)
+            x, pool = jax.lax.scan(body, x, layers)
         else:
-            x, (ks, vs) = jax.lax.scan(
+            x, pool = jax.lax.scan(
                 lambda c, inp: body(c, inp[0], flag=inp[1]), x, (layers, flags))
-        return x, {"k": ks, "v": vs}
+        return x, pool
 
     def prefill_paged_fn(params, tokens, start_pos, last_idx, pool,
                          block_tables):
@@ -1057,8 +1060,10 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
         logits = _lm_head(params, x, cfg)
         return logits, pool
 
-    def init_paged_pool(num_blocks, block_size, dtype=jnp.bfloat16):
-        return init_paged_kv_pool(cfg, num_blocks, block_size, dtype)
+    def init_paged_pool(num_blocks, block_size, dtype=jnp.bfloat16,
+                        kv_group_size=0):
+        return init_paged_kv_pool(cfg, num_blocks, block_size, dtype,
+                                  kv_group_size)
 
     return DecodeModelSpec(prefill_fn=prefill_fn, decode_fn=decode_fn,
                            init_cache=init_cache, params=params, name=name,
@@ -1077,14 +1082,35 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
 
 
 def init_paged_kv_pool(cfg: GPTConfig, num_blocks, block_size,
-                       dtype=jnp.bfloat16):
+                       dtype=jnp.bfloat16, kv_group_size=0):
     """[L, num_blocks, Hkv, block, hd] physical-block pool, allocated ONCE at
     serving-engine init (vLLM's PagedAttention layout on the blocked cache
     unit). Block 0 is the trash block (inference/kv_cache.py): inactive
     slots' writes land there so the fixed-shape decode step never branches
-    on liveness."""
+    on liveness.
+
+    `dtype=int8` selects the QUANTIZED pool (`ServingConfig.quantization.
+    kv_cache_dtype`): the k/v payload is symmetric per-group int8 and the
+    pool grows `k_scale`/`v_scale` f32 leaves [L, N, Hkv, block, hd//g]
+    (`kv_group_size` g, 0 = head_dim — one scale per written K/V vector per
+    head). Scales share the physical-block axis with the payload, so every
+    block-indexed operation — transplant handoff, the prefix cache's
+    content-immutable sharing, the pool auditor — carries a block's scales
+    with its bytes automatically. Zero-init is safe: a trash-block read
+    dequantizes to exact zeros, garbage rows callers already ignore."""
     shape = (cfg.n_layer, num_blocks, cfg.n_kv_head, block_size, cfg.head_dim)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    pool = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if jnp.dtype(dtype) == jnp.int8:
+        g = int(kv_group_size) or cfg.head_dim
+        if g < 1 or cfg.head_dim % g != 0:
+            raise ValueError(
+                f"init_paged_kv_pool: kv_group_size {g} does not tile "
+                f"head_dim {cfg.head_dim} (one scale per {g}-element group "
+                f"of each K/V vector)")
+        sshape = shape[:-1] + (cfg.head_dim // g,)
+        pool["k_scale"] = jnp.zeros(sshape, jnp.float32)
+        pool["v_scale"] = jnp.zeros(sshape, jnp.float32)
+    return pool
 
 
 def _paged_attend(q, k_ctx, v_ctx, q_pos, cfg: GPTConfig, local_flag=None):
@@ -1119,20 +1145,34 @@ def _paged_attend(q, k_ctx, v_ctx, q_pos, cfg: GPTConfig, local_flag=None):
     return out.reshape(B, C, H * hd)
 
 
-def _paged_attn_half(x, p, pool_k_l, pool_v_l, positions, block_tables,
+def _paged_attn_half(x, p, pool_l, positions, block_tables,
                      cfg: GPTConfig, local_flag=None):
     """Attention half-block against one layer's paged pool.
 
-    x: [B, C, D]; pool_[kv]_l: [N, Hkv, block, hd]; positions: [B, C]
+    x: [B, C, D]; pool_l: one layer's pool slice — ``k``/``v``
+    [N, Hkv, block, hd] plus, for the int8 quantized pool,
+    ``k_scale``/``v_scale`` [N, Hkv, block, hd//g]; positions: [B, C]
     absolute; block_tables: [B, nb]. Writes the C new tokens' k/v into each
     row's blocks (logical position -> table -> physical block scatter), then
-    attends over the row's whole table. Returns (attn_out, pool_k, pool_v).
+    attends over the row's whole table. Returns (attn_out, pool_l).
+
+    Quantized pool: K/V are quantized AT CACHE-WRITE TIME (symmetric
+    per-group int8 + f32 scales, `quantization.quantize_kv` — the same
+    scheme as `ops/pallas/quant.py`), so fp K/V for the cached prefix never
+    materializes in HBM. Reads dequantize on the fly: the single-token
+    kernel path dequantizes each streamed tile inside the Pallas KV-grid
+    walk (`paged_decode_attention_quant`), and the gather path (chunked
+    prefill, the spec-decode verify chunk, CPU/arch-flag fallbacks) runs
+    the dequantizing gather oracle — one shared numeric definition, so the
+    two are parity-testable tile for tile.
     """
-    from deepspeed_tpu.inference.kv_cache import gather_block_kv
+    from deepspeed_tpu.inference.kv_cache import (gather_block_kv,
+                                                  gather_block_kv_dequant)
 
     B, C, D = x.shape
-    bs = pool_k_l.shape[2]
+    bs = pool_l["k"].shape[2]
     nb = block_tables.shape[1]
+    quantized = "k_scale" in pool_l
 
     q, k, v = _decode_qkv(x, p, positions, cfg)
 
@@ -1142,8 +1182,21 @@ def _paged_attn_half(x, p, pool_k_l, pool_v_l, positions, block_tables,
     # duplicate-index scatter order is unspecified there and irrelevant.
     blk = jnp.take_along_axis(block_tables, positions // bs, axis=1)  # [B, C]
     off = positions % bs
-    pool_k_l = pool_k_l.at[blk, :, off, :].set(k.astype(pool_k_l.dtype))
-    pool_v_l = pool_v_l.at[blk, :, off, :].set(v.astype(pool_v_l.dtype))
+    pool_l = dict(pool_l)
+    if quantized:
+        from deepspeed_tpu.inference.quantization import quantize_kv
+        g = cfg.head_dim // pool_l["k_scale"].shape[-1]
+        qk, sk = quantize_kv(k, g)
+        qv, sv = quantize_kv(v, g)
+        pool_l["k"] = pool_l["k"].at[blk, :, off, :].set(qk)
+        pool_l["v"] = pool_l["v"].at[blk, :, off, :].set(qv)
+        pool_l["k_scale"] = pool_l["k_scale"].at[blk, :, off, :].set(sk)
+        pool_l["v_scale"] = pool_l["v_scale"].at[blk, :, off, :].set(sv)
+    else:
+        pool_l["k"] = pool_l["k"].at[blk, :, off, :].set(
+            k.astype(pool_l["k"].dtype))
+        pool_l["v"] = pool_l["v"].at[blk, :, off, :].set(
+            v.astype(pool_l["v"].dtype))
 
     use_plain_path = cfg.use_alibi or cfg.sliding_window
     # single-token steps ride the paged Pallas kernel when it is worth it:
@@ -1156,27 +1209,40 @@ def _paged_attn_half(x, p, pool_k_l, pool_v_l, positions, block_tables,
     want_kernel = (C == 1 and not use_plain_path and bs % 128 == 0
                    and _decode_kernel_wanted(cfg, nb * bs))
     if want_kernel:
-        from deepspeed_tpu.ops.pallas.decode_attention import \
-            paged_decode_attention
-        attn = paged_decode_attention(
-            q[:, 0], pool_k_l, pool_v_l, block_tables, positions[:, 0],
-            sm_scale=None if cfg.scale_attn else 1.0).reshape(B, 1, D)
+        sm = None if cfg.scale_attn else 1.0
+        if quantized:
+            from deepspeed_tpu.ops.pallas.decode_attention import \
+                paged_decode_attention_quant
+            attn = paged_decode_attention_quant(
+                q[:, 0], pool_l["k"], pool_l["v"], pool_l["k_scale"],
+                pool_l["v_scale"], block_tables, positions[:, 0],
+                sm_scale=sm).reshape(B, 1, D)
+        else:
+            from deepspeed_tpu.ops.pallas.decode_attention import \
+                paged_decode_attention
+            attn = paged_decode_attention(
+                q[:, 0], pool_l["k"], pool_l["v"], block_tables,
+                positions[:, 0], sm_scale=sm).reshape(B, 1, D)
     else:
-        k_ctx, v_ctx = gather_block_kv(pool_k_l, pool_v_l, block_tables)
+        if quantized:
+            k_ctx, v_ctx = gather_block_kv_dequant(pool_l, block_tables,
+                                                   x.dtype)
+        else:
+            k_ctx, v_ctx = gather_block_kv(pool_l["k"], pool_l["v"],
+                                           block_tables)
         attn = _paged_attend(q, k_ctx, v_ctx, positions, cfg,
                              local_flag=local_flag)
     attn_out = attn @ p["attn_out_w"] + p["attn_out_b"]
-    return attn_out, pool_k_l, pool_v_l
+    return attn_out, pool_l
 
 
-def _block_paged(x, p, pool_k_l, pool_v_l, positions, block_tables,
+def _block_paged(x, p, pool_l, positions, block_tables,
                  cfg: GPTConfig, local_flag=None):
     """One transformer block against the paged pool (decode or prefill chunk)."""
-    attn_out, pool_k_l, pool_v_l = _paged_attn_half(
-        x, p, pool_k_l, pool_v_l, positions, block_tables, cfg,
-        local_flag=local_flag)
+    attn_out, pool_l = _paged_attn_half(
+        x, p, pool_l, positions, block_tables, cfg, local_flag=local_flag)
     x = _residual_mlp(x, attn_out, p, cfg, constrain=False)
-    return x, pool_k_l, pool_v_l
+    return x, pool_l
 
 
 # ----------------------------------------------------------------------

@@ -39,22 +39,51 @@ def _use_interpret():
     return jax.default_backend() not in ("tpu", "axon")
 
 
+def _online_softmax_tile(q, k, v, pos, j, acc_ref, m_ref, l_ref, *,
+                         sm_scale, block_m):
+    """One streamed KV tile's online-softmax update — the SINGLE definition
+    of the decode-attention math, shared by the contiguous, paged, and
+    quantized-paged kernels (the dequantizing kernel hands in already-
+    dequantized tiles; everything after the load is identical, so the
+    variants cannot drift numerically).
+
+    q: [G, hd]; k/v: [block_m, hd] in the compute dtype; scratch acc
+    [G, hd] fp32, m/l [G, _LANES] fp32 carried across the (sequential,
+    innermost) block axis.
+
+    native-dtype dots (fp32 accumulate via preferred_element_type):
+    pre-casting K/V blocks to fp32 doubles the VMEM working set and VPU
+    traffic (same fix as flash_attention.py)."""
+    G = q.shape[0]
+    in_dtype = q.dtype
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+    k_pos = j * block_m + jax.lax.broadcasted_iota(jnp.int32, (G, block_m), 1)
+    s = jnp.where(k_pos <= pos, s, NEG_INF)
+    m_prev = m_ref[:, 0:1]
+    l_prev = l_ref[:, 0:1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p.astype(in_dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
                    *, sm_scale, block_m):
     # q_ref: [1, 1, G, hd]; k_ref/v_ref: [1, 1, block_m, hd] (one streamed
     # cache tile); pos_ref: SMEM [B]; scratch acc [G, hd] fp32, m/l
     # [G, _LANES] fp32. Grid (B, Hkv, num_blocks): the block axis is
     # innermost and sequential, scratch carries the online softmax across it.
-    #
-    # native-dtype loads + dots (fp32 accumulate via preferred_element_type):
-    # pre-casting K/V blocks to fp32 doubles the VMEM working set and VPU
-    # traffic (same fix as flash_attention.py)
     b = pl.program_id(0)
     j = pl.program_id(2)
     nm = pl.num_programs(2)
     pos = pos_ref[b]
-    G, hd = q_ref.shape[2:]
-    in_dtype = q_ref.dtype
 
     @pl.when(j == 0)
     def _init():
@@ -66,25 +95,9 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     # re-serves the frontier tile and this predicate keeps it out of the math
     @pl.when(j * block_m <= pos)
     def _step():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
-        k_pos = j * block_m + jax.lax.broadcasted_iota(jnp.int32, (G, block_m), 1)
-        s = jnp.where(k_pos <= pos, s, NEG_INF)
-        m_prev = m_ref[:, 0:1]
-        l_prev = l_ref[:, 0:1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p.astype(in_dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        _online_softmax_tile(q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], pos, j,
+                             acc_ref, m_ref, l_ref,
+                             sm_scale=sm_scale, block_m=block_m)
 
     @pl.when(j == nm - 1)
     def _finish():
@@ -242,6 +255,125 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, pos, sm_scale=None,
         interpret=interpret,
     )(pos, block_tables, qg, k_pool, v_pool)
     return out.reshape(B, H, hd)
+
+
+def _paged_decode_quant_kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, ks_ref,
+                               vs_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                               sm_scale, block_m):
+    # The int8-pool variant: k/v tiles arrive QUANTIZED (int8 payload +
+    # [block_m, g] f32 group scales, both resolved through the same
+    # logical->physical index map), are dequantized here in VMEM — fp K/V
+    # never exists in HBM — and then run the shared online-softmax tile
+    # update. Dequant ordering (int8 -> f32 x scale -> narrow to the
+    # compute dtype) is pinned to `quantization.dequantize_kv`, so this
+    # kernel and the dequantizing gather oracle see bit-identical tiles.
+    del bt_ref
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nm = pl.num_programs(2)
+    pos = pos_ref[b]
+    in_dtype = q_ref.dtype
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j * block_m <= pos)
+    def _step():
+        # THE dequant definition, not a copy: `dequantize_kv` is pure jnp
+        # (reshape-to-groups x scale, narrow last) and traces fine inside
+        # the kernel body — the write path, the gather oracle, and this
+        # tile load literally share one function, so they cannot drift
+        from deepspeed_tpu.inference.quantization import dequantize_kv
+        _online_softmax_tile(q_ref[0, 0],
+                             dequantize_kv(k_ref[0, 0], ks_ref[0, 0],
+                                           in_dtype),
+                             dequantize_kv(v_ref[0, 0], vs_ref[0, 0],
+                                           in_dtype), pos, j,
+                             acc_ref, m_ref, l_ref,
+                             sm_scale=sm_scale, block_m=block_m)
+
+    @pl.when(j == nm - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def paged_decode_attention_quant(q, k_pool, v_pool, k_scale, v_scale,
+                                 block_tables, pos, sm_scale=None,
+                                 interpret=None):
+    """Decode attention over the INT8 paged pool: dequantize-inside-the-
+    kernel PagedAttention.
+
+    q: [B, H, hd]; k_pool/v_pool: [N, Hkv, block, hd] int8; k_scale/v_scale:
+    [N, Hkv, block, hd//g] f32 (the `init_paged_kv_pool` quantized layout);
+    block_tables: [B, nb]; pos: [B]. Returns [B, H, hd] in q's dtype.
+
+    Identical grid walk to `paged_decode_attention` — the scale tiles ride
+    the SAME scalar-prefetched logical->physical index map as the payload,
+    so a step's HBM traffic is the live prefix's int8 bytes plus its scales
+    (~half the bf16 pool's traffic at group >= 8): decode is HBM-bandwidth-
+    bound, so the quantized pool buys tokens/s, not just capacity. fp K/V
+    exists only tile-by-tile in VMEM."""
+    if interpret is None:
+        interpret = _use_interpret()
+    B, H, hd = q.shape
+    N, Hkv, block_m, _ = k_pool.shape
+    g = k_scale.shape[-1]
+    nb = block_tables.shape[1]
+    assert H % Hkv == 0
+    G = H // Hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(hd)
+
+    pos = pos.astype(jnp.int32)
+    block_tables = block_tables.astype(jnp.int32)
+    qg = q.reshape(B, Hkv, G, hd)
+
+    def kv_index(b, h, j, pos_ref, bt_ref):
+        jj = jnp.minimum(j, pos_ref[b] // block_m)
+        return (bt_ref[b, jj], h, 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_quant_kernel, sm_scale=sm_scale,
+                          block_m=block_m),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, Hkv, nb),
+            in_specs=[
+                pl.BlockSpec((1, 1, G, hd),
+                             lambda b, h, j, pos_ref, bt_ref: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_m, hd), kv_index),
+                pl.BlockSpec((1, 1, block_m, hd), kv_index),
+                pl.BlockSpec((1, 1, block_m, g), kv_index),
+                pl.BlockSpec((1, 1, block_m, g), kv_index),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, G, hd), lambda b, h, j, pos_ref, bt_ref: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((G, hd), jnp.float32),
+                pltpu.VMEM((G, _LANES), jnp.float32),
+                pltpu.VMEM((G, _LANES), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype),
+        interpret=interpret,
+    )(pos, block_tables, qg, k_pool, v_pool, k_scale, v_scale)
+    return out.reshape(B, H, hd)
+
+
+def paged_decode_attention_quant_reference(q, pool_l, block_tables, pos,
+                                           sm_scale=None):
+    """jnp oracle for the quantized kernel: the dequantizing gather
+    (`kv_cache.gather_block_kv_dequant` — the SAME definition the XLA
+    fallback path runs, so the oracle cannot silently diverge from
+    production) followed by the contiguous fp reference. `pool_l` is one
+    layer's quantized pool slice (k/v int8 + k_scale/v_scale)."""
+    from deepspeed_tpu.inference.kv_cache import gather_block_kv_dequant
+    k, v = gather_block_kv_dequant(pool_l, block_tables, q.dtype)
+    return decode_attention_reference(q, k, v, pos, sm_scale=sm_scale)
 
 
 def paged_decode_attention_reference(q, k_pool, v_pool, block_tables, pos,

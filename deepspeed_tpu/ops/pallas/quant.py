@@ -78,6 +78,95 @@ def quantize_int8(x, group_size=128, interpret=None):
     return q.reshape(orig), s.reshape(orig[:-1] + (g,))
 
 
+def _quant4_kernel(x_ref, q_ref, s_ref, *, group_size):
+    # same scale/clip rule as the int8 kernel at qmax 7, then two values
+    # packed per byte as biased [1, 15] nibbles (lo = even index, hi = odd)
+    # — byte-identical to inference/quantization.quantize_tensor(bits=4)
+    x = x_ref[:, :].astype(jnp.float32)            # [rows, D]
+    rows, D = x.shape
+    g = D // group_size
+    xg = x.reshape(rows, g, group_size)
+    amax = jnp.max(jnp.abs(xg), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(xg / scale[..., None]), -7, 7)
+    qu = (q.reshape(rows, D).astype(jnp.int32) + 8).astype(jnp.uint8)
+    packed = (qu[:, 0::2] | (qu[:, 1::2] << 4)).astype(jnp.uint8)
+    q_ref[:, :] = jax.lax.bitcast_convert_type(packed, jnp.int8)
+    s_ref[:, :] = scale
+
+
+def _dequant4_kernel(q_ref, s_ref, o_ref, *, group_size):
+    packed = jax.lax.bitcast_convert_type(q_ref[:, :], jnp.uint8)
+    rows = packed.shape[0]
+    D = packed.shape[1] * 2
+    lo = (packed & 0xF).astype(jnp.int32) - 8
+    hi = (packed >> 4).astype(jnp.int32) - 8
+    q = jnp.stack([lo, hi], axis=-1).reshape(rows, D).astype(jnp.float32)
+    g = D // group_size
+    s = s_ref[:, :]
+    x = q.reshape(rows, g, group_size) * s[..., None]
+    o_ref[:, :] = x.reshape(rows, D).astype(o_ref.dtype)
+
+
+def quantize_int4(x, group_size=128, interpret=None):
+    """x: [..., D] → (packed int8 [..., D//2], scales f32 [..., D//g]).
+
+    Two int4 values per byte (the ZeRO++ qgZ / WOQ storage form); packing
+    layout and scale semantics are pinned against the pure-jnp
+    `inference/quantization.quantize_tensor(bits=4)` by the parity tests."""
+    if interpret is None:
+        interpret = _use_interpret()
+    orig = x.shape
+    D = orig[-1]
+    assert D % group_size == 0, \
+        f"last dim {D} not divisible by group_size {group_size}"
+    assert D % 2 == 0, f"int4 packs two values per byte: last dim {D} odd"
+    x2 = x.reshape(-1, D)
+    N = x2.shape[0]
+    bn = _block_rows(N)
+    g = D // group_size
+    q, s = pl.pallas_call(
+        functools.partial(_quant4_kernel, group_size=group_size),
+        grid=(N // bn,),
+        in_specs=[pl.BlockSpec((bn, D), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((bn, D // 2), lambda i: (i, 0)),
+            pl.BlockSpec((bn, g), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, D // 2), jnp.int8),
+            jax.ShapeDtypeStruct((N, g), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2)
+    return q.reshape(orig[:-1] + (D // 2,)), s.reshape(orig[:-1] + (g,))
+
+
+def dequantize_int4(q, scales, dtype=jnp.bfloat16, group_size=128,
+                    interpret=None):
+    """Inverse of `quantize_int4`: packed [..., D//2] int8 + scales → [..., D]."""
+    if interpret is None:
+        interpret = _use_interpret()
+    orig = q.shape
+    D = orig[-1] * 2
+    q2 = q.reshape(-1, orig[-1])
+    s2 = scales.reshape(-1, D // group_size)
+    N = q2.shape[0]
+    bn = _block_rows(N)
+    out = pl.pallas_call(
+        functools.partial(_dequant4_kernel, group_size=group_size),
+        grid=(N // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, orig[-1]), lambda i: (i, 0)),
+            pl.BlockSpec((bn, D // group_size), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, D), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, D), dtype),
+        interpret=interpret,
+    )(q2, s2)
+    return out.reshape(orig[:-1] + (D,))
+
+
 def dequantize_int8(q, scales, dtype=jnp.bfloat16, group_size=128, interpret=None):
     if interpret is None:
         interpret = _use_interpret()

@@ -323,12 +323,23 @@ class ServingRouter:
             raise ValueError(
                 f"replica {handle.replica_id}: kv_block_size {b.block_size} "
                 f"!= pool's {a.block_size} (blocks must transplant 1:1)")
-        da = str(a.config.kv_cache_dtype)
-        db = str(b.config.kv_cache_dtype)
+        # serving-EFFECTIVE pool dtype (ServingConfig.quantization may pick
+        # int8 over the engine-level kv_cache_dtype), plus the scale group:
+        # an int8 pool next to a bf16 one — or two int8 pools with different
+        # kv_group_size — would fail mid-request at the first handoff's
+        # transplant instead of here at pool-construction time
+        da = str(getattr(a, "kv_cache_dtype", a.config.kv_cache_dtype))
+        db = str(getattr(b, "kv_cache_dtype", b.config.kv_cache_dtype))
         if da != db:
             raise ValueError(
                 f"replica {handle.replica_id}: kv_cache_dtype {db} != "
                 f"pool's {da} (transplanted blocks must be byte-identical)")
+        ga = getattr(a, "kv_group_size", 0)
+        gb = getattr(b, "kv_group_size", 0)
+        if da == "int8" and ga != gb:
+            raise ValueError(
+                f"replica {handle.replica_id}: kv_group_size {gb} != "
+                f"pool's {ga} (int8 scale leaves must transplant 1:1)")
 
     @property
     def disaggregated(self) -> bool:

@@ -57,7 +57,7 @@ __all__ = [
     "plan_serving_prealloc", "serving_pool_bytes", "max_kv_blocks",
     "estimate_zero2_model_states_mem_needs",
     "estimate_zero3_model_states_mem_needs",
-    "aot_memory_analysis", "is_resource_exhausted",
+    "aot_memory_analysis", "is_resource_exhausted", "kv_cache_is_quantized",
     "tree_bytes", "dtype_bytes", "fmt_bytes", "LEDGER_GAUGES",
 ]
 
@@ -415,17 +415,39 @@ def plan_training_from_engine(engine, capacity_bytes=0,
         temp_bytes=temp_bytes, capacity_bytes=capacity_bytes)
 
 
+def kv_cache_is_quantized(kv_cache_dtype) -> bool:
+    """True for the int8 quantized pool layout (payload + f32 group
+    scales). Name-matched so the planner half stays jax-free; EXACT match
+    — 'uint8' is a plain (scale-less) pool, not the quantized layout, and
+    a substring test would make the planner price scales the scheduler
+    never allocates."""
+    name = kv_cache_dtype if isinstance(kv_cache_dtype, str) \
+        else str(getattr(kv_cache_dtype, "name", kv_cache_dtype))
+    return name.strip().lower() == "int8"
+
+
 def serving_pool_bytes(*, n_layer, n_kv_head, head_dim, kv_block_size,
-                       num_kv_blocks, kv_cache_dtype="bfloat16") -> int:
+                       num_kv_blocks, kv_cache_dtype="bfloat16",
+                       kv_group_size=0) -> int:
     """Bytes of a paged KV pool: K and V, each
-    ``[L, num_blocks, Hkv, block, hd]`` (the `init_paged_pool` layout)."""
-    return (2 * int(n_layer) * int(num_kv_blocks) * int(n_kv_head)
-            * int(kv_block_size) * int(head_dim)
-            * dtype_bytes(kv_cache_dtype))
+    ``[L, num_blocks, Hkv, block, hd]`` (the `init_paged_pool` layout) at
+    the pool dtype's itemsize. The int8 quantized pool additionally
+    carries K and V scale leaves ``[L, N, Hkv, block, hd//g]`` f32
+    (`kv_group_size` g, 0 = head_dim) — the scales-overhead term is what
+    keeps the planner's byte identity with `init_paged_kv_pool` exact, and
+    what caps the capacity win below a clean 2x (4/g extra bytes per
+    element: g=128 -> 1.94x, g=head_dim=64 -> 1.88x)."""
+    cells = (2 * int(n_layer) * int(num_kv_blocks) * int(n_kv_head)
+             * int(kv_block_size))
+    total = cells * int(head_dim) * dtype_bytes(kv_cache_dtype)
+    if kv_cache_is_quantized(kv_cache_dtype):
+        g = int(kv_group_size) or int(head_dim)
+        total += cells * (int(head_dim) // g) * 4
+    return total
 
 
 def plan_serving(*, n_layer, n_kv_head, head_dim, kv_block_size,
-                 num_kv_blocks, kv_cache_dtype="bfloat16",
+                 num_kv_blocks, kv_cache_dtype="bfloat16", kv_group_size=0,
                  n_params=0, param_dtype="bfloat16", params_bytes=None,
                  tp=1, draft=None, temp_bytes=0,
                  capacity_bytes=0) -> MemoryPlan:
@@ -445,7 +467,10 @@ def plan_serving(*, n_layer, n_kv_head, head_dim, kv_block_size,
     dev["kv_pool"] = serving_pool_bytes(
         n_layer=n_layer, n_kv_head=n_kv_head, head_dim=head_dim,
         kv_block_size=kv_block_size, num_kv_blocks=num_kv_blocks,
-        kv_cache_dtype=kv_cache_dtype)
+        kv_cache_dtype=kv_cache_dtype, kv_group_size=kv_group_size)
+    if kv_cache_is_quantized(kv_cache_dtype):
+        notes.append("int8 KV pool: payload bytes + f32 per-group scales "
+                     f"(group {int(kv_group_size) or int(head_dim)})")
     if draft:
         dpb = draft.get("params_bytes")
         if dpb is None:
@@ -456,7 +481,8 @@ def plan_serving(*, n_layer, n_kv_head, head_dim, kv_block_size,
             n_layer=draft["n_layer"], n_kv_head=draft["n_kv_head"],
             head_dim=draft["head_dim"], kv_block_size=kv_block_size,
             num_kv_blocks=num_kv_blocks,
-            kv_cache_dtype=draft.get("kv_cache_dtype", kv_cache_dtype))
+            kv_cache_dtype=draft.get("kv_cache_dtype", kv_cache_dtype),
+            kv_group_size=draft.get("kv_group_size", 0))
         notes.append("draft mirror shares the target's num_kv_blocks/"
                      "block_size (indexed by the same block tables)")
     notes.append("prefix-cached blocks live INSIDE kv_pool (a view, "
@@ -466,17 +492,20 @@ def plan_serving(*, n_layer, n_kv_head, head_dim, kv_block_size,
 
 
 def max_kv_blocks(capacity_bytes, *, n_layer, n_kv_head, head_dim,
-                  kv_block_size, kv_cache_dtype="bfloat16",
+                  kv_block_size, kv_cache_dtype="bfloat16", kv_group_size=0,
                   params_bytes=0, temp_bytes=0, draft=None) -> int:
     """The inverse question serving deployment actually asks: the largest
     `num_kv_blocks` that fits `capacity_bytes` next to the weights (and
     the draft mirror, whose pool grows block-for-block with the target's).
+    An int8 `kv_cache_dtype` prices each block at payload + scales
+    (`serving_pool_bytes`), so the same budget answers ~2x the blocks —
+    2/(1 + 4/g) of bf16's, exactly.
     Remember one block (TRASH_BLOCK) is reserved: usable capacity is the
     returned value minus one."""
     per_block = serving_pool_bytes(
         n_layer=n_layer, n_kv_head=n_kv_head, head_dim=head_dim,
         kv_block_size=kv_block_size, num_kv_blocks=1,
-        kv_cache_dtype=kv_cache_dtype)
+        kv_cache_dtype=kv_cache_dtype, kv_group_size=kv_group_size)
     fixed = int(params_bytes) + int(temp_bytes)
     if draft:
         dpb = draft.get("params_bytes")
@@ -488,30 +517,49 @@ def max_kv_blocks(capacity_bytes, *, n_layer, n_kv_head, head_dim,
             n_layer=draft["n_layer"], n_kv_head=draft["n_kv_head"],
             head_dim=draft["head_dim"], kv_block_size=kv_block_size,
             num_kv_blocks=1,
-            kv_cache_dtype=draft.get("kv_cache_dtype", kv_cache_dtype))
+            kv_cache_dtype=draft.get("kv_cache_dtype", kv_cache_dtype),
+            kv_group_size=draft.get("kv_group_size", 0))
     free = int(capacity_bytes) - fixed
     return max(0, free // max(1, per_block))
 
 
 def plan_serving_prealloc(spec, *, num_kv_blocks, kv_block_size,
-                          kv_cache_dtype, params=None, draft_spec=None,
-                          param_dtype=None, temp_bytes=0,
+                          kv_cache_dtype, kv_group_size=0, params=None,
+                          draft_spec=None, param_dtype=None, temp_bytes=0,
                           capacity_bytes=0) -> MemoryPlan:
     """Serving plan BEFORE any pool allocation: pool bytes come from
     `jax.eval_shape` over the spec's `init_paged_pool` (no device memory
     is touched), so a predicted-OOM config can warn/refuse ahead of the
     `device_put` that would crash a real chip with a raw
-    RESOURCE_EXHAUSTED. `param_dtype` mirrors the drafter's cast (draft
-    params are re-cast to the engine dtype when materialized)."""
+    RESOURCE_EXHAUSTED. An int8 `kv_cache_dtype` threads `kv_group_size`
+    through to the quantized-pool contract, so the scale leaves are in the
+    shapes (and therefore in the prediction) too. `param_dtype` mirrors
+    the drafter's cast (draft params are re-cast to the engine dtype when
+    materialized)."""
     import jax
     import jax.numpy as jnp
 
     def pool_shape_bytes(s):
-        shapes = jax.eval_shape(
-            lambda: s.init_paged_pool(int(num_kv_blocks),
-                                      int(kv_block_size),
-                                      jnp.dtype(kv_cache_dtype)))
-        return tree_bytes(shapes)
+        if kv_cache_is_quantized(kv_cache_dtype):
+            build = lambda: s.init_paged_pool(int(num_kv_blocks),
+                                              int(kv_block_size),
+                                              jnp.int8, int(kv_group_size))
+        else:
+            build = lambda: s.init_paged_pool(int(num_kv_blocks),
+                                              int(kv_block_size),
+                                              jnp.dtype(kv_cache_dtype))
+        try:
+            return tree_bytes(jax.eval_shape(build))
+        except TypeError as e:
+            # a 3-arg legacy init_paged_pool asked to build the int8 pool:
+            # surface the contract instead of a bare arity error (the
+            # scheduler raises the same pointer at real allocation time)
+            raise ValueError(
+                f"init_paged_pool of spec "
+                f"'{getattr(s, 'name', '?')}' does not implement the "
+                f"quantized-pool contract (4-arg form with kv_group_size; "
+                f"init_paged_kv_pool in models/gpt.py is the reference): "
+                f"{e}") from e
 
     dev = {"params": tree_bytes(params),
            "kv_pool": pool_shape_bytes(spec)}
@@ -836,24 +884,35 @@ class ServingMemScope(_MemScopeBase):
                 (params, i32((S, s.draft_k + 1)), i32((S,)), pool,
                  np.asarray(s.tables), rng)
 
+    @staticmethod
+    def _pool_geometry(pool):
+        """(payload leaf, kv_group_size) of a pool tree: the k payload is
+        ``[L, N, Hkv, block, hd]`` by the `init_paged_pool` contract, and
+        the int8 layout's `k_scale` leaf reveals the scale group."""
+        import jax
+        leaf = pool["k"] if isinstance(pool, dict) and "k" in pool \
+            else jax.tree_util.tree_leaves(pool)[0]
+        g = 0
+        if isinstance(pool, dict) and "k_scale" in pool:
+            g = int(leaf.shape[-1]) // int(pool["k_scale"].shape[-1])
+        return leaf, g
+
     def plan(self) -> MemoryPlan:
         """Reconstruct the pre-flight prediction from the live pool
-        geometry (leaf 0 is ``[L, N, Hkv, block, hd]`` by the
-        `init_paged_pool` contract) — the OOM dump's planner-delta
-        source."""
-        import jax
-        leaf = jax.tree_util.tree_leaves(self.serving.pool)[0]
+        geometry (payload + scale-group, see `_pool_geometry`) — the OOM
+        dump's planner-delta source."""
+        leaf, g = self._pool_geometry(self.serving.pool)
         L, N, Hkv, B, hd = leaf.shape
         draft = None
         if self.serving.drafter is not None and self.draft_pool_bytes:
-            dleaf = jax.tree_util.tree_leaves(self.serving.drafter.pool)[0]
+            dleaf, dg = self._pool_geometry(self.serving.drafter.pool)
             draft = {"n_layer": dleaf.shape[0], "n_kv_head": dleaf.shape[2],
                      "head_dim": dleaf.shape[4],
                      "params_bytes": self.draft_params_bytes,
-                     "kv_cache_dtype": dleaf.dtype}
+                     "kv_cache_dtype": dleaf.dtype, "kv_group_size": dg}
         return plan_serving(
             n_layer=L, n_kv_head=Hkv, head_dim=hd, kv_block_size=B,
-            num_kv_blocks=N, kv_cache_dtype=leaf.dtype,
+            num_kv_blocks=N, kv_cache_dtype=leaf.dtype, kv_group_size=g,
             params_bytes=self.params_bytes, draft=draft,
             temp_bytes=self.program_temp_bytes(),
             capacity_bytes=self.capacity_bytes())
@@ -977,6 +1036,9 @@ def main(argv=None) -> int:
     ap.add_argument("--blocks", type=int, default=0,
                     help="num_kv_blocks (serving plan)")
     ap.add_argument("--kv-dtype", default="bfloat16")
+    ap.add_argument("--kv-group", type=int, default=0,
+                    help="int8 pool scale-group size (0 = head_dim); "
+                         "prices the f32 scales next to the payload")
     ap.add_argument("--fit", action="store_true",
                     help="serving: report the LARGEST num_kv_blocks that "
                          "fits --capacity instead of judging --blocks")
@@ -1020,7 +1082,8 @@ def main(argv=None) -> int:
             blocks = max_kv_blocks(
                 capacity, n_layer=args.layers, n_kv_head=args.kv_heads,
                 head_dim=args.head_dim, kv_block_size=args.block_size,
-                kv_cache_dtype=args.kv_dtype, params_bytes=per_dev_params)
+                kv_cache_dtype=args.kv_dtype, kv_group_size=args.kv_group,
+                params_bytes=per_dev_params)
             out = {"max_kv_blocks": blocks,
                    "usable_blocks": max(0, blocks - 1),
                    "capacity_bytes": capacity,
@@ -1034,6 +1097,7 @@ def main(argv=None) -> int:
             n_layer=args.layers, n_kv_head=args.kv_heads,
             head_dim=args.head_dim, kv_block_size=args.block_size,
             num_kv_blocks=args.blocks, kv_cache_dtype=args.kv_dtype,
+            kv_group_size=args.kv_group,
             params_bytes=params_bytes, tp=args.tp, capacity_bytes=capacity)
         print(json.dumps(plan.to_dict()) if args.json else plan.render())
         return 0 if plan.fits is not False else 2

@@ -98,6 +98,15 @@ def pytest_configure(config):
                    "DT001-DT005 finding) — fast and CPU-harness-safe, "
                    "rides in tier-1; run it alone with pytest -m lint)")
     config.addinivalue_line(
+        "markers", "quant: quantized serving suite "
+                   "(tests/test_quant_serving.py — int8 KV-cache pool with "
+                   "per-group scales, in-kernel dequantizing paged decode "
+                   "vs the gather oracle, weight-only int8/int4, planner "
+                   "capacity math, prefix-cache/handoff/spec-decode "
+                   "composition over the int8 pool) — fast and "
+                   "CPU-harness-safe, rides in tier-1; run it alone with "
+                   "pytest -m quant)")
+    config.addinivalue_line(
         "markers", "chaos: self-healing serving pool suite "
                    "(tests/test_selfheal.py — KV-pool invariant auditor + "
                    "repair, hung-replica watchdog, hard deadlines, hedged "

@@ -156,6 +156,71 @@ def test_serving_pool_formula_and_inverse():
     assert n_d == n // 2
 
 
+def test_quantized_pool_formula_matches_tree_and_doubles_capacity():
+    """The int8 pool's planner term: byte-identical to the real quantized
+    pool tree (payload + f32 group scales), and >= 1.9x `max_kv_blocks`
+    at the same HBM budget for production serving geometry — THE capacity
+    claim of the quantized-serving tentpole, stated as planner math so it
+    holds on any backend."""
+    from deepspeed_tpu.models.gpt import init_paged_kv_pool
+    # exact identity with init_paged_kv_pool's int8 layout (g = head_dim)
+    pool = init_paged_kv_pool(TINY, 13, 16, jnp.int8)
+    formula = serving_pool_bytes(
+        n_layer=TINY.n_layer, n_kv_head=TINY.n_kv_head,
+        head_dim=TINY.head_dim, kv_block_size=16, num_kv_blocks=13,
+        kv_cache_dtype="int8", kv_group_size=0)
+    assert formula == tree_bytes(pool)
+    # ...and with an explicit sub-vector group
+    pool8 = init_paged_kv_pool(TINY, 13, 16, jnp.int8, kv_group_size=8)
+    formula8 = serving_pool_bytes(
+        n_layer=TINY.n_layer, n_kv_head=TINY.n_kv_head,
+        head_dim=TINY.head_dim, kv_block_size=16, num_kv_blocks=13,
+        kv_cache_dtype="int8", kv_group_size=8)
+    assert formula8 == tree_bytes(pool8) > formula
+    # capacity: >= 1.9x blocks for the same budget at head_dim 128 (the
+    # production MXU-lane geometry; the scales overhead is 4/g per element,
+    # so the exact ratio is 2/(1 + 4/128) = 1.94x)
+    kw = dict(n_layer=24, n_kv_head=8, head_dim=128, kv_block_size=512)
+    cap, params_b = 16 * 2**30, 2 * 10**9
+    n_bf16 = max_kv_blocks(cap, kv_cache_dtype="bfloat16",
+                           params_bytes=params_b, **kw)
+    n_int8 = max_kv_blocks(cap, kv_cache_dtype="int8",
+                           params_bytes=params_b, **kw)
+    assert n_int8 >= 1.9 * n_bf16
+    assert n_int8 <= 2.0 * n_bf16          # scales overhead is not free
+    # inverse property still holds with the scales term in the price
+    assert plan_serving(num_kv_blocks=n_int8, params_bytes=params_b,
+                        kv_cache_dtype="int8", capacity_bytes=cap,
+                        **kw).fits is True
+    assert plan_serving(num_kv_blocks=n_int8 + 1, params_bytes=params_b,
+                        kv_cache_dtype="int8", capacity_bytes=cap,
+                        **kw).fits is False
+
+
+def test_int8_serving_planner_matches_xla_memory_analysis(tmp_path):
+    """Planner-vs-XLA parity for the QUANTIZED serving engine: the int8
+    pool (payload + scales) and the params are the compiled programs'
+    argument bytes within SERVING_PLAN_TOLERANCE, exactly like the bf16
+    case — the scales term keeps the identity exact."""
+    engine = _mk_engine(telemetry=_tel(tmp_path))
+    serving = engine.serving(max_slots=2, max_context=128,
+                             quantization={"kv_cache_dtype": "int8"})
+    assert serving.memscope is not None
+    serving.run(_reqs(2, np.random.default_rng(0)))
+
+    plan = serving.memscope.plan()
+    assert plan.device_bytes["kv_pool"] == tree_bytes(serving.pool)
+    assert plan.device_bytes["params"] == tree_bytes(engine.params)
+    pred = plan.device_bytes["params"] + plan.device_bytes["kv_pool"]
+    progs = serving.memscope.program_memory()
+    assert set(progs) == {"decode_step", "prefill_step"}
+    for name, ma in progs.items():
+        rel = abs(ma["argument_bytes"] - pred) / pred
+        assert rel < SERVING_PLAN_TOLERANCE, (name, ma["argument_bytes"],
+                                              pred, rel)
+    assert serving.compile_stats() == {"decode_step": 1, "prefill_step": 1}
+
+
 # ----------------------------------------------------------------------
 # planner-vs-XLA parity on the REAL compiled programs (tier-1 configs)
 # ----------------------------------------------------------------------
