@@ -43,6 +43,14 @@ Four lanes per run:
      whole-slab VMEM cap ended at ~14k and pushed this shape onto the
      rematerialized XLA chunked fallback, ~0.24 attn-incl MFU). Same
      honesty conventions as the longctx lane.
+  1b2b. longctx_ring (BENCH_LONGCTX_RING=0 to disable): {flash, ring} x
+     {64k, 128k} sweep (BENCH_LCR_{MODEL,SEQS,GAS,STEPS} knobs, child-
+     process pattern) — context-parallel ring attention over a
+     `sequence` mesh axis vs the single-chip streaming flash kernel at
+     the lengths where one chip's HBM is the wall. extra.memory carries
+     attributed K/V bytes total AND per chip (ring: 1/sp). Ring arms
+     skip (recorded, not silent) on a 1-chip harness — the MULTICHIP
+     dry-run carries the sp=4 parity proof there.
   1b3. decode (BENCH_DECODE=0 to disable): serving-scale decode at a 32k
      KV cache through the DEFAULT path (blocked streaming kernel auto-
      engaged at M >= 8192); tokens/s, vs_baseline = fraction of the HBM
@@ -163,8 +171,14 @@ REF_LONGCTX_MFU = 175.0 / 312.0  # = 0.561
 def run_lane(model_name, batch, seq, gas, zero_stage, *, steps, warmup=3,
              master=False, use_flash=None, remat=True,
              policy="dots_with_no_batch_dims_saveable", sm_dtype=None,
-             loss_chunks=0, grad_accum_dtype=None):
-    """Build an engine for one configuration, time it, return the result dict."""
+             loss_chunks=0, grad_accum_dtype=None,
+             attention_backend=None, mesh_sequence=1):
+    """Build an engine for one configuration, time it, return the result dict.
+
+    `attention_backend` + `mesh_sequence` drive the context-parallel arms
+    of the longctx ring sweep: "ring"/"ring_ulysses" routes attention
+    through the dispatch layer's registered program over a
+    `sequence`-sized mesh axis (the remaining chips absorb into `data`)."""
     import dataclasses
 
     import jax
@@ -183,6 +197,7 @@ def run_lane(model_name, batch, seq, gas, zero_stage, *, steps, warmup=3,
         cfg, max_seq_len=max(cfg.max_seq_len, seq),
         use_flash_attention=(use_flash if seq % 128 == 0 else False),
         remat=remat,
+        attention_backend=attention_backend,
         remat_policy=policy, softmax_dtype=sm_dtype or jnp.bfloat16,
         loss_chunks=loss_chunks,
         scan_unroll=int(os.environ.get("BENCH_UNROLL", "1")),
@@ -202,6 +217,10 @@ def run_lane(model_name, batch, seq, gas, zero_stage, *, steps, warmup=3,
     }
     if grad_accum_dtype:
         ds_cfg["data_types"] = {"grad_accum_dtype": grad_accum_dtype}
+    if mesh_sequence > 1:
+        # context-parallel arm: sequence axis takes mesh_sequence chips,
+        # data absorbs the rest (dryrun_multichip's dp x sp factoring)
+        ds_cfg["mesh"] = {"sequence": int(mesh_sequence), "data": -1}
     # registry-only telemetry (no exporter files from a bench run): step-time
     # histogram + the engine's own achieved-MFU gauge ride into extra. The
     # analytic 6N numerator (measure_program_flops=False) avoids paying a
@@ -287,6 +306,20 @@ def run_lane(model_name, batch, seq, gas, zero_stage, *, steps, warmup=3,
             "memory": _memory_extra(engine),
         },
     }
+    # attention K/V residency attribution (the longctx ring sweep's proof
+    # quantity): one micro-batch's K+V activations across all layers, total
+    # and PER CHIP — context parallelism divides the per-chip claim by the
+    # sequence-axis size while the total is invariant
+    kv_total = (2 * cfg.n_layer * batch * seq * cfg.n_kv_head
+                * cfg.head_dim * jnp.dtype(cfg.dtype).itemsize)
+    result["extra"]["memory"]["attn_kv_bytes_total"] = int(kv_total)
+    result["extra"]["memory"]["attn_kv_bytes_per_chip"] = \
+        int(kv_total // max(1, mesh_sequence))
+    if attention_backend:
+        result["extra"]["attention_backend"] = attention_backend
+        result["extra"]["mesh_sequence"] = int(mesh_sequence)
+        result["metric"] = result["metric"].replace(
+            "_train_", f"_{attention_backend}_sp{int(mesh_sequence)}_train_")
     del engine, model
     return result
 
@@ -1344,6 +1377,71 @@ def main():
             longctx16k["extra"]["ref_mfu_longctx"] = round(REF_LONGCTX_MFU, 4)
             print(json.dumps(longctx16k))
 
+    # longctx ring sweep (PR 14): {flash, ring} x {64k, 128k} — context
+    # parallelism vs the single-chip streaming kernel at the sequence
+    # lengths where one chip's HBM is the wall. Each arm is its own child
+    # process (the sub_lane pattern); MFU/mfu_attn/tokens-per-sec ride the
+    # train-lane conventions and extra.memory carries the attributed K/V
+    # bytes total AND per chip (the ring arms' per-chip claim is 1/sp).
+    # Ring arms need a multi-chip `sequence` axis: on a 1-chip harness they
+    # are recorded as skipped, and the MULTICHIP dry-run carries the
+    # multi-chip parity proof instead. Knobs: BENCH_LONGCTX_RING=0
+    # disables; BENCH_LCR_{MODEL,SEQS,GAS,STEPS} shape the sweep.
+    longctx_ring = None
+    if env("BENCH_LONGCTX_RING", "1") == "1" and "BENCH_MODEL" not in os.environ:
+        import jax as _jax
+        n_chips = _jax.device_count()
+        arms = {}
+        for seq in [int(s) for s in
+                    env("BENCH_LCR_SEQS", "65536,131072").split(",")]:
+            for backend in ("flash", "ring"):
+                key = f"{backend}_{seq}"
+                if backend == "ring" and n_chips < 2:
+                    arms[key] = {"skipped": "ring needs a multi-chip "
+                                 "`sequence` axis (1 chip present; see the "
+                                 "MULTICHIP dry-run for the sp=4 proof)"}
+                    continue
+                extra_env = {} if backend == "flash" else {
+                    "BENCH_ATTN_BACKEND": "ring",
+                    "BENCH_MESH_SEQ": str(n_chips)}
+                r = sub_lane(
+                    key, BENCH_MODEL=env("BENCH_LCR_MODEL", "gpt2-350m"),
+                    BENCH_SEQ=str(seq), BENCH_BATCH="1",
+                    BENCH_GAS=env("BENCH_LCR_GAS", "4"),
+                    BENCH_LOSS_CHUNKS="8", BENCH_ZERO="1",
+                    BENCH_STEPS=env("BENCH_LCR_STEPS", "2"), **extra_env)
+                if r is None:
+                    # record the failure — a 128k arm that OOMs its child
+                    # must leave an artifact, not vanish from the sweep
+                    arms[key] = {"failed": "child lane produced no "
+                                 "result (stderr above)"}
+                    continue
+                arms[key] = {
+                    "metric": r["metric"],
+                    "tokens_per_sec_chip":
+                        r["extra"]["tokens_per_sec_chip"],
+                    "mfu": r["extra"]["mfu"],
+                    "mfu_attn": r["extra"]["mfu_attn"],
+                    "step_time_ms": r["extra"]["step_time_ms"],
+                    "memory": r["extra"]["memory"],
+                }
+        measured = [a for a in arms.values() if "mfu_attn" in a]
+        # the sweep record always prints — skipped/failed arms included —
+        # so "ring arms are recorded, not silent" holds even when nothing
+        # measured (value 0 marks an empty sweep)
+        best = max(measured, key=lambda a: a["mfu_attn"]) if measured \
+            else None
+        longctx_ring = {
+            "metric": "longctx_ring_sweep_best_mfu_attn",
+            "value": best["mfu_attn"] if best else 0.0,
+            "unit": "mfu_attn",
+            "vs_baseline": round(best["mfu_attn"] / REF_LONGCTX_MFU, 4)
+            if best else 0.0,
+            "extra": {"arms": arms,
+                      "ref_mfu_longctx": round(REF_LONGCTX_MFU, 4)},
+        }
+        print(json.dumps(longctx_ring))
+
     # long-context decode lane (serving): blocked streaming KV kernel at a
     # 32k cache, measured against the HBM bandwidth floor
     decode = None
@@ -1448,7 +1546,9 @@ def main():
         remat=env("BENCH_REMAT", "1") == "1",
         policy=env("BENCH_REMAT_POLICY", "dots_with_no_batch_dims_saveable"),
         sm_dtype=sm, loss_chunks=int(env("BENCH_LOSS_CHUNKS", "0")),
-        grad_accum_dtype=env("BENCH_ACCUM_DTYPE", "bf16") or None)
+        grad_accum_dtype=env("BENCH_ACCUM_DTYPE", "bf16") or None,
+        attention_backend=env("BENCH_ATTN_BACKEND") or None,
+        mesh_sequence=int(env("BENCH_MESH_SEQ", "1")))
     if north is not None:
         # all lanes land in the driver-recorded artifact (it parses the last
         # line; the extra lanes ride along in extra)
@@ -1473,6 +1573,13 @@ def main():
             "mfu": longctx16k["extra"]["mfu"],
             "mfu_attn": longctx16k["extra"]["mfu_attn"],
             "step_time_ms": longctx16k["extra"]["step_time_ms"],
+        }
+    if longctx_ring is not None:
+        headline["extra"]["longctx_ring"] = {
+            "metric": longctx_ring["metric"],
+            "value": longctx_ring["value"],
+            "vs_baseline": longctx_ring["vs_baseline"],
+            "arms": longctx_ring["extra"]["arms"],
         }
     if decode is not None:
         headline["extra"]["decode"] = {

@@ -13,7 +13,13 @@ idioms the codebase actually uses:
 2. module-level rebinding — ``_fn = jax.jit(_fn, donate_argnums=(2,))``;
 3. factories — a function/method whose ``return`` expression contains a
    ``jax.jit(...)`` call registers assignments from its call sites:
-   ``self._draft_steps = build_draft_program(...)``.
+   ``self._draft_steps = build_draft_program(...)``;
+4. program registries — the attention dispatch layer's idiom
+   (``ops/attention_dispatch.py``): ``prog = register_program(
+   AttentionProgram(..., runner=jax.jit(f)))`` binds a program OBJECT
+   whose ``.runner`` is the persistent jitted callable; the registry
+   records both the object name and its ``.runner`` path, so calls
+   through ``prog.runner(...)`` taint like any jitted program's.
 
 This is intentionally a heuristic model, not an import-time one: it never
 executes the module, so dynamically constructed programs (dict registries
@@ -138,11 +144,25 @@ class JitRegistry:
                     donate = reg.factories[callee]
             if donate is None:
                 continue
+            # registry idiom (4): `prog = register_*(... jax.jit(f) ...)`
+            # binds a program object carrying the jitted callable as
+            # `.runner` — record that path too so DT001's taint follows
+            # calls made through the registered program. The jit CALLABLE
+            # must flow in un-invoked (find_returned_jit):
+            # `register_x(jax.jit(f)(v))` passes the RESULT, the wrapper
+            # dies with the call, and `.runner` would be a phantom
+            registry_call = (isinstance(value, ast.Call)
+                             and (dotted(value.func) or "").split(".")[-1]
+                             .startswith("register")
+                             and find_returned_jit(value) is not None)
             for tgt in node.targets:
                 name = dotted(tgt)
                 if name:
                     reg.programs[name] = JitProgram(name, donate,
                                                     node.lineno)
+                    if registry_call:
+                        reg.programs[f"{name}.runner"] = JitProgram(
+                            f"{name}.runner", donate, node.lineno)
         return reg
 
     def lookup(self, call: ast.Call) -> Optional[JitProgram]:

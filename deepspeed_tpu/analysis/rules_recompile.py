@@ -12,7 +12,13 @@ catches it at review time). The codebase's sanctioned patterns:
 * construct lazily under a caching guard (`if self._prog is None:`), the
   degradation ladder's `decode_step_w1` idiom;
 * a factory that RETURNS the jitted callable (`build_draft_program`) —
-  its call sites hold the persistent handle.
+  its call sites hold the persistent handle;
+* a PROGRAM REGISTRY registration (the attention dispatch layer's idiom,
+  `ops/attention_dispatch.py`): a `jax.jit(...)` constructed inside the
+  arguments of a `register_*(...)` call is stored once in the registry —
+  registration is a once-per-lifetime construction context wherever it
+  happens (ring/quant attention programs register like the scheduler's
+  persistent programs).
 
 Anything else — a `jax.jit(...)` in a loop body, or in a plain function
 that is re-entered per step/request — fires. A jitted function whose
@@ -91,6 +97,19 @@ class RecompileHazardRule(Rule):
                           for n in chain)
             if guarded:
                 continue                      # lazy-build idiom
+            # program-registry idiom: the jit CALLABLE (not its result —
+            # `register_x(jax.jit(f)(v))` invokes per call and stays a
+            # hazard) flows into a register_*() call's arguments and is
+            # stored once, called forever
+            in_registry = any(
+                isinstance(n, ast.Call)
+                and (dotted(n.func) or "").split(".")[-1]
+                .startswith("register")
+                for n in chain)
+            invoked = any(isinstance(n, ast.Call) and n.func is node
+                          for n in chain)
+            if in_registry and not invoked:
+                continue
             in_loop = any(isinstance(n, (ast.For, ast.While))
                           for n in chain[:chain.index(funcs[0])])
             if in_loop:

@@ -30,6 +30,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.mesh import BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, shard_constraint
+from deepspeed_tpu.ops import attention_dispatch as attn_dispatch
 from deepspeed_tpu.runtime.engine import ModelSpec
 
 
@@ -88,6 +89,19 @@ class GPTConfig:
                                      # serving-scale caches that asymmetry,
                                      # not the matmul, decides; see
                                      # docs/kernels.md
+    attention_backend: Optional[str] = None  # explicit attention-program
+                                     # request for the dispatch layer
+                                     # (ops/attention_dispatch.py): "ring" /
+                                     # "ring_ulysses" engage context
+                                     # parallelism over the `sequence` mesh
+                                     # axis (K/V shards rotate via ppermute;
+                                     # the hybrid adds the Ulysses head
+                                     # all-to-all, sp = ulysses x ring).
+                                     # None = auto (flash/chunked/dense by
+                                     # the measured crossovers). Ignored
+                                     # when no `sequence` axis > 1 is
+                                     # installed — the request falls through
+                                     # to the auto programs
     chunked_attn_min_seq: Optional[int] = None  # remat/memory escape hatch:
                                      # T >= this routes to the q-chunked
                                      # rematerialized XLA path
@@ -418,13 +432,24 @@ def resolve_remat_policy(name):
     return getattr(jax.checkpoint_policies, name, None)
 
 
-FLASH_MIN_SEQ = 1024  # auto-dispatch crossover (see GPTConfig.use_flash_attention)
-# decode auto-dispatch: the blocked streaming kernel reads only the live
-# cache prefix (clamped block index map) while the XLA einsum reads the whole
-# allocated M every step; at serving-scale caches the allocation/prefix gap
-# dominates, below it XLA already sits at the bandwidth floor (see
-# GPTConfig.use_flash_attention and docs/kernels.md)
-DECODE_KERNEL_MIN_CTX = 8192
+# Dispatch crossovers live in ops/attention_dispatch.py (ONE home for the
+# predicates every attention call site shares); re-exported here for the
+# callers that read the constants (tests, bench).
+FLASH_MIN_SEQ = attn_dispatch.FLASH_MIN_SEQ
+DECODE_KERNEL_MIN_CTX = attn_dispatch.DECODE_KERNEL_MIN_CTX
+
+
+def _train_attn_site(cfg, T, S, has_bias, attn_fn):
+    """Dispatch key for the training/prefill attention call sites."""
+    return attn_dispatch.AttnSite(
+        phase="train", q_len=T, kv_len=S, causal=True,
+        has_bias=has_bias, has_window=bool(cfg.sliding_window),
+        scale_attn=cfg.scale_attn,
+        mesh_axes=attn_dispatch.active_mesh_axes(),
+        force_flash=cfg.use_flash_attention,
+        chunk_min=getattr(cfg, "chunked_attn_min_seq", None),
+        backend=getattr(cfg, "attention_backend", None),
+        external_fn=attn_fn is not None)
 
 
 def _attention(q, k, v, causal_mask, cfg, attn_fn=None, bias=None):
@@ -432,32 +457,21 @@ def _attention(q, k, v, causal_mask, cfg, attn_fn=None, bias=None):
 
     GQA (Hkv < H): query heads are grouped per kv head and contracted without
     materializing repeated k/v (reference serves GQA models like llama2-70b via
-    `module_inject/containers/llama2.py`). `bias`: additive [H, T, S] (alibi)."""
-    want_flash = (cfg.use_flash_attention is True
-                  or (cfg.use_flash_attention is None
-                      and q.shape[1] >= FLASH_MIN_SEQ))
-    if attn_fn is None and want_flash and bias is None \
-            and not cfg.sliding_window and cfg.scale_attn \
-            and q.shape[1] == k.shape[1] and q.shape[1] % 128 == 0:
-        chunk_min = getattr(cfg, "chunked_attn_min_seq", None)
-        if chunk_min is not None and q.shape[1] >= chunk_min:
-            # explicit remat/memory escape hatch (chunked_attn_min_seq): the
-            # streaming kernel itself has no sequence cap — this trades its
-            # speed for jax.checkpoint'd [block_q, T] strips when activation
-            # residuals at extreme T squeeze HBM
-            from deepspeed_tpu.ops.chunked_attention import chunked_attention
+    `module_inject/containers/llama2.py`). `bias`: additive [H, T, S] (alibi).
 
-            def attn_fn(q, k, v):
-                out = chunked_attention(
-                    jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
-                    jnp.swapaxes(v, 1, 2), causal=True)
-                return jnp.swapaxes(out, 1, 2)
-        else:
-            # HBM-streaming flash: one kernel for every T >= FLASH_MIN_SEQ —
-            # K/V tiles DMA from HBM, so 16k+ runs in-kernel instead of on
-            # the ~2.8x-slower rematerialized XLA fallback
-            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
-            attn_fn = partial(flash_attention, causal=True)
+    Program selection goes through the unified dispatch layer
+    (`ops/attention_dispatch.py`): flash at the measured crossover, the
+    chunked escape hatch, ring / ring∘Ulysses context parallelism on
+    request (`GPTConfig.attention_backend`), dense XLA otherwise — every
+    registered program's runner is invoked through the same matched-heads
+    external-fn path, so a new variant plugs in at the registry, not here."""
+    program = attn_dispatch.select(
+        _train_attn_site(cfg, q.shape[1], k.shape[1], bias is not None,
+                         attn_fn))
+    if program not in ("dense", "external"):
+        runner = attn_dispatch.get_program(program).runner
+        attn_fn = partial(runner, causal=True,
+                          sm_scale=None if cfg.scale_attn else 1.0)
     if attn_fn is not None:
         if k.shape[2] != q.shape[2]:  # external kernels expect matched heads
             rep = q.shape[2] // k.shape[2]
@@ -847,14 +861,18 @@ def _decode_qkv(x, p, positions, cfg: GPTConfig):
     return q, k, v
 
 
-def _decode_kernel_wanted(cfg: GPTConfig, M):
-    """Shared auto-engage rule for the streaming decode kernels: explicit
-    True forces, auto engages from DECODE_KERNEL_MIN_CTX with a
-    block-tileable length (contiguous path: M = allocated cache length;
-    paged path: M = table_width * block = the effective context)."""
-    return (cfg.use_flash_attention is True
-            or (cfg.use_flash_attention is None
-                and M >= DECODE_KERNEL_MIN_CTX and M % 128 == 0))
+def _decode_attn_site(cfg: GPTConfig, phase, C, M, kv_dtype="bfloat16",
+                      block_size=0):
+    """Dispatch key for the decode/paged call sites. The engage rule itself
+    (`attn_dispatch.decode_kernel_wanted`) has ONE definition shared by the
+    contiguous path (M = allocated cache length) and the paged path
+    (M = table_width * block = the effective context)."""
+    return attn_dispatch.AttnSite(
+        phase=phase, q_len=C, kv_len=M, causal=True,
+        has_bias=cfg.use_alibi, has_window=bool(cfg.sliding_window),
+        scale_attn=cfg.scale_attn, kv_dtype=kv_dtype, block_size=block_size,
+        mesh_axes=attn_dispatch.active_mesh_axes(),
+        force_flash=cfg.use_flash_attention)
 
 
 def _decode_attn_half(x, p, cache_k, cache_v, pos, cfg: GPTConfig,
@@ -882,7 +900,6 @@ def _decode_attn_half(x, p, cache_k, cache_v, pos, cfg: GPTConfig,
     cache_k = cache_k * (1 - onehot)[:, None, :, None] + onehot[:, None, :, None] * k_new
     cache_v = cache_v * (1 - onehot)[:, None, :, None] + onehot[:, None, :, None] * v_new
 
-    use_plain_path = cfg.use_alibi or cfg.sliding_window
     # decode kernel: explicit True forces it; auto engages from
     # DECODE_KERNEL_MIN_CTX — the blocked streaming kernel reads only the
     # live prefix of the cache while the XLA einsum reads the whole
@@ -891,8 +908,19 @@ def _decode_attn_half(x, p, cache_k, cache_v, pos, cfg: GPTConfig,
     # auto additionally requires a block-tileable M (128-multiple): an
     # unrounded cache would otherwise pay a whole-cache pad-to-block copy
     # INSIDE every jitted decode step (the engine's kv_block_size rounding
-    # guarantees this; direct callers with odd M stay on XLA)
-    if _decode_kernel_wanted(cfg, M) and not use_plain_path:
+    # guarantees this; direct callers with odd M stay on XLA). Alibi/window
+    # archs disqualify the kernel — all through the dispatch registry.
+    program = attn_dispatch.select(_decode_attn_site(cfg, "decode", 1, M))
+    if program not in ("decode_kernel", "decode_dense"):
+        # the decode/paged sites dispatch BY NAME (their call signatures
+        # carry cache state the train-phase runner protocol doesn't):
+        # an unknown registered program must fail loudly here, not fall
+        # into a numerically-different path
+        raise NotImplementedError(
+            f"attention program {program!r} selected for the contiguous "
+            f"decode site has no handler in models/gpt.py — non-train "
+            f"phases dispatch by name; add a branch for it here")
+    if program == "decode_kernel":
         from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
         attn = decode_attention(
             q[:, 0], cache_k, cache_v, pos,
@@ -1010,16 +1038,18 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
     # stacked blocks with the pool's layer axis as scan data, exactly like
     # the contiguous cache path, so layer count stays out of compile time
 
-    def _scan_paged(params, x, pool, block_tables, positions):
+    def _scan_paged(params, x, pool, block_tables, positions, phase=None):
         # the pool rides the scan as a PYTREE of [L, ...] leaves (k/v, plus
         # the int8 pool's k_scale/v_scale), so the quantized and fp layouts
-        # share one scan body — the per-layer slice arrives as a dict
+        # share one scan body — the per-layer slice arrives as a dict.
+        # `phase` labels the dispatch site ("verify" for the spec-decode
+        # chunk; None = derive decode/prefill from the chunk width)
         flags = _layer_local_flags(cfg)
 
         def body(x, inputs, flag=None):
             p, pool_l = inputs
             x, pool_l = _block_paged(x, p, pool_l, positions, block_tables,
-                                     cfg, local_flag=flag)
+                                     cfg, local_flag=flag, phase=phase)
             return x, pool_l
 
         layers = (params["blocks"], pool)
@@ -1056,7 +1086,8 @@ def make_gpt_decode_model(cfg: GPTConfig = None, name="gpt2-125m", params=None, 
         B, C = tokens.shape
         positions = pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None]
         x = _embed(params, tokens, positions, cfg)
-        x, pool = _scan_paged(params, x, pool, block_tables, positions)
+        x, pool = _scan_paged(params, x, pool, block_tables, positions,
+                              phase="verify")
         logits = _lm_head(params, x, cfg)
         return logits, pool
 
@@ -1146,7 +1177,7 @@ def _paged_attend(q, k_ctx, v_ctx, q_pos, cfg: GPTConfig, local_flag=None):
 
 
 def _paged_attn_half(x, p, pool_l, positions, block_tables,
-                     cfg: GPTConfig, local_flag=None):
+                     cfg: GPTConfig, local_flag=None, phase=None):
     """Attention half-block against one layer's paged pool.
 
     x: [B, C, D]; pool_l: one layer's pool slice — ``k``/``v``
@@ -1198,33 +1229,36 @@ def _paged_attn_half(x, p, pool_l, positions, block_tables,
         pool_l["v"] = pool_l["v"].at[blk, :, off, :].set(
             v.astype(pool_l["v"].dtype))
 
-    use_plain_path = cfg.use_alibi or cfg.sliding_window
     # single-token steps ride the paged Pallas kernel when it is worth it:
     # same engage rule as the contiguous decode path (forced, or auto at
     # serving-scale effective context nb*bs), PLUS the paged-only
     # constraints: the kernel's no-bias/no-window contract, a lane-aligned
     # pool block (it cannot pad physical blocks the way the contiguous
-    # kernel pads a whole cache), and C == 1 — chunked prefill always takes
-    # the gather path (matmul-bound, not gather-bound).
-    want_kernel = (C == 1 and not use_plain_path and bs % 128 == 0
-                   and _decode_kernel_wanted(cfg, nb * bs))
-    if want_kernel:
-        sm = None if cfg.scale_attn else 1.0
-        if quantized:
-            from deepspeed_tpu.ops.pallas.decode_attention import \
-                paged_decode_attention_quant
-            attn = paged_decode_attention_quant(
-                q[:, 0], pool_l["k"], pool_l["v"], pool_l["k_scale"],
-                pool_l["v_scale"], block_tables, positions[:, 0],
-                sm_scale=sm).reshape(B, 1, D)
-        else:
-            from deepspeed_tpu.ops.pallas.decode_attention import \
-                paged_decode_attention
-            attn = paged_decode_attention(
-                q[:, 0], pool_l["k"], pool_l["v"], block_tables,
-                positions[:, 0], sm_scale=sm).reshape(B, 1, D)
-    else:
-        if quantized:
+    # kernel pads a whole cache), and C == 1 — chunked prefill and the
+    # spec-decode verify chunk always take the gather path (matmul-bound,
+    # not gather-bound). The int8-pool kernel is an ordinary REGISTERED
+    # program keyed on kv_dtype, not a special case here.
+    program = attn_dispatch.select(_decode_attn_site(
+        cfg,
+        phase or ("paged_decode" if C == 1 else "prefill_chunk"), C, nb * bs,
+        kv_dtype="int8" if quantized else str(jnp.dtype(pool_l["k"].dtype)),
+        block_size=bs))
+    if program == "paged_kernel_quant":
+        from deepspeed_tpu.ops.pallas.decode_attention import \
+            paged_decode_attention_quant
+        attn = paged_decode_attention_quant(
+            q[:, 0], pool_l["k"], pool_l["v"], pool_l["k_scale"],
+            pool_l["v_scale"], block_tables, positions[:, 0],
+            sm_scale=None if cfg.scale_attn else 1.0).reshape(B, 1, D)
+    elif program == "paged_kernel":
+        from deepspeed_tpu.ops.pallas.decode_attention import \
+            paged_decode_attention
+        attn = paged_decode_attention(
+            q[:, 0], pool_l["k"], pool_l["v"], block_tables,
+            positions[:, 0],
+            sm_scale=None if cfg.scale_attn else 1.0).reshape(B, 1, D)
+    elif program in ("paged_gather_quant", "paged_gather"):
+        if program == "paged_gather_quant":
             k_ctx, v_ctx = gather_block_kv_dequant(pool_l, block_tables,
                                                    x.dtype)
         else:
@@ -1232,15 +1266,26 @@ def _paged_attn_half(x, p, pool_l, positions, block_tables,
                                            block_tables)
         attn = _paged_attend(q, k_ctx, v_ctx, positions, cfg,
                              local_flag=local_flag)
+    else:
+        # see the contiguous decode site: by-name dispatch, loud failure
+        # for programs without a handler (an unknown name silently taking
+        # the fp gather would read int8 payload as K/V on quantized pools)
+        raise NotImplementedError(
+            f"attention program {program!r} selected for the paged site "
+            f"has no handler in models/gpt.py — non-train phases dispatch "
+            f"by name; add a branch for it here")
     attn_out = attn @ p["attn_out_w"] + p["attn_out_b"]
     return attn_out, pool_l
 
 
 def _block_paged(x, p, pool_l, positions, block_tables,
-                 cfg: GPTConfig, local_flag=None):
-    """One transformer block against the paged pool (decode or prefill chunk)."""
+                 cfg: GPTConfig, local_flag=None, phase=None):
+    """One transformer block against the paged pool (decode, prefill
+    chunk, or the spec-decode verify chunk — `phase` labels the dispatch
+    site)."""
     attn_out, pool_l = _paged_attn_half(
-        x, p, pool_l, positions, block_tables, cfg, local_flag=local_flag)
+        x, p, pool_l, positions, block_tables, cfg, local_flag=local_flag,
+        phase=phase)
     x = _residual_mlp(x, attn_out, p, cfg, constrain=False)
     return x, pool_l
 

@@ -1,0 +1,359 @@
+"""Unified attention dispatch — ONE decision layer for every attention call.
+
+The model zoo has five attention entry points (training flash/chunked/dense,
+chunked paged prefill, paged single-token decode, paged spec-decode verify,
+contiguous-cache decode) and until PR 14 each call site carried its own copy
+of the engage predicate: the training `use_flash_attention` check lived at
+`models/gpt.py::_attention` while the decode-kernel check lived 400 lines
+away in `_decode_kernel_wanted`, and every new variant (the PR 12 quantized
+kernels, ring context parallelism) had to be special-cased at each site.
+
+This module is the single home for those decisions. A call site builds an
+`AttnSite` — the dispatch KEY: (phase, q/kv length, mesh axes, kv dtype)
+plus the masking flags that disqualify kernels — and `select()` walks the
+PROGRAM REGISTRY (highest priority first) to name the program that runs.
+Variants register once here instead of branching at five call sites:
+
+  * the ring / ring∘Ulysses context-parallel programs (`parallel/ring.py`)
+    register with a `runner` — the training forward invokes them through
+    the registry without knowing their internals;
+  * the PR 12 quantized paged kernels register as ordinary programs keyed
+    on `kv_dtype`, not as an if/else inside the paged attention half.
+
+Every predicate reads only TRACE-TIME-STATIC inputs (shapes, config
+fields, the installed mesh spec), so dispatch can never cause a recompile:
+the serving tier's ≤1-compile-per-program invariant is untouched, and
+`dstpu_lint` DT004 treats `register_program` as a once-per-lifetime
+construction context (programs built at registration time are persistent,
+exactly like the scheduler's `_build_*` programs).
+
+`dispatch_table()` renders the live registry — the reference table in
+docs/kernels.md is generated from the same data the dispatcher walks.
+"""
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+# ----------------------------------------------------------------------
+# engage predicates — the ONE home of the measured crossovers
+# ----------------------------------------------------------------------
+
+# Training auto-dispatch crossover (measured r4, bf16 dots + 512-blocks:
+# XLA materialized attention wins <= 512, flash wins 1.6x/2.3x/3.4x at
+# 1k/2k/4k fwd+bwd) — see GPTConfig.use_flash_attention.
+FLASH_MIN_SEQ = 1024
+# Decode auto-dispatch: the blocked streaming kernel reads only the live
+# cache prefix while the XLA einsum reads the whole allocated M every step;
+# below this the einsum already sits at the bandwidth floor (r5: 174-204us
+# vs kernel 189us vs floor 164us at ctx 8k) — see docs/kernels.md.
+DECODE_KERNEL_MIN_CTX = 8192
+
+
+def flash_wanted(force_flash: Optional[bool], T: int) -> bool:
+    """THE training-attention flash predicate (single definition — the two
+    historical copies at models/gpt.py:436 and :855 both resolve here).
+    `force_flash` is `GPTConfig.use_flash_attention`: True forces, False
+    forbids, None auto-engages from FLASH_MIN_SEQ."""
+    return force_flash is True or (force_flash is None and T >= FLASH_MIN_SEQ)
+
+
+def decode_kernel_wanted(force_flash: Optional[bool], M: int) -> bool:
+    """THE decode-kernel predicate: explicit True forces, auto engages from
+    DECODE_KERNEL_MIN_CTX with a block-tileable length (contiguous path:
+    M = allocated cache length; paged path: M = table_width * block = the
+    effective context)."""
+    return (force_flash is True
+            or (force_flash is None
+                and M >= DECODE_KERNEL_MIN_CTX and M % 128 == 0))
+
+
+def active_mesh_axes() -> Tuple[str, ...]:
+    """Mesh axes with size > 1 on the installed global mesh (() when no
+    mesh) — the `mesh_axes` component of the dispatch key."""
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    if not mesh_mod.has_mesh():
+        return ()
+    sizes = mesh_mod.get_spec().axis_sizes()
+    return tuple(name for name, n in sizes.items() if n > 1)
+
+
+# ----------------------------------------------------------------------
+# the dispatch key
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AttnSite:
+    """One attention call site's dispatch key. Everything here is known at
+    trace time; nothing data-dependent may enter (that would make program
+    selection a recompile hazard)."""
+    phase: str                    # "train" | "decode" | "paged_decode" |
+                                  # "prefill_chunk" | "verify"
+    q_len: int                    # query length (T; chunk C; 1 for decode)
+    kv_len: int                   # key/context length (T, M, or nb*block)
+    causal: bool = True
+    has_bias: bool = False        # additive bias (alibi) present
+    has_window: bool = False      # sliding-window / per-layer local mask
+    scale_attn: bool = True       # False = unscaled scores (GPT-Neo)
+    kv_dtype: str = "bfloat16"    # KV storage dtype ("int8" = quantized pool)
+    block_size: int = 0           # paged pool physical block (paged phases)
+    mesh_axes: Tuple[str, ...] = ()  # active (size>1) mesh axes
+    force_flash: Optional[bool] = None  # GPTConfig.use_flash_attention
+    chunk_min: Optional[int] = None     # GPTConfig.chunked_attn_min_seq
+    backend: Optional[str] = None       # GPTConfig.attention_backend request
+    external_fn: bool = False     # caller supplied its own attn_fn — only
+                                  # the "external" pseudo-program may match
+
+    @property
+    def square(self) -> bool:
+        return self.q_len == self.kv_len
+
+
+# ----------------------------------------------------------------------
+# the program registry
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AttentionProgram:
+    """One registered attention implementation.
+
+    `matches` decides eligibility from the AttnSite alone; `runner`, when
+    set, is the zoo-layout callable ([B, T, H, hd] q/k/v, matched heads)
+    the training forward invokes — phases whose call signatures carry pool
+    state (decode/paged) dispatch by NAME and invoke at the call site.
+    `when` is the human-readable engage condition for `dispatch_table()`
+    and docs/kernels.md."""
+    name: str
+    phases: Tuple[str, ...]
+    priority: int                 # higher wins among eligible programs
+    matches: Callable[[AttnSite], bool]
+    when: str = ""
+    runner: Optional[Callable] = None
+
+
+_REGISTRY: Dict[str, AttentionProgram] = {}
+
+
+def register_program(program: AttentionProgram) -> AttentionProgram:
+    """Add (or replace) a program in the dispatch registry. Registration is
+    a once-per-lifetime construction context: a program whose runner closes
+    over jitted callables builds them HERE, not per call."""
+    _REGISTRY[program.name] = program
+    return program
+
+
+def get_program(name: str) -> AttentionProgram:
+    return _REGISTRY[name]
+
+
+def registered_programs(phase: Optional[str] = None):
+    """Programs (highest priority first, name-tiebroken) — the order
+    `select` walks."""
+    progs = [p for p in _REGISTRY.values()
+             if phase is None or phase in p.phases]
+    return sorted(progs, key=lambda p: (-p.priority, p.name))
+
+
+def select(site: AttnSite) -> str:
+    """Name the program this site runs: the highest-priority registered
+    program whose `matches(site)` holds. Every phase registers a priority-0
+    fallback that always matches, so selection is total.
+
+    An explicit ring-family `backend` request on a live `sequence` mesh
+    that resolves to a NON-ring program (the site carries alibi/window
+    bias or non-square attention — outside the kernel contract) raises
+    instead of silently materializing dense attention: at the 128k+
+    contexts context parallelism exists for, the dense fallback is an
+    HBM OOM far from its cause. (A request with NO `sequence` axis still
+    falls through to auto — that degenerate case is exact and documented
+    on `GPTConfig.attention_backend`.) A backend string naming NO
+    registered program is a config typo and raises immediately — silently
+    ignoring "ring-ulysses" would hand a 128k run to single-chip dense."""
+    if site.phase == "train" and site.backend is not None \
+            and site.backend not in _REGISTRY:
+        raise ValueError(
+            f"unknown attention_backend {site.backend!r}: no program of "
+            f"that name is registered (registered: {sorted(_REGISTRY)})")
+    for prog in registered_programs(site.phase):
+        if prog.matches(site):
+            if (site.phase == "train"
+                    and site.backend in ("ring", "ring_ulysses")
+                    and "sequence" in site.mesh_axes
+                    and prog.name not in ("ring", "ring_ulysses",
+                                          "external")):
+                raise ValueError(
+                    f"attention_backend={site.backend!r} was requested on "
+                    f"a `sequence` mesh but this site is ineligible for "
+                    f"the ring programs (alibi/sliding-window bias or "
+                    f"non-square attention — the plain-causal kernel "
+                    f"contract) — resolved program would be "
+                    f"{prog.name!r}. Drop the backend request or the "
+                    f"arch flag")
+            return prog.name
+    raise LookupError(
+        f"no attention program registered for phase {site.phase!r} "
+        f"(registry: {sorted(_REGISTRY)})")
+
+
+def dispatch_table() -> Dict[str, list]:
+    """phase -> [(program, when)] in selection order — the reference table
+    (docs/kernels.md renders this)."""
+    phases = ("train", "prefill_chunk", "decode", "paged_decode", "verify")
+    return {ph: [(p.name, p.when) for p in registered_programs(ph)]
+            for ph in phases}
+
+
+# ----------------------------------------------------------------------
+# built-in programs
+# ----------------------------------------------------------------------
+# Priorities: 100s = explicit backend requests (ring family), 50s =
+# kernel/escape-hatch engagement, 0 = the always-eligible dense fallback.
+
+
+def _kernel_shape_ok(site: AttnSite) -> bool:
+    """Kernel-path disqualifiers shared by flash/chunked/ring: the Pallas
+    contract is plain (un-biased, un-windowed, scaled) square causal-or-not
+    attention on 128-multiple sequences."""
+    return (not site.has_bias and not site.has_window and site.square
+            and site.q_len % 128 == 0)
+
+
+def _train_external(site):
+    return site.external_fn
+
+
+def _train_ring(site):
+    return (site.backend in ("ring", "ring_ulysses")
+            and "sequence" in site.mesh_axes
+            and not site.has_bias and not site.has_window and site.square)
+
+
+def _train_chunked(site):
+    return (site.phase == "train" and _kernel_shape_ok(site)
+            and site.scale_attn and site.causal
+            and flash_wanted(site.force_flash, site.q_len)
+            and site.chunk_min is not None and site.q_len >= site.chunk_min)
+
+
+def _train_flash(site):
+    return (site.phase == "train" and _kernel_shape_ok(site)
+            and site.scale_attn and site.causal
+            and flash_wanted(site.force_flash, site.q_len))
+
+
+def _run_ring(q, k, v, *, causal=True, sm_scale=None):
+    from deepspeed_tpu.parallel.ring import ring_attention
+    return ring_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+
+
+def _run_ring_ulysses(q, k, v, *, causal=True, sm_scale=None):
+    from deepspeed_tpu.parallel.ring import ring_ulysses_attention
+    return ring_ulysses_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+
+
+def _run_flash(q, k, v, *, causal=True, sm_scale=None):
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+    return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+
+
+def _run_chunked(q, k, v, *, causal=True, sm_scale=None):
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.chunked_attention import chunked_attention
+    out = chunked_attention(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                            jnp.swapaxes(v, 1, 2), causal=causal,
+                            sm_scale=sm_scale)
+    return jnp.swapaxes(out, 1, 2)
+
+
+register_program(AttentionProgram(
+    name="external", phases=("train",), priority=1000,
+    matches=_train_external,
+    when="caller passed an explicit attn_fn (sparse/Ulysses wrappers)"))
+
+register_program(AttentionProgram(
+    name="ring_ulysses", phases=("train",), priority=110,
+    matches=lambda s: _train_ring(s) and s.backend == "ring_ulysses",
+    when="attention_backend='ring_ulysses', `sequence` mesh axis active; "
+         "sp = ulysses_degree x ring_degree (head all-to-all around the "
+         "K/V ring)",
+    runner=_run_ring_ulysses))
+
+register_program(AttentionProgram(
+    name="ring", phases=("train",), priority=100,
+    matches=lambda s: _train_ring(s) and s.backend == "ring",
+    when="attention_backend='ring', `sequence` mesh axis active; K/V "
+         "shards rotate via ppermute, flash kernel per ring step",
+    runner=_run_ring))
+
+register_program(AttentionProgram(
+    name="chunked", phases=("train",), priority=60,
+    matches=_train_chunked,
+    when="chunked_attn_min_seq set and T >= it (remat/memory escape "
+         "hatch; ~2.8x slower than flash)",
+    runner=_run_chunked))
+
+register_program(AttentionProgram(
+    name="flash", phases=("train",), priority=50,
+    matches=_train_flash,
+    when=f"T >= {FLASH_MIN_SEQ} (auto) or use_flash_attention=True; "
+         "plain scaled causal, T % 128 == 0",
+    runner=_run_flash))
+
+register_program(AttentionProgram(
+    name="dense", phases=("train",), priority=0,
+    matches=lambda s: True,
+    when="fallback: XLA materialized attention (GQA grouped einsum, "
+         "alibi/window masks, short T)"))
+
+
+# -- contiguous-cache decode ------------------------------------------------
+
+register_program(AttentionProgram(
+    name="decode_kernel", phases=("decode",), priority=50,
+    matches=lambda s: (not s.has_bias and not s.has_window
+                       and decode_kernel_wanted(s.force_flash, s.kv_len)),
+    when=f"M >= {DECODE_KERNEL_MIN_CTX} and M % 128 == 0 (auto) or "
+         "use_flash_attention=True; no alibi/window"))
+
+register_program(AttentionProgram(
+    name="decode_dense", phases=("decode",), priority=0,
+    matches=lambda s: True,
+    when="fallback: XLA einsum over the whole allocated cache"))
+
+
+# -- paged pool (serving) ---------------------------------------------------
+
+
+def _paged_kernel_ok(site):
+    return (site.phase == "paged_decode" and site.q_len == 1
+            and not site.has_bias and not site.has_window
+            and site.block_size % 128 == 0
+            and decode_kernel_wanted(site.force_flash, site.kv_len))
+
+
+register_program(AttentionProgram(
+    name="paged_kernel_quant", phases=("paged_decode",), priority=60,
+    matches=lambda s: _paged_kernel_ok(s) and s.kv_dtype == "int8",
+    when="int8 pool + kernel conditions: streamed tiles dequantize "
+         "in-kernel (paged_decode_attention_quant)"))
+
+register_program(AttentionProgram(
+    name="paged_kernel", phases=("paged_decode",), priority=50,
+    matches=_paged_kernel_ok,
+    when="C == 1, block % 128 == 0, effective context nb*block past the "
+         "decode crossover; no alibi/window"))
+
+register_program(AttentionProgram(
+    name="paged_gather_quant",
+    phases=("paged_decode", "prefill_chunk", "verify"), priority=10,
+    matches=lambda s: s.kv_dtype == "int8",
+    when="int8 pool on the gather path: dequantizing gather oracle "
+         "(chunked prefill, verify, CPU/arch-flag fallbacks)"))
+
+register_program(AttentionProgram(
+    name="paged_gather",
+    phases=("paged_decode", "prefill_chunk", "verify"), priority=0,
+    matches=lambda s: True,
+    when="fallback: table gather + dense attend (matmul-bound chunked "
+         "prefill and spec-decode verify always take this)"))

@@ -373,6 +373,15 @@ def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def flash_seq_tileable(T):
+    """True when the kernel's 128-lane tiling divides T — the shard-shape
+    contract ring attention (`parallel/ring.py`) checks before forcing the
+    kernel on a per-rank T/sp shard, and the zoo's dispatch layer checks
+    for the whole-sequence path. One definition, next to the lane width it
+    encodes."""
+    return T % _LANES == 0
+
+
 def flash_max_seq(d_head, itemsize=2, hbm_budget=12 * 2**30):
     """Largest single-device T the STREAMING kernel can serve. K/V tiles are
     DMA'd from HBM per grid step, so VMEM no longer bounds the sequence —

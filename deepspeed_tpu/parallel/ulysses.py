@@ -66,8 +66,16 @@ def ulysses_shard_map_attention(attn_fn, mesh=None):
     """Explicit shard_map Ulysses for manual control: q,k,v are global arrays
     sharded [B@data, T@sequence, H@tensor, hd]; inside, each sequence rank trades
     its sequence shard for a head shard, runs local attention on the full sequence,
-    then trades back."""
+    then trades back.
+
+    The head-scatter all-to-all hands each of the sp sequence ranks a whole
+    number of heads, so the per-tensor-shard head count must divide by sp —
+    validated eagerly per call with a clear ValueError (the alternative is a
+    shape-mismatch error deep inside XLA's all-to-all lowering)."""
     mesh = mesh or mesh_mod.get_mesh()
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    sp = sizes.get(SEQ_AXIS, 1)
+    tp = sizes.get(TENSOR_AXIS, 1)
 
     spec = P(BATCH_AXES, SEQ_AXIS, TENSOR_AXIS, None)
 
@@ -79,5 +87,22 @@ def ulysses_shard_map_attention(attn_fn, mesh=None):
         o = attn_fn(q, k, v)
         return seq_all_to_all(o, scatter_axis=1, gather_axis=2)
 
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                     check_vma=False)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                   check_vma=False)
+
+    def validated(q, k, v):
+        for name, x in (("q", q), ("k", k), ("v", v)):
+            h_local = x.shape[2] // tp if x.shape[2] % tp == 0 else None
+            if h_local is None or h_local % sp != 0:
+                raise ValueError(
+                    f"ulysses_shard_map_attention: {name} has {x.shape[2]} "
+                    f"heads — after the {tp}-way tensor split, the per-shard "
+                    f"head count must divide by the {sp}-way `sequence` axis "
+                    f"(the all-to-all scatters whole heads per rank). Use a "
+                    f"head count divisible by tp*sp={tp * sp}, lower the "
+                    f"sequence axis, or compose with ring context "
+                    f"parallelism (parallel/ring.py ring_ulysses_attention: "
+                    f"the non-dividing factor of sp moves to the K/V ring)")
+        return fn(q, k, v)
+
+    return validated

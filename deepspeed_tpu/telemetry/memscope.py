@@ -66,7 +66,8 @@ __all__ = [
 # literal-name scan cannot see them); growing this tuple means growing the
 # docs/profiling.md catalog row
 LEDGER_GAUGES = (
-    "params_bytes", "kv_pool_bytes", "prefix_cached_bytes",
+    "params_bytes", "kv_pool_bytes", "kv_pool_per_chip_bytes",
+    "prefix_cached_bytes",
     "draft_params_bytes", "draft_pool_bytes",
     "master_bytes", "opt_state_bytes",
     "program_temp_bytes", "bytes_in_use", "peak_bytes", "capacity_bytes",
@@ -449,7 +450,7 @@ def serving_pool_bytes(*, n_layer, n_kv_head, head_dim, kv_block_size,
 def plan_serving(*, n_layer, n_kv_head, head_dim, kv_block_size,
                  num_kv_blocks, kv_cache_dtype="bfloat16", kv_group_size=0,
                  n_params=0, param_dtype="bfloat16", params_bytes=None,
-                 tp=1, draft=None, temp_bytes=0,
+                 tp=1, sequence_parallel=1, draft=None, temp_bytes=0,
                  capacity_bytes=0) -> MemoryPlan:
     """Serving-resident memory prediction: weights + the paged KV pool
     (+ the spec-decode draft mirror, which shares num_kv_blocks/block_size
@@ -457,8 +458,16 @@ def plan_serving(*, n_layer, n_kv_head, head_dim, kv_block_size,
     model's `n_layer`/`n_kv_head`/`head_dim` and `n_params` (or
     `params_bytes`). `temp_bytes` carries the compiled-step temp (measured
     via `aot_memory_analysis`, or a margin) — decode/prefill temps are
-    small next to the pool, but headroom claims should include them."""
+    small next to the pool, but headroom claims should include them.
+
+    `sequence_parallel` > 1 prices the SEQUENCE-SHARDED pool
+    (`inference/sequence_span.py`): `num_kv_blocks` stays the GLOBAL block
+    count, the pool's physical-block axis spans sp chips, so the per-chip
+    kv_pool claim — the number this per-device plan judges against
+    capacity — is total/sp. Weights replicate across the sequence axis
+    (only tp divides them), so `params` is unchanged."""
     tp = max(1, int(tp))
+    sp = max(1, int(sequence_parallel))
     dev: Dict[str, int] = {}
     notes: List[str] = []
     if params_bytes is None:
@@ -467,7 +476,11 @@ def plan_serving(*, n_layer, n_kv_head, head_dim, kv_block_size,
     dev["kv_pool"] = serving_pool_bytes(
         n_layer=n_layer, n_kv_head=n_kv_head, head_dim=head_dim,
         kv_block_size=kv_block_size, num_kv_blocks=num_kv_blocks,
-        kv_cache_dtype=kv_cache_dtype, kv_group_size=kv_group_size)
+        kv_cache_dtype=kv_cache_dtype, kv_group_size=kv_group_size) // sp
+    if sp > 1:
+        notes.append(f"sequence-sharded pool (sequence_parallel={sp}): "
+                     f"block tables span the `sequence` axis — per-chip "
+                     f"KV bytes are 1/{sp} of the global pool")
     if kv_cache_is_quantized(kv_cache_dtype):
         notes.append("int8 KV pool: payload bytes + f32 per-group scales "
                      f"(group {int(kv_group_size) or int(head_dim)})")
@@ -482,7 +495,7 @@ def plan_serving(*, n_layer, n_kv_head, head_dim, kv_block_size,
             head_dim=draft["head_dim"], kv_block_size=kv_block_size,
             num_kv_blocks=num_kv_blocks,
             kv_cache_dtype=draft.get("kv_cache_dtype", kv_cache_dtype),
-            kv_group_size=draft.get("kv_group_size", 0))
+            kv_group_size=draft.get("kv_group_size", 0)) // sp
         notes.append("draft mirror shares the target's num_kv_blocks/"
                      "block_size (indexed by the same block tables)")
     notes.append("prefix-cached blocks live INSIDE kv_pool (a view, "
@@ -493,15 +506,22 @@ def plan_serving(*, n_layer, n_kv_head, head_dim, kv_block_size,
 
 def max_kv_blocks(capacity_bytes, *, n_layer, n_kv_head, head_dim,
                   kv_block_size, kv_cache_dtype="bfloat16", kv_group_size=0,
-                  params_bytes=0, temp_bytes=0, draft=None) -> int:
+                  params_bytes=0, temp_bytes=0, sequence_parallel=1,
+                  draft=None) -> int:
     """The inverse question serving deployment actually asks: the largest
     `num_kv_blocks` that fits `capacity_bytes` next to the weights (and
     the draft mirror, whose pool grows block-for-block with the target's).
     An int8 `kv_cache_dtype` prices each block at payload + scales
     (`serving_pool_bytes`), so the same budget answers ~2x the blocks —
     2/(1 + 4/g) of bf16's, exactly.
-    Remember one block (TRASH_BLOCK) is reserved: usable capacity is the
-    returned value minus one."""
+    `sequence_parallel` > 1: `capacity_bytes` is PER CHIP but the answer
+    stays the GLOBAL block count of the sequence-sharded pool. Shards hold
+    WHOLE blocks (the pool is sp equal shard ranges), so the answer is
+    (blocks-per-shard that fit one chip) × sp — exactly sp× the flat
+    answer, never overfilling a shard with a fractional-block credit.
+    Remember per-shard local block 0 is reserved as trash: usable capacity
+    is the returned value minus `sequence_parallel` blocks."""
+    sp = max(1, int(sequence_parallel))
     per_block = serving_pool_bytes(
         n_layer=n_layer, n_kv_head=n_kv_head, head_dim=head_dim,
         kv_block_size=kv_block_size, num_kv_blocks=1,
@@ -519,8 +539,10 @@ def max_kv_blocks(capacity_bytes, *, n_layer, n_kv_head, head_dim,
             num_kv_blocks=1,
             kv_cache_dtype=draft.get("kv_cache_dtype", kv_cache_dtype),
             kv_group_size=draft.get("kv_group_size", 0))
+    # shards hold WHOLE blocks: one chip fits free//per_block of them, and
+    # the global sequence-sharded pool is sp such shard ranges (sp=1: flat)
     free = int(capacity_bytes) - fixed
-    return max(0, free // max(1, per_block))
+    return max(0, (free // max(1, per_block)) * sp)
 
 
 def plan_serving_prealloc(spec, *, num_kv_blocks, kv_block_size,
@@ -842,6 +864,11 @@ class ServingMemScope(_MemScopeBase):
         # static footprints, measured once from the live trees
         self.params_bytes = tree_bytes(serving.engine.params)
         self.pool_bytes = tree_bytes(serving.pool)
+        # sequence-spanning pools shard the physical-block axis over
+        # `span_shards` chips; an engine built over a SpanKVPool mirrors
+        # the pool's span_shards attr here (the ledger wire —
+        # inference/sequence_span.py SpanKVPool docstring); 1 = flat pool
+        self.span_shards = max(1, int(getattr(serving, "span_shards", 1)))
         self.block_bytes = self.pool_bytes // max(1,
                                                   serving.allocator.num_blocks)
         dr = serving.drafter
@@ -856,7 +883,14 @@ class ServingMemScope(_MemScopeBase):
         if self.draft_params_bytes or self.draft_pool_bytes:
             cats["draft_params_bytes"] = self.draft_params_bytes
             cats["draft_pool_bytes"] = self.draft_pool_bytes
-        info = {}
+        info = {
+            # per-sequence-shard residency: equals kv_pool_bytes for the
+            # flat pool; 1/sp of it when the pool spans the sequence axis —
+            # the live-ledger counterpart of plan_serving's
+            # sequence_parallel pricing. Informational (a per-chip VIEW of
+            # kv_pool_bytes, never added to the attribution sum).
+            "kv_pool_per_chip_bytes": self.pool_bytes // self.span_shards,
+        }
         pc = self.serving.prefix_cache
         if pc is not None:
             # a VIEW of kv_pool (blocks the cache holds matchable), never
@@ -1042,6 +1076,12 @@ def main(argv=None) -> int:
     ap.add_argument("--fit", action="store_true",
                     help="serving: report the LARGEST num_kv_blocks that "
                          "fits --capacity instead of judging --blocks")
+    ap.add_argument("--sp", type=int, default=1,
+                    help="serving: sequence_parallel — price the sequence-"
+                         "sharded pool (inference/sequence_span.py): "
+                         "per-chip KV bytes are 1/sp of the global pool; "
+                         "--fit answers the GLOBAL block count ~sp times "
+                         "a single chip's")
     args = ap.parse_args(argv)
     try:
         capacity = _parse_size(args.capacity)
@@ -1083,22 +1123,29 @@ def main(argv=None) -> int:
                 capacity, n_layer=args.layers, n_kv_head=args.kv_heads,
                 head_dim=args.head_dim, kv_block_size=args.block_size,
                 kv_cache_dtype=args.kv_dtype, kv_group_size=args.kv_group,
-                params_bytes=per_dev_params)
+                params_bytes=per_dev_params,
+                sequence_parallel=args.sp)
+            # one trash block is reserved PER SHARD (the flat pool's
+            # block 0; every sequence shard's local block 0 under --sp)
+            sp = max(1, args.sp)
+            usable = max(0, blocks - sp)
             out = {"max_kv_blocks": blocks,
-                   "usable_blocks": max(0, blocks - 1),
+                   "usable_blocks": usable,
                    "capacity_bytes": capacity,
                    "params_bytes": per_dev_params}
             print(json.dumps(out) if args.json else
                   f"largest num_kv_blocks that fits "
                   f"{fmt_bytes(capacity)}: {blocks} "
-                  f"({max(0, blocks - 1)} usable past the trash block)")
+                  f"({usable} usable past the trash "
+                  f"block{'s' if sp > 1 else ''})")
             return 0
         plan = plan_serving(
             n_layer=args.layers, n_kv_head=args.kv_heads,
             head_dim=args.head_dim, kv_block_size=args.block_size,
             num_kv_blocks=args.blocks, kv_cache_dtype=args.kv_dtype,
             kv_group_size=args.kv_group,
-            params_bytes=params_bytes, tp=args.tp, capacity_bytes=capacity)
+            params_bytes=params_bytes, tp=args.tp,
+            sequence_parallel=args.sp, capacity_bytes=capacity)
         print(json.dumps(plan.to_dict()) if args.json else plan.render())
         return 0 if plan.fits is not False else 2
 

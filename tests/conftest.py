@@ -107,6 +107,13 @@ def pytest_configure(config):
                    "CPU-harness-safe, rides in tier-1; run it alone with "
                    "pytest -m quant)")
     config.addinivalue_line(
+        "markers", "longctx: long-context / context-parallel attention "
+                   "suite (ring flash attention fwd+bwd parity, "
+                   "ring∘Ulysses composition, the unified attention "
+                   "dispatch layer, sequence-spanning serving over the "
+                   "sharded paged pool) — fast and CPU-harness-safe, rides "
+                   "in tier-1; run it alone with pytest -m longctx)")
+    config.addinivalue_line(
         "markers", "chaos: self-healing serving pool suite "
                    "(tests/test_selfheal.py — KV-pool invariant auditor + "
                    "repair, hung-replica watchdog, hard deadlines, hedged "

@@ -1,0 +1,320 @@
+"""Unified attention dispatch layer (`ops/attention_dispatch.py`).
+
+The PR 14 refactor: ONE registry decides which attention program every call
+site runs — training flash/chunked/ring/dense, contiguous decode, paged
+decode (fp + int8), chunked prefill, spec-decode verify. These tests pin
+
+  * the selection table (phase × shape × flags × backend → program),
+  * the single-home predicate regression: `models/gpt.py` carries NO local
+    copy of the flash/decode engage predicates anymore, so the historical
+    two-copies-drift failure mode (gpt.py:436 vs :855) is structurally
+    impossible — monkeypatching the ONE predicate flips every call site,
+  * registry extensibility (a program registered at runtime is selectable),
+  * compile-stability: selection is pure trace-time — a serving engine
+    still compiles exactly one program per bucket.
+"""
+
+import dataclasses
+import inspect
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import attention_dispatch as ad
+
+pytestmark = pytest.mark.longctx
+
+
+def site(**kw):
+    base = dict(phase="train", q_len=2048, kv_len=2048, causal=True,
+                has_bias=False, has_window=False, scale_attn=True,
+                mesh_axes=(), force_flash=None, chunk_min=None,
+                backend=None, external_fn=False)
+    base.update(kw)
+    return ad.AttnSite(**base)
+
+
+class TestSelectionTable:
+    def test_train_auto_crossover(self):
+        assert ad.select(site(q_len=512, kv_len=512)) == "dense"
+        assert ad.select(site(q_len=ad.FLASH_MIN_SEQ,
+                              kv_len=ad.FLASH_MIN_SEQ)) == "flash"
+        assert ad.select(site(q_len=256, kv_len=256,
+                              force_flash=True)) == "flash"
+        assert ad.select(site(force_flash=False)) == "dense"
+
+    def test_train_kernel_disqualifiers(self):
+        assert ad.select(site(has_bias=True)) == "dense"       # alibi
+        assert ad.select(site(has_window=True)) == "dense"     # sliding win
+        assert ad.select(site(scale_attn=False)) == "dense"    # GPT-Neo
+        assert ad.select(site(q_len=2000, kv_len=2000)) == "dense"  # %128
+        assert ad.select(site(kv_len=4096)) == "dense"         # non-square
+
+    def test_train_chunked_escape_hatch(self):
+        assert ad.select(site(chunk_min=2048)) == "chunked"
+        assert ad.select(site(chunk_min=4096)) == "flash"      # below it
+
+    def test_train_external_fn_always_wins(self):
+        assert ad.select(site(external_fn=True)) == "external"
+        assert ad.select(site(external_fn=True, backend="ring",
+                              mesh_axes=("sequence",))) == "external"
+
+    def test_ring_needs_backend_request_and_sequence_axis(self):
+        assert ad.select(site(backend="ring",
+                              mesh_axes=("sequence",))) == "ring"
+        assert ad.select(site(backend="ring_ulysses",
+                              mesh_axes=("data", "sequence"))) \
+            == "ring_ulysses"
+        # no sequence axis installed: the request falls through to auto
+        assert ad.select(site(backend="ring")) == "flash"
+        # no request: sequence axis alone keeps the SPMD-Ulysses default
+        assert ad.select(site(mesh_axes=("sequence",))) == "flash"
+        # ring carries the kernel's no-bias/no-window contract: an
+        # EXPLICIT request on an ineligible site fails loudly — the dense
+        # fallback at 128k would be an HBM OOM far from its cause
+        with pytest.raises(ValueError, match="ineligible"):
+            ad.select(site(backend="ring", mesh_axes=("sequence",),
+                           has_bias=True))
+        # an explicit attn_fn still outranks the request (user's choice)
+        assert ad.select(site(backend="ring", mesh_axes=("sequence",),
+                              has_bias=True, external_fn=True)) \
+            == "external"
+        # a typo'd backend is a config error, not a silent single-chip run
+        with pytest.raises(ValueError, match="unknown attention_backend"):
+            ad.select(site(backend="ring-ulysses",
+                           mesh_axes=("sequence",)))
+
+    def test_decode_phase(self):
+        d = dict(phase="decode", q_len=1)
+        assert ad.select(site(**d, kv_len=1024)) == "decode_dense"
+        assert ad.select(site(**d, kv_len=ad.DECODE_KERNEL_MIN_CTX)) \
+            == "decode_kernel"
+        assert ad.select(site(**d, kv_len=ad.DECODE_KERNEL_MIN_CTX + 1)) \
+            == "decode_dense"                                  # not %128
+        assert ad.select(site(**d, kv_len=1024, force_flash=True)) \
+            == "decode_kernel"
+        assert ad.select(site(**d, kv_len=ad.DECODE_KERNEL_MIN_CTX,
+                              has_window=True)) == "decode_dense"
+
+    def test_paged_phase_incl_quant(self):
+        d = dict(phase="paged_decode", q_len=1,
+                 kv_len=ad.DECODE_KERNEL_MIN_CTX, block_size=128)
+        assert ad.select(site(**d)) == "paged_kernel"
+        assert ad.select(site(**d, kv_dtype="int8")) == "paged_kernel_quant"
+        # unaligned pool block: gather path, still keyed on kv dtype
+        d2 = dict(d, block_size=64)
+        assert ad.select(site(**d2)) == "paged_gather"
+        assert ad.select(site(**d2, kv_dtype="int8")) == "paged_gather_quant"
+        # chunked prefill / verify never take the single-token kernel
+        assert ad.select(site(phase="prefill_chunk", q_len=16,
+                              kv_len=ad.DECODE_KERNEL_MIN_CTX,
+                              block_size=128)) == "paged_gather"
+        assert ad.select(site(phase="verify", q_len=5,
+                              kv_len=ad.DECODE_KERNEL_MIN_CTX,
+                              block_size=128,
+                              kv_dtype="int8")) == "paged_gather_quant"
+
+    def test_dispatch_table_is_total_and_ordered(self):
+        table = ad.dispatch_table()
+        for phase, rows in table.items():
+            names = [n for n, _ in rows]
+            assert names, f"phase {phase} has no programs"
+            # a priority-0 always-true fallback closes every phase
+            fallback = names[-1]
+            assert ad.get_program(fallback).priority == 0
+
+
+class TestSingleHomePredicates:
+    """The regression the satellite demands: the two call sites
+    (training want-flash at the old gpt.py:436, decode engage at :855)
+    can never disagree again — there is exactly ONE definition."""
+
+    def test_gpt_carries_no_local_predicate_copy(self):
+        import deepspeed_tpu.models.gpt as gpt
+        src = inspect.getsource(gpt)
+        assert "use_flash_attention is True" not in src, \
+            "models/gpt.py regrew a local copy of the engage predicate"
+        assert "use_flash_attention is None" not in src
+        # every attention call site resolves through the dispatch layer
+        assert src.count("attn_dispatch.select(") >= 3
+        # and the re-exported constants ARE the dispatch layer's
+        assert gpt.FLASH_MIN_SEQ == ad.FLASH_MIN_SEQ
+        assert gpt.DECODE_KERNEL_MIN_CTX == ad.DECODE_KERNEL_MIN_CTX
+
+    def test_monkeypatched_predicate_flips_all_decode_sites(self, monkeypatch):
+        """Forcing the ONE decode predicate off switches BOTH the
+        contiguous-cache decode and the paged decode to the dense path in
+        the same breath — the call sites share the definition, they cannot
+        drift."""
+        from deepspeed_tpu.models.gpt import (GPTConfig,
+                                              make_gpt_decode_model)
+        cfg = GPTConfig(n_layer=1, n_head=2, d_model=64, max_seq_len=256,
+                        vocab_size=128, dtype=jnp.float32, remat=False,
+                        use_flash_attention=True)      # forced ON
+        spec = make_gpt_decode_model(cfg=cfg)
+
+        def contiguous_uses_pallas():
+            cache = spec.init_cache(1, 1024, jnp.float32)
+            jaxpr = jax.make_jaxpr(
+                lambda p, t, s, c: spec.decode_fn(p, t, s, c))(
+                    spec.params, jnp.zeros((1,), jnp.int32),
+                    jnp.zeros((1,), jnp.int32), cache)
+            return "pallas_call" in str(jaxpr)
+
+        def paged_uses_pallas():
+            pool = spec.init_paged_pool(9, 128, jnp.float32)
+            tables = jnp.zeros((1, 8), jnp.int32)
+            jaxpr = jax.make_jaxpr(
+                lambda p, t, s, pl, bt: spec.decode_paged_fn(p, t, s, pl, bt))(
+                    spec.params, jnp.zeros((1,), jnp.int32),
+                    jnp.zeros((1,), jnp.int32), pool, tables)
+            return "pallas_call" in str(jaxpr)
+
+        assert contiguous_uses_pallas() and paged_uses_pallas()
+        monkeypatch.setattr(ad, "decode_kernel_wanted",
+                            lambda force, M: False)
+        assert not contiguous_uses_pallas()
+        assert not paged_uses_pallas()
+
+    def test_verify_call_site_dispatches_as_verify_phase(self, monkeypatch):
+        """The spec-decode verify chunk is dispatched under phase='verify'
+        (not folded into prefill_chunk) — a verify-specific registered
+        program would actually engage there."""
+        from deepspeed_tpu.models.gpt import (GPTConfig,
+                                              make_gpt_decode_model)
+        cfg = GPTConfig(n_layer=1, n_head=2, d_model=64, max_seq_len=256,
+                        vocab_size=128, dtype=jnp.float32, remat=False)
+        spec = make_gpt_decode_model(cfg=cfg)
+        seen = []
+        orig = ad.select
+
+        def spy(site):
+            seen.append(site.phase)
+            return orig(site)
+
+        monkeypatch.setattr(ad, "select", spy)
+        pool = spec.init_paged_pool(9, 128, jnp.float32)
+        tables = jnp.zeros((1, 2), jnp.int32)
+        jax.make_jaxpr(
+            lambda p, t, s, pl, bt: spec.verify_paged_fn(p, t, s, pl, bt))(
+                spec.params, jnp.zeros((1, 5), jnp.int32),
+                jnp.zeros((1,), jnp.int32), pool, tables)
+        assert "verify" in seen and "prefill_chunk" not in seen
+
+    def test_monkeypatched_flash_predicate_flips_training(self, monkeypatch):
+        from deepspeed_tpu.models.gpt import (GPTConfig, gpt_forward,
+                                              init_gpt_params)
+        cfg = GPTConfig(n_layer=1, n_head=2, d_model=64, max_seq_len=2048,
+                        vocab_size=128, dtype=jnp.float32, remat=False)
+        params = init_gpt_params(cfg, seed=0)
+
+        def uses_pallas():
+            toks = jnp.zeros((1, 2048), jnp.int32)
+            jaxpr = jax.make_jaxpr(
+                lambda p, t: gpt_forward(p, t, cfg))(params, toks)
+            return "pallas_call" in str(jaxpr)
+
+        assert uses_pallas()
+        monkeypatch.setattr(ad, "flash_wanted", lambda force, T: False)
+        assert not uses_pallas()
+
+
+class TestRegistryExtensibility:
+    def test_runtime_registered_program_is_selected(self):
+        calls = []
+
+        def runner(q, k, v, causal=True, sm_scale=None):
+            calls.append(q.shape)
+            return q
+
+        prog = ad.AttentionProgram(
+            name="test_variant", phases=("train",), priority=999,
+            matches=lambda s: s.backend == "test_variant",
+            when="test fixture", runner=runner)
+        ad.register_program(prog)
+        try:
+            assert ad.select(site(backend="test_variant")) == "test_variant"
+            # an unrelated site is untouched by the registration
+            assert ad.select(site()) == "flash"
+            # and the zoo invokes the registered runner end to end
+            from deepspeed_tpu.models.gpt import (GPTConfig, gpt_forward,
+                                                  init_gpt_params)
+            cfg = GPTConfig(n_layer=1, n_head=2, d_model=32, max_seq_len=64,
+                            vocab_size=64, dtype=jnp.float32, remat=False,
+                            attention_backend="test_variant")
+            params = init_gpt_params(cfg, seed=0)
+            gpt_forward(params, jnp.zeros((1, 16), jnp.int32), cfg)
+            assert calls, "registered runner was never invoked"
+        finally:
+            ad._REGISTRY.pop("test_variant", None)
+
+    def test_selection_is_total(self):
+        for phase in ("train", "decode", "paged_decode", "prefill_chunk",
+                      "verify"):
+            assert ad.select(site(phase=phase, has_bias=True,
+                                  has_window=True, scale_attn=False,
+                                  q_len=7, kv_len=13))
+
+
+class TestBackendConfigEndToEnd:
+    def test_gpt_ring_backend_matches_default(self):
+        """GPTConfig.attention_backend='ring' routes training attention
+        through the registered ring program (no per-call-site wiring) and
+        reproduces the default dense loss on a sequence mesh."""
+        from deepspeed_tpu.comm import mesh as mesh_mod
+        from deepspeed_tpu.config.core import MeshConfig
+        from deepspeed_tpu.models.gpt import (GPTConfig, gpt_loss,
+                                              init_gpt_params)
+        mesh_mod.clear_mesh()
+        mesh_mod.init_mesh(MeshConfig(data=2, sequence=4))
+        cfg = GPTConfig(n_layer=2, n_head=4, d_model=64, d_ff=256,
+                        max_seq_len=64, vocab_size=256, dtype=jnp.float32,
+                        remat=False)
+        ring_cfg = dataclasses.replace(cfg, attention_backend="ring")
+        params = init_gpt_params(cfg, seed=0)
+        batch = {"tokens": jnp.asarray(
+            np.random.default_rng(0).integers(0, 256, (4, 33)), jnp.int32)}
+        loss_ring = jax.jit(
+            lambda p: gpt_loss(p, batch, None, cfg=ring_cfg))(params)
+        loss_ref = jax.jit(
+            lambda p: gpt_loss(p, batch, None, cfg=cfg))(params)
+        np.testing.assert_allclose(float(loss_ring), float(loss_ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_ring_backend_without_mesh_falls_through(self):
+        """attention_backend='ring' on a mesh-less run must not crash —
+        the dispatch key's mesh_axes is empty, so auto programs carry."""
+        from deepspeed_tpu.models.gpt import (GPTConfig, gpt_forward,
+                                              init_gpt_params)
+        cfg = GPTConfig(n_layer=1, n_head=2, d_model=32, max_seq_len=64,
+                        vocab_size=64, dtype=jnp.float32, remat=False,
+                        attention_backend="ring")
+        params = init_gpt_params(cfg, seed=0)
+        out = gpt_forward(params, jnp.zeros((1, 16), jnp.int32), cfg)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestCompileStability:
+    @pytest.mark.serving
+    def test_serving_compiles_one_program_per_bucket(self):
+        """Dispatch decisions are trace-time-static: a serving trace still
+        compiles exactly {decode_step: 1, prefill_step: 1}."""
+        import deepspeed_tpu
+        from deepspeed_tpu.inference.scheduler import Request
+        from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_decode_model
+        cfg = GPTConfig(n_layer=2, n_head=2, d_model=64, d_ff=128,
+                        max_seq_len=128, vocab_size=128, dtype=jnp.float32)
+        spec = make_gpt_decode_model(cfg=cfg, name="dispatch-compile")
+        engine = deepspeed_tpu.init_inference(
+            spec, config={"dtype": "float32", "max_out_tokens": 128})
+        serving = engine.serving(max_slots=2, max_context=128,
+                                 prefill_chunk=16)
+        rng = np.random.default_rng(0)
+        reqs = [Request(uid=i, tokens=list(rng.integers(0, 128, 12 + i)),
+                        max_new_tokens=8) for i in range(4)]
+        done = serving.run(reqs)
+        assert len(done) == 4
+        assert serving.compile_stats() == {"decode_step": 1,
+                                           "prefill_step": 1}

@@ -267,6 +267,51 @@ def test_dt004_sanctioned_construction_sites_are_clean(tmp_path):
     assert report.findings == []
 
 
+def test_dt004_program_registry_construction_is_clean(tmp_path):
+    """The attention dispatch layer's registration idiom: jax.jit built
+    inside the arguments of a register_*() call is stored once in the
+    program registry (ring/quant programs register like the scheduler's
+    persistent programs) — sanctioned even outside a builder-named
+    function. A plain per-step jit next to it still fires."""
+    report = lint_tree(tmp_path, {"deepspeed_tpu/ops/reg.py": """
+        import jax
+
+        def enable_ring(registry, fn):
+            registry.register_program(dict(name="ring",
+                                           runner=jax.jit(fn)))   # stored once
+
+        def step(self, batch):
+            return jax.jit(self._fwd)(batch)      # per-step: still fires
+
+        def hot(self, batch):
+            # the jit RESULT (not the callable) flows into register_*:
+            # a fresh wrapper per call — register's name is no shield
+            return self.stats.register_sample(jax.jit(self._fwd)(batch))
+        """}, rules=["DT004"])
+    assert rules_of(report) == ["DT004", "DT004"]
+    assert "'step'" in report.findings[0].message
+    assert "'hot'" in report.findings[1].message
+
+
+def test_dt001_registered_program_runner_taints(tmp_path):
+    """A program registered with `register_*(... jax.jit(f) ...)` carries
+    its jitted callable as `.runner`; a hot-path np.asarray on a value
+    produced THROUGH the registered runner is the same host sync as one on
+    a direct jitted program's output."""
+    report = lint_tree(tmp_path, {"deepspeed_tpu/inference/rp.py": """
+        import jax
+        import numpy as np
+
+        _prog = register_program(dict(runner=jax.jit(lambda q: q)))
+
+        def hot(q):
+            out = _prog.runner(q)
+            return np.asarray(out)        # sync on a device value
+        """}, rules=["DT001"])
+    assert rules_of(report) == ["DT001"]
+    assert "'out'" in report.findings[0].message
+
+
 def test_dt004_unhashable_static_default(tmp_path):
     report = lint_tree(tmp_path, {"deepspeed_tpu/models/s.py": """
         import jax

@@ -1,0 +1,251 @@
+"""Sequence-spanning serving (`inference/sequence_span.py`).
+
+One monster-context request across the `sequence` mesh axis: the paged
+pool's physical-block axis is sharded, block tables split per shard, and
+every serving step's attention runs as a shard_map whose per-shard online-
+softmax partials merge with the ring's (m, l) combination. These tests pin
+
+  * numeric parity with the single-chip flat paged path — full prefill
+    logits AND token-identical greedy decode (the acceptance bar),
+  * the per-shard block accounting (`span_blocks_needed` vs the flat
+    `blocks_needed` single source of truth; all-or-nothing admission),
+  * the planner/ledger pricing: per-chip KV bytes ~1/sp
+    (`plan_serving(sequence_parallel=sp)`, `max_kv_blocks`,
+    `SpanKVPool.per_chip_bytes`, the `mem/kv_pool_per_chip_bytes` gauge).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.config.core import MeshConfig
+from deepspeed_tpu.inference.kv_cache import blocks_needed
+from deepspeed_tpu.inference.sequence_span import (
+    SPAN_TRASH, SpanKVPool, make_span_gpt_fns, span_blocks_needed,
+    span_table_width)
+
+pytestmark = pytest.mark.longctx
+
+SP, BS, MAX_CTX = 4, 16, 256
+
+
+def _mk_mesh():
+    mesh_mod.clear_mesh()
+    return mesh_mod.init_mesh(MeshConfig(sequence=SP))
+
+
+def _cfg(**kw):
+    from deepspeed_tpu.models.gpt import GPTConfig
+    base = dict(n_layer=2, n_head=4, n_kv_head=2, d_model=64, d_ff=128,
+                max_seq_len=MAX_CTX, vocab_size=256, dtype=jnp.float32)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+class TestBlockAccounting:
+    def test_span_needs_partition_the_flat_need(self):
+        """The per-shard occupancies tile the flat-pool need exactly —
+        same `max_written_pos` source of truth, split contiguously."""
+        nb_s = span_table_width(MAX_CTX, BS, SP)
+        for prompt, padded, new in ((40, 48, 12), (1, 16, 1), (200, 208, 40)):
+            needs = span_blocks_needed(prompt, padded, new, BS, SP, nb_s)
+            flat = blocks_needed(prompt, padded, new, BS)
+            assert sum(needs) == flat
+            assert len(needs) == SP
+            # shard 0 binds; later shards taper monotonically
+            assert needs == sorted(needs, reverse=True)
+            assert all(n <= nb_s for n in needs)
+
+    def test_overflowing_extent_raises_at_admit(self):
+        """A request whose write extent overflows the sp·nb_s span table
+        can NEVER fit — admit must raise (the span analog of the
+        scheduler's table-width check), not trash-scatter the overflow
+        and silently serve truncated context."""
+        _mk_mesh()
+        nb_s = span_table_width(MAX_CTX, BS, SP)
+        pool = SpanKVPool(_cfg(), blocks_per_shard=nb_s + 1, block_size=BS)
+        with pytest.raises(ValueError, match="max context"):
+            pool.admit(250, 20, nb_s, padded_prompt=256)
+        for alloc in pool.allocators:              # nothing leaked
+            assert alloc.num_free == alloc.capacity
+
+    def test_admission_is_all_or_nothing_across_shards(self):
+        _mk_mesh()
+        cfg = _cfg()
+        nb_s = span_table_width(MAX_CTX, BS, SP)
+        # a shard need beyond the shard's WHOLE capacity is PERMANENT —
+        # a retry loop treating None as backpressure would starve forever
+        small = SpanKVPool(cfg, blocks_per_shard=3, block_size=BS)
+        with pytest.raises(ValueError, match="never be admitted"):
+            small.admit(60, 12, nb_s, padded_prompt=64)
+        for alloc in small.allocators:
+            assert alloc.num_free == alloc.capacity
+        # transient backpressure: shard 1 busy → None, and shard 0's
+        # already-allocated slice is ROLLED BACK (all-or-nothing)
+        pool = SpanKVPool(cfg, blocks_per_shard=nb_s + 1, block_size=BS)
+        held = pool.allocators[1].alloc(3)
+        tables = pool.admit(100, 1, nb_s, padded_prompt=112)  # [4,3,0,0]
+        assert tables is None
+        assert pool.allocators[0].num_free == pool.allocators[0].capacity
+        pool.allocators[1].free(held)
+        # now it fits; retiring restores every shard
+        tables = pool.admit(100, 1, nb_s, padded_prompt=112)
+        assert tables is not None and tables.shape == (SP, nb_s)
+        assert (tables[0] != SPAN_TRASH).sum() == 4
+        assert (tables[1] != SPAN_TRASH).sum() == 3
+        pool.free(tables)
+        for alloc in pool.allocators:
+            assert alloc.num_free == alloc.capacity
+
+
+class TestSpanParity:
+    """The acceptance bar: the sequence-spanning path is numerically the
+    single-chip flat paged path — full chunk logits close, greedy decode
+    token-identical."""
+
+    def _run_span(self, cfg, params, toks, prompt_len, max_new):
+        mesh = _mk_mesh()
+        nb_s = span_table_width(MAX_CTX, BS, SP)
+        mgr = SpanKVPool(cfg, blocks_per_shard=nb_s + 1, block_size=BS,
+                         mesh=mesh, dtype=jnp.float32)
+        tables = mgr.admit(prompt_len, max_new, nb_s,
+                           padded_prompt=len(toks))
+        assert tables is not None
+        prefill_fn, decode_fn = make_span_gpt_fns(cfg, mesh=mesh)
+        pj, dj = jax.jit(prefill_fn), jax.jit(decode_fn)
+        pool, spt = mgr.pool, jnp.asarray(tables[None], jnp.int32)
+        chunk_logits = []
+        # chunked prefill WALKS THE RING: chunks cross shard boundaries
+        for c0 in range(0, len(toks), BS):
+            chunk = jnp.asarray(toks[c0:c0 + BS][None], jnp.int32)
+            lg, pool = pj(params, chunk, jnp.asarray([c0], jnp.int32),
+                          pool, spt)
+            chunk_logits.append(np.asarray(lg[0]))
+        logits = np.concatenate(chunk_logits, axis=0)       # [T, V]
+        out = [int(np.argmax(logits[prompt_len - 1]))]
+        pos = prompt_len
+        for _ in range(max_new - 1):
+            lg, pool = dj(params, jnp.asarray([out[-1]], jnp.int32),
+                          jnp.asarray([pos], jnp.int32), pool, spt)
+            out.append(int(jnp.argmax(lg, -1)[0]))
+            pos += 1
+        return logits, out, mgr
+
+    def _run_flat(self, cfg, params, toks, prompt_len, max_new):
+        from deepspeed_tpu.models.gpt import make_gpt_decode_model
+        mesh_mod.clear_mesh()
+        spec = make_gpt_decode_model(cfg=cfg, params=params)
+        nb = -(-MAX_CTX // BS)
+        pool = spec.init_paged_pool(nb + 1, BS, jnp.float32)
+        tab = jnp.asarray([list(range(1, nb + 1))], jnp.int32)
+        # verify_paged_fn returns EVERY position's logits — the flat-path
+        # oracle for the span prefill's full chunk logits
+        dj = jax.jit(spec.decode_paged_fn)       # hoisted: one compile
+        logits, pool = jax.jit(spec.verify_paged_fn)(
+            params, jnp.asarray(toks[None], jnp.int32),
+            jnp.asarray([0], jnp.int32), pool, tab)
+        logits = np.asarray(logits[0])
+        out = [int(np.argmax(logits[prompt_len - 1]))]
+        pos = prompt_len
+        for _ in range(max_new - 1):
+            lg, pool = dj(
+                params, jnp.asarray([out[-1]], jnp.int32),
+                jnp.asarray([pos], jnp.int32), pool, tab)
+            out.append(int(jnp.argmax(lg, -1)[0]))
+            pos += 1
+        return logits, out
+
+    @pytest.mark.parametrize("use_rotary", [False, True])
+    def test_logits_and_greedy_match_flat_paged(self, use_rotary):
+        from deepspeed_tpu.models.gpt import init_gpt_params
+        cfg = _cfg(use_rotary=use_rotary)
+        params = init_gpt_params(cfg, seed=0)
+        rng = np.random.default_rng(3)
+        prompt_len, max_new = 70, 10          # spans shards 0 AND 1
+        toks = np.zeros(80, np.int32)
+        toks[:prompt_len] = rng.integers(0, 256, prompt_len)
+        s_logits, s_out, mgr = self._run_span(cfg, params, toks,
+                                              prompt_len, max_new)
+        f_logits, f_out = self._run_flat(cfg, params, toks,
+                                         prompt_len, max_new)
+        np.testing.assert_allclose(s_logits[:prompt_len],
+                                   f_logits[:prompt_len],
+                                   rtol=2e-4, atol=2e-4)
+        assert s_out == f_out, "greedy output must be token-identical"
+        # and the spanning pool's per-chip residency is 1/sp of the global
+        from deepspeed_tpu.telemetry.memscope import tree_bytes
+        assert mgr.per_chip_bytes() == tree_bytes(mgr.pool) // SP
+
+
+class TestSpanPricing:
+    def test_plan_serving_per_chip_scales_inverse_sp(self):
+        from deepspeed_tpu.telemetry.memscope import plan_serving
+        kw = dict(n_layer=12, n_kv_head=4, head_dim=128, kv_block_size=512,
+                  num_kv_blocks=256, n_params=int(1e8))
+        flat = plan_serving(**kw)
+        span = plan_serving(**kw, sequence_parallel=4)
+        assert span.device_bytes["kv_pool"] == \
+            flat.device_bytes["kv_pool"] // 4
+        assert span.device_bytes["params"] == \
+            flat.device_bytes["params"]                        # replicated
+        assert any("sequence-sharded" in n for n in span.notes)
+
+    def test_max_kv_blocks_answers_sp_times_the_blocks(self):
+        from deepspeed_tpu.telemetry.memscope import max_kv_blocks
+        kw = dict(n_layer=12, n_kv_head=4, head_dim=128, kv_block_size=512)
+        cap = 8 * 2**30
+        flat = max_kv_blocks(cap, **kw)
+        span = max_kv_blocks(cap, **kw, sequence_parallel=4)
+        # shards hold WHOLE blocks: exactly sp x the flat per-chip answer
+        # (no fractional-block credit that could overfill a shard)
+        assert span == 4 * flat
+
+    def test_memscope_cli_prices_span(self, capsys):
+        from deepspeed_tpu.telemetry.memscope import main
+        import json
+        rc = main(["--plan", "serving", "--layers", "12", "--kv-heads", "4",
+                   "--head-dim", "128", "--blocks", "256", "--sp", "4",
+                   "--json"])
+        assert rc == 0
+        plan = json.loads(capsys.readouterr().out)
+        assert any("sequence-sharded" in n for n in plan["notes"])
+        rc = main(["--plan", "serving", "--layers", "12", "--kv-heads", "4",
+                   "--head-dim", "128", "--capacity", "8G", "--fit",
+                   "--sp", "4", "--json"])
+        assert rc == 0
+        fit = json.loads(capsys.readouterr().out)
+        assert fit["max_kv_blocks"] > 0
+
+    def test_serving_ledger_has_per_chip_gauge(self):
+        """The flat serving engine's ledger carries the per-chip view too
+        (== kv_pool_bytes at span_shards 1) — the gauge the span pool
+        divides; informational, never in the attribution sum."""
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_decode_model
+        mesh_mod.clear_mesh()
+        cfg = GPTConfig(n_layer=2, n_head=2, d_model=64, d_ff=128,
+                        max_seq_len=128, vocab_size=128, dtype=jnp.float32)
+        spec = make_gpt_decode_model(cfg=cfg, name="span-ledger")
+        engine = deepspeed_tpu.init_inference(
+            spec, config={"dtype": "float32", "max_out_tokens": 128,
+                          "telemetry": {"enabled": True,
+                                        "memscope": True,
+                                        "memscope_programs": False}})
+        serving = engine.serving(max_slots=2, max_context=128,
+                                 prefill_chunk=16)
+        snap = serving.memscope.snapshot()
+        assert snap["kv_pool_per_chip_bytes"] == snap["kv_pool_bytes"]
+        assert snap["attributed_bytes"] >= snap["kv_pool_bytes"]
+        # informational: per-chip view not double-counted in the sum
+        assert snap["attributed_bytes"] < (snap["kv_pool_bytes"]
+                                           + snap["kv_pool_per_chip_bytes"]
+                                           + snap["params_bytes"])
+        # the span wire: an engine built over a SpanKVPool mirrors the
+        # pool's span_shards attr and the gauge divides accordingly
+        from deepspeed_tpu.telemetry.memscope import ServingMemScope
+        serving.span_shards = 4
+        snap4 = ServingMemScope(serving).snapshot(programs=False)
+        assert snap4["kv_pool_per_chip_bytes"] == \
+            snap["kv_pool_bytes"] // 4
