@@ -97,6 +97,10 @@ class OffloadParamConfig(ConfigModel):
     buffer_size: int = 10**8
     max_in_cpu: int = 10**9
     pin_memory: bool = False
+    # async staging-pool depth (runtime/param_swap.LayerStreamer): layers
+    # of weights kept in flight ahead of compute; 0 = blocking baseline,
+    # 1 = classic double buffering (docs/offload.md "Staging depth")
+    lookahead: int = 1
 
 
 @dataclass

@@ -159,13 +159,32 @@ class ServingEngine:
 
     def __init__(self, engine, draft_spec=None, clock=None, **overrides):
         spec = engine.model_spec
-        missing = [n for n in ("prefill_paged_fn", "decode_paged_fn",
-                               "init_paged_pool") if getattr(spec, n) is None]
-        if missing:
-            raise ValueError(
-                f"model spec '{spec.name}' has no paged serving contract "
-                f"(missing {missing}); build it with make_gpt_decode_model "
-                f"or serve through generate()")
+        # streamed (offloaded-weights) mode: a LayeredModelSpec served
+        # through a ZeroInferenceEngine — the stacked blocks live in the
+        # host/disk store and ONE jitted per-layer program walks the paged
+        # pool with weights fed by the async staging pool. The resident
+        # mode's whole-model paged contract is replaced by the per-layer
+        # one (layer_paged_fn + embed/final).
+        self.streamed = getattr(spec, "layer_paged_fn", None) is not None \
+            and getattr(spec, "prefill_paged_fn", None) is None
+        if self.streamed:
+            missing = [n for n in ("layer_paged_fn", "init_paged_pool",
+                                   "embed_fn", "final_fn")
+                       if getattr(spec, n, None) is None]
+            if missing:
+                raise ValueError(
+                    f"layered model spec '{spec.name}' has no streamed "
+                    f"paged contract (missing {missing}); build it with "
+                    f"make_gpt_layered_model")
+        else:
+            missing = [n for n in ("prefill_paged_fn", "decode_paged_fn",
+                                   "init_paged_pool")
+                       if getattr(spec, n, None) is None]
+            if missing:
+                raise ValueError(
+                    f"model spec '{spec.name}' has no paged serving contract "
+                    f"(missing {missing}); build it with make_gpt_decode_model "
+                    f"or serve through generate()")
         self.engine = engine
         self.config = engine.config
         scfg = dataclasses.replace(engine.config.serving, **overrides)
@@ -244,6 +263,23 @@ class ServingEngine:
         # speculative decoding: the verify step REPLACES the decode step
         # (and its window) when a drafter is configured
         self.spec_on = str(scfg.spec_decode.drafter or "off") != "off"
+        if self.streamed:
+            # streamed-mode envelope: every decode token already walks the
+            # host link once (the cost model of the tier) — a K-step jitted
+            # window or a verify chunk cannot host a per-layer Python walk,
+            # so both are refused rather than silently degraded
+            if self.spec_on:
+                raise ValueError(
+                    "speculative decoding is a resident-engine feature: the "
+                    "streamed (offloaded-weights) serving mode walks one "
+                    "jitted per-layer program per token and has no verify "
+                    "contract — drop spec_decode, or serve resident")
+            if self.window != 1:
+                raise ValueError(
+                    f"decode_steps_per_sync={self.window} needs the whole "
+                    f"stack resident inside one jitted scan; the streamed "
+                    f"(offloaded-weights) mode streams layers through HBM "
+                    f"per token — set decode_steps_per_sync=1")
         self.draft_k = int(scfg.spec_decode.draft_k) if self.spec_on else 0
         if self.spec_on and spec.verify_paged_fn is None:
             raise ValueError(
@@ -349,6 +385,11 @@ class ServingEngine:
         self.queue = collections.deque()
 
         self._rng = jax.random.PRNGKey(0)
+        if self.streamed and self.telemetry.enabled:
+            # the staging pool's offload/* metrics (stage-wait, occupancy,
+            # in-flight bytes) land in THIS engine's serving registry
+            engine.streamer.telemetry = self.telemetry
+            engine.store.telemetry = self.telemetry
         self._build_step_fns()
 
         # drafter AFTER pool/allocator: the draft-model drafter mirrors the
@@ -439,6 +480,9 @@ class ServingEngine:
     # ------------------------------------------------------------------
 
     def _build_step_fns(self):
+        if self.streamed:
+            self._build_streamed_step_fns()
+            return
         spec = self.engine.model_spec
         cfg = self.engine.config
         decode_paged = self.engine._fn_transform(spec.decode_paged_fn)
@@ -526,6 +570,94 @@ class ServingEngine:
 
             self._verify_step = wd.wrap(
                 "verify_step", jax.jit(verify_step, donate_argnums=(3,)))
+
+    def _build_streamed_step_fns(self):
+        """Step programs for the offloaded-weights (streamed) mode: the
+        whole-model paged programs are replaced by SIX single-signature
+        jitted programs — {embed, layer, head} x {prefill, decode} — and a
+        host loop that walks the layer program L times per call, weights
+        fed by the engine's async staging pool (layer i computes while
+        layer i+1's upload and layer i+2's disk read are in flight). The
+        layer index is TRACED (the pool's layer axis is dynamic-sliced and
+        written back in place via donation), so every layer of the walk
+        shares one compile; the serving promise becomes one compile per
+        PROGRAM, six programs total, asserted by compile_stats() exactly
+        like the resident mode's two."""
+        spec = self.engine.model_spec
+        cfg = self.engine.config
+        L = self.engine.store.num_layers
+        streamer = self.engine.streamer
+
+        def sample(logits, rng):
+            return sample_logits(logits, rng, greedy=cfg.greedy,
+                                 temperature=cfg.temperature, top_k=cfg.top_k,
+                                 top_p=cfg.top_p)
+
+        # separate prefill/decode jits per role: each program then has
+        # exactly ONE call signature for the engine's lifetime, keeping the
+        # compile-watchdog contract as sharp as the resident mode's. The
+        # factories mint DISTINCT function objects per phase — jax.jit
+        # wrappers over one function share a single compile cache, which
+        # would double every program's reported count.
+
+        def make_embed():
+            def embed(res, toks, positions):
+                return spec.embed_fn(res, toks, positions)
+            return embed
+
+        def make_layer():
+            def layer(p, x, layer_idx, pool, tables, positions):
+                return spec.layer_paged_fn(p, x, layer_idx, pool, tables,
+                                           positions)
+            return layer
+
+        def make_head():
+            def head(res, x, last_idx, rng):
+                last = jnp.take_along_axis(x, last_idx[:, None, None],
+                                           axis=1)
+                logits = spec.final_fn(res, last)[:, 0]
+                return sample(logits, rng)
+            return head
+
+        wd = self.telemetry.watchdog
+        self._embed_prefill = wd.wrap("embed_prefill", jax.jit(make_embed()))
+        self._embed_decode = wd.wrap("embed_decode", jax.jit(make_embed()))
+        self._layer_prefill = wd.wrap(
+            "layer_prefill", jax.jit(make_layer(), donate_argnums=(3,)))
+        self._layer_decode = wd.wrap(
+            "layer_decode", jax.jit(make_layer(), donate_argnums=(3,)))
+        self._head_prefill = wd.wrap("head_prefill", jax.jit(make_head()))
+        self._head_decode = wd.wrap("head_decode", jax.jit(make_head()))
+
+        def prefill_step(params, toks, start, last_idx, pool, table, rng):
+            B, C = toks.shape
+            positions = np.asarray(start, np.int32)[:, None] + \
+                np.arange(C, dtype=np.int32)[None]
+            x = self._embed_prefill(params, toks, positions)
+            for i in range(L):
+                x, pool = self._layer_prefill(streamer.layer(i), x,
+                                              np.int32(i), pool, table,
+                                              positions)
+            return self._head_prefill(params, x,
+                                      np.asarray(last_idx, np.int32),
+                                      rng), pool
+
+        def decode_step(params, tok, pos, pool, tables, rng):
+            S = np.shape(tok)[0]
+            positions = np.asarray(pos, np.int32)[:, None]
+            x = self._embed_decode(params, np.asarray(tok, np.int32)[:, None],
+                                   positions)
+            for i in range(L):
+                x, pool = self._layer_decode(streamer.layer(i), x,
+                                             np.int32(i), pool, tables,
+                                             positions)
+            tok_next = self._head_decode(params, x, np.zeros(S, np.int32),
+                                         rng)
+            return tok_next[:, None], pool
+
+        self._prefill_step = prefill_step
+        self._decode_step = decode_step
+        self._verify_step = None
 
     def _degraded_decode_step(self):
         """The 1-step decode program, built lazily the first time a
@@ -1477,7 +1609,17 @@ class ServingEngine:
         """Compiled-program counts of the persistent step functions — the
         serving promise is that these stay at 1 each for the engine's
         lifetime, across any mix of request shapes (the verify and draft
-        programs appear, and join the promise, when spec decode is on)."""
+        programs appear, and join the promise, when spec decode is on; the
+        streamed mode's six per-phase programs replace the resident two,
+        each still pinned at one)."""
+        if self.streamed:
+            return {name: int(fn._cache_size()) for name, fn in (
+                ("embed_prefill", self._embed_prefill),
+                ("layer_prefill", self._layer_prefill),
+                ("head_prefill", self._head_prefill),
+                ("embed_decode", self._embed_decode),
+                ("layer_decode", self._layer_decode),
+                ("head_decode", self._head_decode))}
         out = {"decode_step": int(self._decode_step._cache_size()),
                "prefill_step": int(self._prefill_step._cache_size())}
         if self.spec_on:
@@ -1544,6 +1686,19 @@ class ServingEngine:
                 "prefill_chunks_skipped": self.prefill_chunks_skipped,
                 "cached_blocks": self.prefix_cache.num_cached,
                 "evictions": self.allocator.evictions}
+        if self.streamed:
+            # staging-pool overlap counters (device-ward hits/stalls +
+            # write-back accounting) — the streamed mode's "is the overlap
+            # real" readout, available with telemetry off
+            from deepspeed_tpu.telemetry.memscope import tree_bytes
+            out["offload"] = {
+                "staging": self.engine.streamer.stats(),
+                "layer_bytes": self.engine.store.layer_bytes,
+                "host_param_bytes": self.engine.store.host_bytes,
+                # peak HBM of the streamed-layer staging window — distinct
+                # from the always-resident (embed/norm/head) tree below
+                "staged_peak_bytes": self.engine.peak_param_hbm_bytes,
+                "resident_param_bytes": tree_bytes(self.engine.params)}
         if self.memscope is not None:
             out["memory"] = self.memscope.snapshot()
         if self.telemetry.enabled:

@@ -52,6 +52,22 @@ class LayeredModelSpec:
     train_loss_fn: Optional[Callable] = None   # (resident, x, labels) -> loss
     eos_token_id: Optional[int] = None
     name: str = "model"
+    # streamed paged-serving contract (inference/scheduler.py offloaded-
+    # weights mode): ONE jitted per-layer program reused for every layer,
+    # weights streamed by the staging pool while the paged pool stays
+    # device-resident and is updated in place (donated) layer by layer.
+    #   layer_paged_fn(layer_p, x[B,C,D], layer_idx, pool, block_tables,
+    #                  positions[B,C]) -> (x, pool)
+    #     layer_idx is a TRACED scalar — the pool's layer axis is sliced /
+    #     updated with dynamic_index/update, so L layers share one compile
+    #   init_paged_pool(num_blocks, block_size, dtype[, kv_group_size])
+    #     -> pool pytree (the same [L, N, Hkv, block, hd] layout as
+    #     DecodeModelSpec's)
+    layer_paged_fn: Optional[Callable] = None
+    init_paged_pool: Optional[Callable] = None
+    # cache-identity fingerprint (prefix cache hash chain; falls back to
+    # `name`) — same contract as DecodeModelSpec.cache_fingerprint
+    cache_fingerprint: Optional[str] = None
 
 
 class ZeroInferenceEngine:
@@ -91,15 +107,23 @@ class ZeroInferenceEngine:
                                            res_sh)
         else:
             self.resident = jax.device_put(tree_cast(model.resident, dtype))
+        from deepspeed_tpu.telemetry import Telemetry
+        self.telemetry = Telemetry(getattr(config, "telemetry", None),
+                                   subsystem="zero_inference")
         self.store = LayerParamStore(
             tree_cast(model.blocks, dtype), device=offload_device,
             swap_folder=nvme_path, staging=staging)
+        self.store.telemetry = self.telemetry
         layer_sh = None
         if model.block_specs is not None:
             layer_sh = jax.tree_util.tree_map(
                 lambda s: NamedSharding(self.mesh, s), model.block_specs)
+        # cyclic: decode walks layers 0..L-1 over and over — pinning the
+        # look-ahead to that scan order keeps layer 0 staged while L-1
+        # computes, so the wrap between steps never restarts cold
         self.streamer = LayerStreamer(self.store, shardings=layer_sh,
-                                      lookahead=lookahead)
+                                      lookahead=lookahead, cyclic=True,
+                                      telemetry=self.telemetry)
         self.total_param_bytes = (
             self.store.layer_bytes * self.store.num_layers)
 
@@ -110,11 +134,39 @@ class ZeroInferenceEngine:
         self._layer_decode = jax.jit(model.layer_decode_fn,
                                      donate_argnums=(1, 2, 3))
         self._final = jax.jit(model.final_fn)
+        # scheduler-facing surface (serving() streamed mode): resident
+        # params ARE the device-resident tree; no dequant transform here
+        self._fn_transform = lambda fn: fn
+        # engine-owned cache template (PR 3 satellite pattern): generate()
+        # reuses the previous request's cache buffers when (B, max_len,
+        # dtype) matches instead of re-allocating (and re-zeroing) a fresh
+        # per-layer cache every call. Safe WITHOUT re-zeroing: decode masks
+        # attention to k_pos <= pos and prefill never reads the cache, so
+        # stale content past the written prefix is provably unattended.
+        # The layer programs donate their cache arguments, so the retained
+        # entry is always the most recently RETURNED buffers.
+        self._cache_entry = None       # ((B, max_len, dtype), caches)
+        self._cache_hits = 0
         log_dist(
             f"zero-inference engine: {model.name} dtype={dtype} "
             f"offload={offload_device} layers={self.store.num_layers} "
             f"layer_mb={self.store.layer_bytes / 1e6:.1f} "
             f"resident+{lookahead + 1} layers in HBM", ranks=[0])
+
+    @property
+    def params(self):
+        """The device-RESIDENT param tree (embeddings/norms/head) — what
+        the serving scheduler passes to the embed/head programs; the
+        streamed blocks never appear here."""
+        return self.resident
+
+    def enable_weight_quant(self, bits=8, group_size=64):
+        raise ValueError(
+            "weight-only quantization is a resident-engine feature "
+            "(InferenceEngine.enable_weight_quant): the spill tier streams "
+            "bit16 layers from the host store — quantize the HOST copies "
+            "instead by building the store at a narrower dtype, or serve "
+            "resident with serving.quantization.weights")
 
     # ---- forward ----
 
@@ -122,6 +174,22 @@ class ZeroInferenceEngine:
         dt = jnp.dtype(self.config.kv_cache_dtype)
         return [self.model_spec.init_layer_cache(B, max_len, dt)
                 for _ in range(self.store.num_layers)]
+
+    def _own_caches(self, B, max_len):
+        """Engine-owned per-layer cache buffers for generate(): reused on a
+        shape match (ONE retained entry — a multi-shape store would pin
+        several full caches in HBM). The entry is checked out here and
+        checked back in by generate() AFTER the decode loop — donation
+        rotates the underlying buffers, so the retained reference must be
+        whatever the programs last returned."""
+        key = (int(B), int(max_len), str(self.config.kv_cache_dtype))
+        if self._cache_entry is not None and self._cache_entry[0] == key:
+            self._cache_hits += 1
+            caches = self._cache_entry[1]
+        else:
+            caches = self._init_caches(B, max_len)
+        self._cache_entry = None       # checked out (buffers will be donated)
+        return key, caches
 
     def forward(self, tokens, caches=None, max_len=None):
         """Prefill: logits [B,T,V] + per-layer caches, streaming the weights."""
@@ -167,7 +235,7 @@ class ZeroInferenceEngine:
         B, T = tokens.shape
         if rng is None and not self.config.greedy:
             rng = jax.random.PRNGKey(0)
-        caches = self._init_caches(B, T + max_new_tokens)
+        cache_key, caches = self._own_caches(B, T + max_new_tokens)
         logits, caches = self.forward(tokens, caches)
         if rng is not None:
             rng, sub = jax.random.split(rng)
@@ -194,7 +262,22 @@ class ZeroInferenceEngine:
                 sub = None
             tok = self._sample(logits, sub)
             pos = pos + 1
+        # check the (donation-rotated) cache buffers back in for the next
+        # shape-matching request
+        self._cache_entry = (cache_key, caches)
         return np.stack(out, axis=1)
+
+    # ---- serving -------------------------------------------------------
+
+    def serving(self, **overrides):
+        """Continuous-batching serving over STREAMED weights: the paged KV
+        pool and scheduler (inference/scheduler.py) with this engine's
+        staging pool feeding one jitted per-layer program — the
+        router/scheduler stack serves a model bigger than HBM. Constraints
+        of the streamed mode (enforced loudly by the scheduler): decode
+        window 1, no speculative decoding, no weight-only quant."""
+        from deepspeed_tpu.inference.scheduler import ServingEngine
+        return ServingEngine(self, **overrides)
 
     # ---- accounting (for tests and `see_memory_usage`-style reporting) ----
 
@@ -204,4 +287,5 @@ class ZeroInferenceEngine:
         return self.streamer.peak_live_layers * self.store.layer_bytes
 
     def release(self):
+        self.telemetry.close()
         self.store.release()

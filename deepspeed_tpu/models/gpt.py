@@ -1358,10 +1358,33 @@ def make_gpt_layered_model(cfg: GPTConfig = None, name="gpt2-125m", params=None,
         mask = (labels >= 0).astype(jnp.float32)
         return ((logz - gold) * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
+    # streamed paged-serving contract (inference/scheduler.py offloaded-
+    # weights mode): the same `_block_paged` body as the resident paged
+    # path, but the layer index arrives TRACED and the [L, ...] pool is
+    # sliced / written back with dynamic_index/update — one compile serves
+    # every layer of a streamed walk, and pool donation makes the update
+    # write in place
+    def layer_paged_fn(p, x, layer, pool, block_tables, positions):
+        pool_l = {k: jax.lax.dynamic_index_in_dim(v, layer, 0,
+                                                  keepdims=False)
+                  for k, v in pool.items()}
+        x, pool_l = _block_paged(x, p, pool_l, positions, block_tables, cfg)
+        pool = {k: jax.lax.dynamic_update_index_in_dim(
+                    pool[k], pool_l[k].astype(pool[k].dtype), layer, 0)
+                for k in pool}
+        return x, pool
+
+    def init_paged_pool(num_blocks, block_size, dtype=jnp.bfloat16,
+                        kv_group_size=0):
+        return init_paged_kv_pool(cfg, num_blocks, block_size, dtype,
+                                  kv_group_size)
+
     return LayeredModelSpec(
         embed_fn=embed_fn, layer_prefill_fn=layer_prefill_fn,
         layer_decode_fn=layer_decode_fn, final_fn=final_fn,
         layer_train_fn=layer_train_fn, train_loss_fn=train_loss_fn,
         resident=resident, blocks=blocks, num_layers=cfg.n_layer,
         init_layer_cache=init_layer_cache, resident_specs=resident_specs,
-        block_specs=block_specs, name=name)
+        block_specs=block_specs, name=name,
+        layer_paged_fn=layer_paged_fn, init_paged_pool=init_paged_pool,
+        cache_fingerprint=gpt_cache_identity(cfg, name))

@@ -70,9 +70,16 @@ class HostOffloadOptimizer:
         master params as a pytree of numpy fp32."""
         self.step_count += 1
         lr = self._current_lr()
-        # dstpu: ignore[DT001]: host-offload tier — grads MUST land in host RAM for the C++ optimizer; the sync is the design
-        grads = [np.asarray(jax.device_get(g), np.float32)
-                 for g in jax.tree_util.tree_flatten(grads_tree)[0]]
+        leaves = jax.tree_util.tree_flatten(grads_tree)[0]
+        # ONE bulk device->host transfer per step: dispatch every leaf's
+        # D2H copy first (non-blocking under JAX's dispatch model), then
+        # land them together — the old per-leaf device_get paid a host
+        # sync per leaf, serializing the transfer against the conversion
+        for g in leaves:
+            if hasattr(g, "copy_to_host_async"):
+                g.copy_to_host_async()
+        # dstpu: ignore[DT001]: host-offload tier — grads MUST land in host RAM for the C++ optimizer; the copies were dispatched async above, this is the single landing barrier per step
+        grads = [np.asarray(g, np.float32) for g in jax.device_get(leaves)]
 
         if self.nvme is not None:
             states = self.nvme.swap_in_all()
@@ -117,12 +124,35 @@ class HostOffloadOptimizer:
             sd["exp_avg"] = self.exp_avg
             if self.exp_avg_sq is not None:
                 sd["exp_avg_sq"] = self.exp_avg_sq
+        else:
+            # NVMe-swapped moments are still part of the optimizer state:
+            # pull them through the swapper so a checkpoint of this tier is
+            # complete (previously they were silently dropped)
+            states = self.nvme.swap_in_all()
+            n = len(self.master)
+            sd["exp_avg"] = [np.array(states[f"m_{i}"]) for i in range(n)]
+            if self.optimizer == "adam":
+                sd["exp_avg_sq"] = [np.array(states[f"v_{i}"])
+                                    for i in range(n)]
         return sd
 
     def load_state_dict(self, sd):
-        self.step_count = sd["step"]
+        self.step_count = int(np.asarray(sd["step"]))
         self.master = [np.asarray(m, np.float32) for m in sd["master"]]
-        if self.nvme is None and "exp_avg" in sd:
-            self.exp_avg = [np.asarray(m, np.float32) for m in sd["exp_avg"]]
-            if "exp_avg_sq" in sd:
-                self.exp_avg_sq = [np.asarray(m, np.float32) for m in sd["exp_avg_sq"]]
+        if "exp_avg" not in sd:
+            return
+        exp_avg = [np.asarray(m, np.float32) for m in sd["exp_avg"]]
+        exp_avg_sq = None
+        if "exp_avg_sq" in sd:
+            exp_avg_sq = [np.asarray(m, np.float32) for m in sd["exp_avg_sq"]]
+        if self.nvme is None:
+            self.exp_avg = exp_avg
+            if exp_avg_sq is not None:
+                self.exp_avg_sq = exp_avg_sq
+        else:
+            out = {}
+            for i, m in enumerate(exp_avg):
+                out[f"m_{i}"] = m
+                if exp_avg_sq is not None:
+                    out[f"v_{i}"] = exp_avg_sq[i]
+            self.nvme.swap_out_all(out)

@@ -1721,7 +1721,16 @@ def initialize(args=None,
             loss_scale_window=cfg.fp16.loss_scale_window,
             min_loss_scale=cfg.fp16.min_loss_scale,
             hysteresis=cfg.fp16.hysteresis,
-            consecutive_hysteresis=cfg.fp16.consecutive_hysteresis)
+            consecutive_hysteresis=cfg.fp16.consecutive_hysteresis,
+            # async staging pool: lookahead (device-ward depth) rides the
+            # offload_param block — 0 is the DOCUMENTED blocking baseline,
+            # so only None falls back to the default; telemetry enables the
+            # offload/* staging metrics; the checkpoint block drives
+            # save_checkpoint
+            lookahead=int(1 if getattr(off, "lookahead", 1) is None
+                          else getattr(off, "lookahead", 1)),
+            telemetry=getattr(cfg, "telemetry", None),
+            checkpoint=getattr(cfg, "checkpoint", None))
         return inf, None, inf.training_dataloader, None
     if not isinstance(model, ModelSpec):
         assert callable(model), "model must be a ModelSpec or a loss callable"

@@ -27,11 +27,14 @@ device memory" claims rest on; the inference half lives in
 `inference/zero_inference.py`.
 """
 
+import types
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.runtime.cpu_optimizer import HostOffloadOptimizer
+from deepspeed_tpu.runtime.offload_staging import HostwardPipe
 from deepspeed_tpu.runtime.param_swap import LayerParamStore, LayerStreamer
 from deepspeed_tpu.utils.logging import log_dist
 from deepspeed_tpu.utils.tree import tree_cast
@@ -42,7 +45,15 @@ class InfinityEngine:
 
     `offload_device`: "cpu" | "nvme" for the bit16 weights;
     `optimizer_nvme_path`: optionally push the per-layer Adam moments to
-    NVMe too (the full ZeRO-Infinity tier)."""
+    NVMe too (the full ZeRO-Infinity tier);
+    `lookahead`: staging depth of the async double-buffered upload pool
+    (0 = the blocking baseline — every layer acquisition stalls);
+    `landing_depth`: how many layers' grad flats may be in device->host
+    flight at once (the backward-direction half of the overlap);
+    `telemetry`: a TelemetryConfig — enables the `offload/*` staging
+    metrics (stage-wait, occupancy, in-flight bytes) and per-step export;
+    `checkpoint`: a CheckpointConfig for `save_checkpoint` (engine,
+    keep_last_n, checksum verification — checkpoint/saver.py)."""
 
     def __init__(self, spec, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=0.0, dtype=jnp.bfloat16, offload_device="cpu",
@@ -53,7 +64,8 @@ class InfinityEngine:
                  seed=1234, fp16=False, static_loss_scale=None,
                  initial_scale_power=16, loss_scale_window=1000,
                  min_loss_scale=1.0, hysteresis=2,
-                 consecutive_hysteresis=False):
+                 consecutive_hysteresis=False, landing_depth=None,
+                 max_write_bytes=None, telemetry=None, checkpoint=None):
         assert spec.layer_train_fn is not None and spec.train_loss_fn is not None, \
             "InfinityEngine needs a LayeredModelSpec with train fns " \
             "(models.gpt.make_gpt_layered_model provides them)"
@@ -61,11 +73,33 @@ class InfinityEngine:
         self.micro_batch_size = micro_batch_size
         self.gas = max(1, int(gradient_accumulation_steps))
         self.dtype = jnp.dtype(dtype)
+        from deepspeed_tpu.telemetry import Telemetry
+        self.telemetry = Telemetry(telemetry, subsystem="infinity")
+        # minimal config surface for checkpoint/saver.py's free functions
+        # (engine.config.checkpoint drives the checkpoint-engine choice;
+        # this tier's state is a host-side numpy pytree, so default to the
+        # npz engine rather than orbax)
+        self.config = types.SimpleNamespace(
+            checkpoint=(checkpoint if checkpoint is not None else
+                        types.SimpleNamespace(engine="numpy",
+                                              async_save=False)),
+            telemetry=telemetry)
+        self.monitor = None
         self.resident = jax.device_put(tree_cast(spec.resident, self.dtype))
         self.store = LayerParamStore(tree_cast(spec.blocks, self.dtype),
                                      device=offload_device,
-                                     swap_folder=nvme_path)
-        self.streamer = LayerStreamer(self.store, lookahead=lookahead)
+                                     swap_folder=nvme_path,
+                                     max_write_bytes=max_write_bytes)
+        self.store.telemetry = self.telemetry
+        self.streamer = LayerStreamer(self.store, lookahead=lookahead,
+                                      telemetry=self.telemetry)
+        self.landing_depth = max(1, int(landing_depth
+                                        if landing_depth is not None
+                                        else max(1, lookahead)))
+        # hostward (grad-landing) stall accounting across the per-pass
+        # pipes — the bench lane's stall fraction includes BOTH directions
+        self.hostward_wait_ms_total = 0.0
+        self.hostward_bytes_total = 0
         self.L = self.store.num_layers
 
         # fp32 masters + moments on host, one optimizer per layer + resident.
@@ -200,17 +234,14 @@ class InfinityEngine:
 
     def _layer_step_host(self, i, flat):
         """Host optimizer step for layer i from a host fp32 grad flat; bit16
-        write-back to the store."""
+        write-back to the store (async under the store's write budget — the
+        disk write of layer i overlaps layer i-1's backward)."""
         g_host = self._unflatten_host(flat, [s for s, _ in self.store.leaf_meta])
         g_tree = jax.tree_util.tree_unflatten(self.store.treedef, g_host)
         new_master = self.layer_opts[i].step(g_tree)
         self.store.put(i, [np.asarray(l).astype(self.store.leaf_meta[j][1])
                            for j, l in enumerate(
                                jax.tree_util.tree_leaves(new_master))])
-
-    def _layer_step(self, i, g_flat):
-        # dstpu: ignore[DT001]: ZeRO-Infinity tier — per-layer grads stream to the host optimizer; the CPU work overlaps the next layer's vjp
-        self._layer_step_host(i, np.asarray(jax.device_get(g_flat)))
 
     def _micro_pass(self, inputs, labels, acc, res_acc, mode):
         """One micro-batch forward+backward. `mode`:
@@ -238,19 +269,25 @@ class InfinityEngine:
 
         # backward: stream layers in reverse. No reset first: layer L-1's
         # device copy from the forward is exactly what the backward needs;
-        # the direction-aware eviction window handles the turn-around. The
-        # host work for layer i runs AFTER layer i-1's vjp is dispatched, so
-        # the CPU overlaps device compute (the tier's raison d'etre).
-        pending = None
+        # the direction-aware eviction window handles the turn-around.
+        # Layer i's grad flat is submitted to the hostward pipe the moment
+        # its vjp is enqueued — copy_to_host_async dispatches the D2H copy
+        # behind it — and lands `landing_depth` layers later, so the host
+        # optimizer (and the write-back) overlaps the device backward while
+        # the transfer itself overlaps the NEXT layer's vjp (the tier's
+        # raison d'etre; a late transfer's stall is measured in
+        # offload/hostward_wait_ms, not hidden).
+        pipe = HostwardPipe(depth=self.landing_depth,
+                            telemetry=self.telemetry)
         for i in reversed(range(self.L)):
             p = self.streamer.layer(i, direction=-1)
             g_p, g_x = self._block_vjp(p, boundaries[i], positions, g_x)
-            g_flat = self._flatten(g_p)
-            if pending is not None:
-                self._consume(acc, mode, *pending)
-            pending = (i, g_flat)
-        if pending is not None:
-            self._consume(acc, mode, *pending)
+            for k, flat in pipe.submit(i, self._flatten(g_p)):
+                self._consume(acc, mode, k, flat)
+        for k, flat in pipe.drain():
+            self._consume(acc, mode, k, flat)
+        self.hostward_wait_ms_total += pipe.wait_ms_total
+        self.hostward_bytes_total += pipe.bytes_total
 
         g_res = self._add(g_res, self._embed_vjp(self.resident, inputs,
                                                  positions, g_x))
@@ -262,18 +299,18 @@ class InfinityEngine:
             res_acc += res_flat
         return float(loss), res_acc
 
-    def _consume(self, acc, mode, i, g_flat):
+    def _consume(self, acc, mode, i, flat):
+        """Consume layer i's LANDED host grad flat (the hostward pipe did
+        the device->host transfer asynchronously)."""
         if mode == "apply":
-            self._layer_step(i, g_flat)
+            self._layer_step_host(i, flat)
             return
-        # dstpu: ignore[DT001]: ZeRO-Infinity tier — gas accumulation happens in host RAM (the accumulator IS the offload)
-        flat = np.asarray(jax.device_get(g_flat))
         if mode == "finalize":
             mean = (acc[i] + flat) / self.gas
             acc[i] = None  # accumulator memory falls as the backward proceeds
             self._layer_step_host(i, mean)
         elif acc[i] is None:
-            acc[i] = flat.copy()  # device_get arrays are read-only
+            acc[i] = flat.copy()  # landed arrays are read-only views
         else:
             acc[i] += flat
 
@@ -390,11 +427,110 @@ class InfinityEngine:
         new_res_master = self.resident_opt.step(g_res_host)
         self.resident = jax.device_put(tree_cast(new_res_master, self.dtype))
         self.step_count += 1
+        self.telemetry.maybe_export(self.step_count)
         return float(loss)
 
     @property
     def peak_param_hbm_bytes(self):
         return self.streamer.peak_live_layers * self.store.layer_bytes
 
+    def offload_stats(self):
+        """Host-side overlap counters for the bench offload lane,
+        available with telemetry off. The two directions are reported
+        SEPARATELY on purpose: `staging.stall_ms_total` (device-ward) is
+        a pure transfer-lateness signal — acquiring a layer never waits
+        on compute — while `hostward_wait_ms_total` is measured at the
+        host's one sync point with the device stream per layer, so it
+        includes the producing vjp's in-flight compute by construction;
+        summing them into one "stall" would double-count compute as
+        transfer."""
+        return {"staging": self.streamer.stats(),
+                "hostward_wait_ms_total": round(self.hostward_wait_ms_total,
+                                                3),
+                "hostward_bytes_total": self.hostward_bytes_total,
+                "write_flushes": self.store.write_flushes,
+                "landing_depth": self.landing_depth,
+                "lookahead": self.streamer.lookahead}
+
+    def memory_plan(self, capacity_bytes=0):
+        """The memscope training plan priced from THE LIVE TIER: the host
+        params column is byte-identical to the `LayerParamStore`, the
+        device staging column to the streamer's `lookahead+1` window
+        (telemetry/memscope.py `plan_training_from_infinity`)."""
+        from deepspeed_tpu.telemetry.memscope import plan_training_from_infinity
+        return plan_training_from_infinity(self, capacity_bytes=capacity_bytes)
+
+    # ---- checkpointing (checkpoint/saver.py free functions; the commit
+    # protocol, validated rollback-walking loads, retention and the fault
+    # hooks all come from there — this tier only defines what "state" is) --
+
+    @property
+    def global_steps(self):
+        return self.step_count
+
+    @property
+    def state(self):
+        """Host snapshot pytree: fp32 masters + moments + loss-scale
+        bookkeeping. The bit16 store is DERIVED state (bit16(master)) —
+        rebuilt by the setter on load, so a checkpoint holds one copy of
+        the truth and never needs to read the (possibly disk-resident)
+        store."""
+        return {"layer_opts": [o.state_dict() for o in self.layer_opts],
+                "resident_opt": self.resident_opt.state_dict(),
+                "step": int(self.step_count),
+                "scale": float(self.cur_scale),
+                "good_steps": int(self._scale_state.good_steps),
+                "overflows": int(self._scale_state.overflows),
+                "hysteresis_left": int(self._scale_state.hysteresis_left)}
+
+    @state.setter
+    def state(self, s):
+        for i, sd in enumerate(s["layer_opts"]):
+            opt = self.layer_opts[i]
+            opt.load_state_dict(sd)
+            # bit16 write-back: the store content is derived from the master
+            self.store.put(i, [np.asarray(l).astype(self.store.leaf_meta[j][1])
+                               for j, l in enumerate(opt.master)])
+        self.store.flush_writes()
+        self.resident_opt.load_state_dict(s["resident_opt"])
+        res_master = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self.resident),
+            self.resident_opt.master)
+        self.resident = jax.device_put(tree_cast(res_master, self.dtype))
+        self.streamer.reset()           # device copies are stale
+        self.step_count = int(np.asarray(s["step"]))
+        from deepspeed_tpu.runtime.precision import LossScaleState
+        self._scale_state = LossScaleState(
+            scale=jnp.asarray(float(np.asarray(s["scale"])), jnp.float32),
+            good_steps=jnp.asarray(int(np.asarray(s["good_steps"])), jnp.int32),
+            overflows=jnp.asarray(int(np.asarray(s["overflows"])), jnp.int32),
+            hysteresis_left=jnp.asarray(
+                int(np.asarray(s["hysteresis_left"])), jnp.int32))
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        """Atomic-commit checkpoint of the tier's host state (PR 2
+        protocol: stage -> manifest -> rename-commit -> latest). The async
+        write-back queue is flushed FIRST: a snapshot must never race its
+        own in-flight disk writes — that ordering is what keeps a mid-step
+        crash during async write-back recoverable (the manifest only ever
+        describes a quiesced store)."""
+        self.store.flush_writes()
+        from deepspeed_tpu.checkpoint import saver
+        client = dict(client_state or {})
+        client.setdefault("global_steps", int(self.step_count))
+        return saver.save_checkpoint(self, save_dir, tag=tag,
+                                     client_state=client,
+                                     save_latest=save_latest)
+
+    def load_checkpoint(self, load_dir, tag=None):
+        """Validated restore with the corruption rollback walk
+        (checkpoint/saver.py): checksum-verified manifest, newest good tag
+        wins. Full-state loads only — this tier's masters/moments ARE the
+        model, partial loads have nothing to stand on."""
+        from deepspeed_tpu.checkpoint import saver
+        return saver.load_checkpoint(self, load_dir, tag=tag)
+
     def release(self):
+        self.telemetry.close()
         self.store.release()

@@ -1,0 +1,117 @@
+"""Host-ward half of the async offload staging pipeline.
+
+The device-ward half lives in `runtime/param_swap.py` (`LayerStreamer`
+staging host layers into HBM ahead of compute). This module carries the
+opposite direction: gradients (and any other device-resident tree) leaving
+for the host optimizer of the ZeRO-Offload/Infinity tier
+(`runtime/cpu_optimizer.py`, `runtime/infinity.py`).
+
+`HostwardPipe` turns the blocking per-layer `jax.device_get` of the old
+path into dispatch + deferred landing: `submit()` fires
+`copy_to_host_async()` on every leaf the moment the producing program is
+enqueued — the D2H copy then overlaps the NEXT layer's backward — and the
+consumer collects landed entries a configurable depth behind. The step
+only blocks on a transfer that is genuinely late, and that block is
+measured (`offload/hostward_wait_ms`), not assumed away.
+
+Metric names are centralized in `OFFLOAD_METRICS` so docs/profiling.md's
+catalog and the tests pin one spelling.
+"""
+
+import collections
+import time
+
+import jax
+import numpy as np
+
+# the offload tier's metric vocabulary (docs/profiling.md "Metric catalog";
+# docs/offload.md explains the overlap-efficiency math built on them)
+OFFLOAD_METRICS = (
+    "offload/stage_wait_ms",       # host stall making a layer device-ready
+    "offload/hostward_wait_ms",    # host stall landing a device->host tree
+    "offload/write_flush_ms",      # NVMe write-back flush barrier
+    "offload/staging_occupancy",   # live device-resident staged layers
+    "offload/inflight_bytes",      # bytes in async flight (reads + writes)
+    "offload/bytes_to_host",       # cumulative device->host traffic
+)
+
+
+def _leaf_bytes(leaves):
+    return sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+               for l in leaves if hasattr(l, "shape"))
+
+
+class HostwardPipe:
+    """Bounded async device->host landing queue.
+
+    `submit(key, value)` dispatches `copy_to_host_async()` on every jax
+    leaf of `value` (a non-blocking D2H enqueue under JAX's dispatch
+    model) and returns the entries that fell out of the depth window —
+    each landed as numpy, oldest first. `depth` is how many trees may be
+    in flight at once: 1 is classic double buffering (layer i's grads
+    land while layer i-1's backward runs), 0 degenerates to the blocking
+    path (submit returns its own landing immediately).
+
+    The landing conversion (`np.asarray`) is where a late transfer blocks;
+    that wait is measured into `offload/hostward_wait_ms` when a telemetry
+    facade is attached.
+    """
+
+    def __init__(self, depth=1, telemetry=None, clock=None):
+        self.depth = max(0, int(depth))
+        self.telemetry = telemetry
+        self._clock = clock if clock is not None else time.perf_counter
+        self._q = collections.deque()   # (key, leaves, treedef)
+        self.bytes_in_flight = 0
+        self.bytes_total = 0
+        self.landings = 0
+        self.wait_ms_total = 0.0
+
+    def __len__(self):
+        return len(self._q)
+
+    def submit(self, key, value):
+        """Dispatch `value`'s D2H copies and enqueue it; returns the list of
+        (key, landed_value) entries popped past the depth window."""
+        leaves, treedef = jax.tree_util.tree_flatten(value)
+        for l in leaves:
+            # non-blocking: enqueues the copy behind the producing program;
+            # plain numpy leaves (already host) have no such method
+            if hasattr(l, "copy_to_host_async"):
+                l.copy_to_host_async()
+        self._q.append((key, leaves, treedef))
+        self.bytes_in_flight += _leaf_bytes(leaves)
+        out = []
+        while len(self._q) > self.depth:
+            out.append(self._land(*self._q.popleft()))
+        return out
+
+    def _land(self, key, leaves, treedef):
+        t0 = self._clock()
+        nbytes = _leaf_bytes(leaves)
+        # the landing point of a transfer dispatched async at submit(); a
+        # late transfer blocks HERE and the wait is measured, not hidden
+        host = [np.asarray(l) for l in leaves]
+        wait_ms = (self._clock() - t0) * 1e3
+        self.bytes_in_flight = max(0, self.bytes_in_flight - nbytes)
+        self.bytes_total += nbytes
+        self.landings += 1
+        self.wait_ms_total += wait_ms
+        tel = self.telemetry
+        if tel is not None and getattr(tel, "enabled", False):
+            tel.observe("offload/hostward_wait_ms", wait_ms)
+            tel.inc("offload/bytes_to_host", nbytes)
+        return key, jax.tree_util.tree_unflatten(treedef, host)
+
+    def drain(self):
+        """Land every remaining entry, oldest first."""
+        out = []
+        while self._q:
+            out.append(self._land(*self._q.popleft()))
+        return out
+
+    def stats(self):
+        return {"landings": self.landings,
+                "bytes_total": self.bytes_total,
+                "wait_ms_total": round(self.wait_ms_total, 3),
+                "in_flight": len(self._q)}

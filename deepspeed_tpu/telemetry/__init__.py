@@ -52,6 +52,7 @@ from deepspeed_tpu.telemetry.memscope import (MemoryPlan, PredictedOOMError,
                                               ServingMemScope, TrainMemScope,
                                               fmt_bytes, max_kv_blocks,
                                               plan_serving, plan_training,
+                                              plan_training_from_infinity,
                                               tree_bytes)
 
 __all__ = ["Telemetry", "MetricsRegistry", "Counter", "Gauge", "Histogram",
@@ -59,7 +60,8 @@ __all__ = ["Telemetry", "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "prometheus_text", "ChromeTraceSink", "Span", "Tracer",
            "TraceContext", "FlightRecorder", "CompileWatchdog",
            "MemoryPlan", "PredictedOOMError", "ServingMemScope",
-           "TrainMemScope", "plan_training", "plan_serving", "max_kv_blocks",
+           "TrainMemScope", "plan_training", "plan_serving",
+           "plan_training_from_infinity", "max_kv_blocks",
            "fmt_bytes", "tree_bytes"]
 
 _NULL_SPAN = contextlib.nullcontext()

@@ -54,6 +54,7 @@ from deepspeed_tpu.utils.logging import logger
 __all__ = [
     "MemoryPlan", "PredictedOOMError", "ServingMemScope", "TrainMemScope",
     "plan_training", "plan_serving", "plan_training_from_engine",
+    "plan_training_from_infinity",
     "plan_serving_prealloc", "serving_pool_bytes", "max_kv_blocks",
     "estimate_zero2_model_states_mem_needs",
     "estimate_zero3_model_states_mem_needs",
@@ -70,6 +71,7 @@ LEDGER_GAUGES = (
     "prefix_cached_bytes",
     "draft_params_bytes", "draft_pool_bytes",
     "master_bytes", "opt_state_bytes",
+    "offload_staged_bytes", "offload_host_bytes",
     "program_temp_bytes", "bytes_in_use", "peak_bytes", "capacity_bytes",
     "attributed_bytes", "unattributed_bytes", "headroom_frac",
 )
@@ -301,8 +303,9 @@ class MemoryPlan:
 def plan_training(n_params, *, zero_stage=0, dp=1, tp=1, dtype="bfloat16",
                   master_weights=True, optimizer_moments=2,
                   grad_accum_dtype=None, offload_optimizer=False,
-                  offload_param=False, temp_bytes=0,
-                  capacity_bytes=0) -> MemoryPlan:
+                  offload_param=False, offload_param_bytes=None,
+                  offload_staging_layers=0, offload_layer_bytes=0,
+                  temp_bytes=0, capacity_bytes=0) -> MemoryPlan:
     """Model-state memory prediction per device — the ZeRO estimator.
 
     Mirrors the reference's `estimate_zero*_model_states_mem_needs` math on
@@ -320,6 +323,14 @@ def plan_training(n_params, *, zero_stage=0, dp=1, tp=1, dtype="bfloat16",
     arguments) but are listed per the reference's convention — the
     planner-parity test compares `total - grads` against the compiled
     step's argument bytes.
+
+    Exact offload pricing (the Infinity tier; `plan_training_from_infinity`
+    fills these from the live engine): `offload_param_bytes` overrides the
+    host params column with a LIVE store's measured bytes — the prediction
+    is then byte-identical to the `LayerParamStore`, not an n·dtype
+    estimate — and `offload_staging_layers` × `offload_layer_bytes` prices
+    the device-side async staging window (lookahead+1 layers of weights in
+    rotation) that the offloaded params still occupy.
     """
     n = int(n_params)
     dp = max(1, int(dp))
@@ -334,8 +345,16 @@ def plan_training(n_params, *, zero_stage=0, dp=1, tp=1, dtype="bfloat16",
 
     params = n * p_b // p_shard
     if offload_param:
-        host["params"] = params
+        host["params"] = params if offload_param_bytes is None \
+            else int(offload_param_bytes)
         dev["params"] = 0
+        if offload_staging_layers and offload_layer_bytes:
+            dev["param_staging"] = int(offload_staging_layers) * \
+                int(offload_layer_bytes)
+            notes.append(
+                f"offload_param: async staging pool keeps "
+                f"{int(offload_staging_layers)} layer(s) of weights "
+                f"device-resident (lookahead+1 rotation)")
         notes.append("offload_param: bit16 params host-resident, "
                      "streamed/gathered through HBM per layer")
     else:
@@ -414,6 +433,58 @@ def plan_training_from_engine(engine, capacity_bytes=0,
         grad_accum_dtype=cfg.data_types.grad_accum_dtype,
         offload_optimizer=off_o, offload_param=off_p,
         temp_bytes=temp_bytes, capacity_bytes=capacity_bytes)
+
+
+def plan_training_from_infinity(engine, capacity_bytes=0,
+                                temp_bytes=0) -> MemoryPlan:
+    """Training plan priced from a LIVE InfinityEngine — every model-state
+    byte measured, none estimated:
+
+      host   params  = the `LayerParamStore`'s exact bytes (layer_bytes ×
+                       num_layers — the byte-identity the offload tests
+                       assert);
+      host   master  = the fp32 masters held by the per-layer +
+                       resident `HostOffloadOptimizer`s;
+      host   optim   = their moments (exp_avg / exp_avg_sq), whether
+                       RAM-held or NVMe-swapped;
+      device params          = the resident leaves (embed/norms/head);
+      device param_staging   = the async staging window — lookahead+1
+                               layers of bit16 weights in rotation
+                               (`LayerStreamer.depth` × layer_bytes, the
+                               streamer's peak_live_layers bound).
+
+    Boundary activations ([L+1, B, T, D] — the dominant device term at
+    large batch) live in `temp_bytes`, measured or margin, matching the
+    reference estimators' model-states-only convention."""
+    import numpy as np
+    host: Dict[str, int] = {}
+    dev: Dict[str, int] = {}
+    store = engine.store
+    host["params"] = int(store.host_bytes)
+    masters = 0
+    optim = 0
+    for opt in list(engine.layer_opts) + [engine.resident_opt]:
+        masters += sum(int(m.nbytes) for m in opt.master)
+        for moments in (opt.exp_avg, opt.exp_avg_sq):
+            if moments:
+                optim += sum(int(m.nbytes) for m in moments)
+        if getattr(opt, "nvme", None) is not None:
+            # NVMe-swapped moments: priced from the swapper's metadata —
+            # they stream through host RAM per step
+            optim += sum(int(np.prod(s)) * np.dtype(d).itemsize
+                         for s, d in opt.nvme.meta.values())
+    host["master"] = masters
+    host["optim"] = optim
+    dev["params"] = tree_bytes(engine.resident)
+    dev["param_staging"] = engine.streamer.depth * store.layer_bytes
+    notes = [
+        "priced from the live tier: host params are byte-identical to the "
+        "LayerParamStore; param_staging is the lookahead+1 async staging "
+        "window (peak_live_layers bound)",
+        "boundary activations / vjp workspace live in temp_bytes "
+        "(measured or margin)"]
+    return MemoryPlan("train", dev, host, int(temp_bytes),
+                      int(capacity_bytes), notes)
 
 
 def kv_cache_is_quantized(kv_cache_dtype) -> bool:
@@ -876,6 +947,11 @@ class ServingMemScope(_MemScopeBase):
             if dr is not None else 0
         self.draft_pool_bytes = tree_bytes(getattr(dr, "pool", None)) \
             if dr is not None else 0
+        # streamed (offloaded-weights) mode: params_bytes above priced only
+        # the RESIDENT tree (engine.params); the staged layer window is a
+        # live device claim of its own, the host store an informational one
+        self._streamed_engine = serving.engine \
+            if getattr(serving, "streamed", False) else None
 
     def _categories(self):
         cats = {"params_bytes": self.params_bytes,
@@ -883,6 +959,10 @@ class ServingMemScope(_MemScopeBase):
         if self.draft_params_bytes or self.draft_pool_bytes:
             cats["draft_params_bytes"] = self.draft_params_bytes
             cats["draft_pool_bytes"] = self.draft_pool_bytes
+        eng = self._streamed_engine
+        if eng is not None:
+            cats["offload_staged_bytes"] = \
+                len(eng.streamer._live) * eng.store.layer_bytes
         info = {
             # per-sequence-shard residency: equals kv_pool_bytes for the
             # flat pool; 1/sp of it when the pool spans the sequence axis —
@@ -891,6 +971,11 @@ class ServingMemScope(_MemScopeBase):
             # kv_pool_bytes, never added to the attribution sum).
             "kv_pool_per_chip_bytes": self.pool_bytes // self.span_shards,
         }
+        if eng is not None:
+            # host/disk residency of the streamed weights — informational
+            # (not device memory), the live counterpart of the planner's
+            # host column
+            info["offload_host_bytes"] = eng.store.host_bytes
         pc = self.serving.prefix_cache
         if pc is not None:
             # a VIEW of kv_pool (blocks the cache holds matchable), never
@@ -902,6 +987,13 @@ class ServingMemScope(_MemScopeBase):
     def _program_args(self):
         import numpy as np
         s = self.serving
+        if getattr(s, "streamed", False):
+            # streamed (offloaded-weights) mode: the step "programs" are
+            # host loops over per-layer jits — no single whole-step
+            # executable exists to memory_analyze; the pool + resident
+            # categories (and the staging window, priced by the planner)
+            # still cover the residents
+            return
         params, pool, rng = s.engine.params, s.pool, s._rng
         S, chunk = s.max_slots, s.chunk
 
@@ -944,10 +1036,17 @@ class ServingMemScope(_MemScopeBase):
                      "head_dim": dleaf.shape[4],
                      "params_bytes": self.draft_params_bytes,
                      "kv_cache_dtype": dleaf.dtype, "kv_group_size": dg}
+        params_bytes = self.params_bytes
+        eng = self._streamed_engine
+        if eng is not None:
+            # streamed weights: the device claim is resident leaves + the
+            # staging window (lookahead+1 layers), byte-identical to the
+            # live LayerParamStore's layer_bytes
+            params_bytes += eng.streamer.depth * eng.store.layer_bytes
         return plan_serving(
             n_layer=L, n_kv_head=Hkv, head_dim=hd, kv_block_size=B,
             num_kv_blocks=N, kv_cache_dtype=leaf.dtype, kv_group_size=g,
-            params_bytes=self.params_bytes, draft=draft,
+            params_bytes=params_bytes, draft=draft,
             temp_bytes=self.program_temp_bytes(),
             capacity_bytes=self.capacity_bytes())
 
@@ -1062,6 +1161,17 @@ def main(argv=None) -> int:
     ap.add_argument("--no-master", action="store_true")
     ap.add_argument("--offload-optimizer", action="store_true")
     ap.add_argument("--offload-param", action="store_true")
+    ap.add_argument("--offload-param-bytes", type=float, default=0,
+                    help="exact host bytes of a live LayerParamStore "
+                         "(overrides the n-params estimate for the host "
+                         "params column — byte-identical planning)")
+    ap.add_argument("--staging-layers", type=int, default=0,
+                    help="offload staging-pool depth (lookahead+1): prices "
+                         "the device-resident weight window next to the "
+                         "host column")
+    ap.add_argument("--layer-bytes", type=float, default=0,
+                    help="bit16 bytes of ONE layer's weights (with "
+                         "--staging-layers: the staging window's unit)")
     # serving planner
     ap.add_argument("--layers", type=int, default=0)
     ap.add_argument("--kv-heads", type=int, default=0)
@@ -1096,6 +1206,10 @@ def main(argv=None) -> int:
                              master_weights=not args.no_master,
                              offload_optimizer=args.offload_optimizer,
                              offload_param=args.offload_param,
+                             offload_param_bytes=(int(args.offload_param_bytes)
+                                                  or None),
+                             offload_staging_layers=args.staging_layers,
+                             offload_layer_bytes=int(args.layer_bytes),
                              capacity_bytes=capacity)
         print(json.dumps(plan.to_dict()) if args.json else plan.render())
         return 0 if plan.fits is not False else 2

@@ -114,6 +114,14 @@ def pytest_configure(config):
                    "sharded paged pool) — fast and CPU-harness-safe, rides "
                    "in tier-1; run it alone with pytest -m longctx)")
     config.addinivalue_line(
+        "markers", "offload: async offload staging pipeline suite "
+                   "(tests/test_offload.py — double-buffered host/disk "
+                   "weight staging with measured stage-wait, bounded async "
+                   "write-back, crash-safe checkpointing under write-back, "
+                   "streamed serving parity, memscope host-column byte "
+                   "identity) — fast and CPU-harness-safe, rides in "
+                   "tier-1; run it alone with pytest -m offload)")
+    config.addinivalue_line(
         "markers", "chaos: self-healing serving pool suite "
                    "(tests/test_selfheal.py — KV-pool invariant auditor + "
                    "repair, hung-replica watchdog, hard deadlines, hedged "

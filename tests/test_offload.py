@@ -1,0 +1,444 @@
+"""Async offload staging pipeline (ZeRO-Offload/Infinity + ZeRO-Inference;
+reference `runtime/swap_tensor/partitioned_param_swapper.py`, SURVEY §7
+step 3 "async double-buffered host staging").
+
+What tier-1 pins here:
+  * prefetch-depth sweep is BIT-identical to the blocking path (overlap is
+    a latency optimization, never a numerics change);
+  * `offload/stage_wait_ms` p50 ~ 0 once depth >= 2 (the overlap is
+    measured, not asserted);
+  * the disk tier's async write-back queue is bounded (`max_write_bytes`);
+  * a mid-step crash during async write-back leaves the checkpoint
+    manifest recoverable (PR 2 commit protocol);
+  * streamed serving (offloaded weights under the scheduler) is
+    token-identical to the resident engine at <= 1 compile per program;
+  * memscope's host column is byte-identical to the live LayerParamStore.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.config.core import MeshConfig, TelemetryConfig
+from deepspeed_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                      make_gpt_decode_model,
+                                      make_gpt_layered_model)
+from deepspeed_tpu.runtime.infinity import InfinityEngine
+from deepspeed_tpu.runtime.offload_staging import HostwardPipe
+from deepspeed_tpu.runtime.param_swap import LayerParamStore, LayerStreamer
+
+pytestmark = pytest.mark.offload
+
+DEEP = GPTConfig(n_layer=6, n_head=4, d_model=64, d_ff=128, max_seq_len=128,
+                 vocab_size=128, dtype=jnp.float32, remat=False)
+
+
+def _mk_mesh(**axes):
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    return mesh_mod.init_mesh(MeshConfig(**{**dict(data=1, tensor=1,
+                                                   sequence=1, expert=1,
+                                                   pipe=1), **axes}))
+
+
+def _batches(n, B=4, T=17, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"tokens": rng.integers(0, DEEP.vocab_size, (B, T)).astype(np.int32)}
+            for _ in range(n)]
+
+
+def _registry_telemetry():
+    """Registry-only telemetry (no files) for metric assertions."""
+    from deepspeed_tpu.telemetry import Telemetry
+    return Telemetry(TelemetryConfig(enabled=True, prometheus=False,
+                                     jsonl=False, monitor_bridge=False),
+                     subsystem="test-offload")
+
+
+# ----------------------------------------------------------------------
+# staging pipeline: parity, overlap, write budget
+# ----------------------------------------------------------------------
+
+
+def test_prefetch_depth_sweep_bit_identical_losses(tmp_path):
+    """Overlap must never change numerics: lookahead 1, 2, 3 (and the nvme
+    tier at depth 2, with a deeper landing pipe) walk bit-identical loss
+    trajectories to the blocking lookahead=0 baseline."""
+    params = init_gpt_params(DEEP, seed=0)
+    batches = _batches(4, seed=3)
+
+    def run(**kw):
+        spec = make_gpt_layered_model(cfg=DEEP, name="inf", params=params)
+        eng = InfinityEngine(spec, lr=1e-2, dtype=jnp.float32, **kw)
+        losses = [eng.train_batch(b) for b in batches]
+        eng.release()
+        return np.asarray(losses)
+
+    base = run(offload_device="cpu", lookahead=0)
+    for depth in (1, 2, 3):
+        np.testing.assert_array_equal(
+            run(offload_device="cpu", lookahead=depth), base,
+            err_msg=f"lookahead={depth}")
+    np.testing.assert_array_equal(
+        run(offload_device="cpu", lookahead=2, landing_depth=3), base)
+    np.testing.assert_array_equal(
+        run(offload_device="nvme", nvme_path=str(tmp_path / "w"),
+            lookahead=2), base, err_msg="nvme depth=2")
+
+
+def test_stage_wait_p50_zero_at_depth_2(tmp_path):
+    """The acceptance number: with prefetch depth >= 2 on the CPU harness
+    the staging pool almost always has the next layer ready — the
+    stage-wait histogram's p50 is ~0 — while the blocking baseline
+    (lookahead=0) misses on every acquisition."""
+    rng = np.random.default_rng(0)
+    stacked = {"w": rng.normal(size=(8, 64, 64)).astype(np.float32),
+               "b": rng.normal(size=(8, 256)).astype(np.float32)}
+
+    def walk(streamer, passes=4):
+        for _ in range(passes):
+            for i in range(8):
+                streamer.layer(i)
+
+    tel = _registry_telemetry()
+    store = LayerParamStore(stacked, device="nvme",
+                            swap_folder=str(tmp_path / "s2"), staging=4)
+    store.telemetry = tel
+    fast = LayerStreamer(store, lookahead=2, cyclic=True, telemetry=tel)
+    walk(fast)
+    snap = tel.registry.histogram("offload/stage_wait_ms").snapshot()
+    assert snap["count"] == fast.acquires
+    assert snap["p50"] <= 1.0, snap       # staged hits record ~0 wait
+    assert fast.hits >= fast.acquires - 8, fast.stats()  # only pass 1 misses
+    # occupancy/inflight gauges exist and are sane
+    occ = tel.registry.gauge("offload/staging_occupancy").value
+    assert 0 < occ <= fast.depth
+    store.release()
+
+    blocking = LayerStreamer(
+        LayerParamStore(stacked, device="nvme",
+                        swap_folder=str(tmp_path / "s0"), staging=2),
+        lookahead=0)
+    walk(blocking)
+    assert blocking.hits == 0                      # every acquisition stalls
+    assert blocking.stall_ms_total > 0
+    assert blocking.peak_live_layers == 1
+    blocking.store.release()
+
+
+def test_cyclic_lookahead_pins_scan_order(tmp_path):
+    """The decode walk wraps L-1 -> 0 every step: cyclic mode keeps layer 0
+    staged across the wrap, so the second and later passes are all hits —
+    without it each pass restarted cold."""
+    rng = np.random.default_rng(1)
+    stacked = {"w": rng.normal(size=(5, 32, 32)).astype(np.float32)}
+    store = LayerParamStore(stacked, device="cpu")
+    s = LayerStreamer(store, lookahead=1, cyclic=True)
+    for _ in range(3):
+        for i in range(5):
+            tree = s.layer(i)
+            np.testing.assert_array_equal(np.asarray(tree["w"]),
+                                          stacked["w"][i])
+    # pass 1: only layer 0 misses (each layer(i) pre-uploads i+1, incl. the
+    # wrap 4->0); passes 2..3: all hits
+    assert s.hits == 3 * 5 - 1, s.stats()
+    assert s.peak_live_layers <= 2
+
+
+def test_write_budget_bounds_host_ram(tmp_path):
+    """put(blocking=False) under a byte budget: the disk tier can never
+    queue more than `max_write_bytes` of un-flushed host buffers — the
+    put itself flushes past the budget — and every layer still round-trips
+    exactly."""
+    rng = np.random.default_rng(2)
+    stacked = {"w": rng.normal(size=(6, 128, 17)).astype(np.float32)}
+    store = LayerParamStore(stacked, device="nvme",
+                            swap_folder=str(tmp_path / "wb"),
+                            max_write_bytes=2 * 128 * 17 * 4)
+    new = {}
+    for i in range(6):
+        arr = rng.normal(size=(128, 17)).astype(np.float32)
+        new[i] = arr
+        store.put(i, [arr])
+        assert store.pending_write_bytes <= store.max_write_bytes
+    assert store.write_flushes >= 2        # the budget actually engaged
+    store.flush_writes()
+    assert store.pending_write_bytes == 0
+    for i in range(6):
+        np.testing.assert_array_equal(store.get_tree(i)["w"], new[i])
+    store.release()
+
+
+def test_hostward_pipe_bounded_async_landing():
+    """HostwardPipe: exact values in submit order, at most `depth` trees in
+    flight, byte accounting that returns to zero on drain."""
+    pipe = HostwardPipe(depth=2)
+    vals = {k: jnp.arange(16, dtype=jnp.float32) * (k + 1) for k in range(5)}
+    landed = []
+    for k, v in vals.items():
+        landed += pipe.submit(k, v)
+        assert len(pipe) <= 2
+    landed += pipe.drain()
+    assert [k for k, _ in landed] == list(range(5))     # oldest first
+    for k, arr in landed:
+        np.testing.assert_array_equal(arr, np.asarray(vals[k]))
+    assert pipe.bytes_in_flight == 0
+    assert pipe.stats()["landings"] == 5
+    # depth=0 degenerates to the blocking path: submit returns its own entry
+    p0 = HostwardPipe(depth=0)
+    out = p0.submit("x", jnp.ones((4,)))
+    assert [k for k, _ in out] == ["x"] and len(p0) == 0
+
+
+# ----------------------------------------------------------------------
+# checkpointing under async write-back
+# ----------------------------------------------------------------------
+
+
+def test_checkpoint_crash_during_async_writeback_recoverable(tmp_path):
+    """A crash between training steps — with async write-back in flight and
+    a save dying mid-commit — must leave the newest COMMITTED tag loadable:
+    the save flushes the write queue first (snapshot never races its own
+    disk writes), the staging dir is orphaned by the crash, and the
+    rollback walk restores the previous tag exactly."""
+    from deepspeed_tpu.checkpoint.manifest import resolve_latest_tag
+    from deepspeed_tpu.testing.faults import FaultInjected, crash_save
+
+    params = init_gpt_params(DEEP, seed=5)
+    spec = make_gpt_layered_model(cfg=DEEP, name="inf-ck", params=params)
+    eng = InfinityEngine(spec, lr=1e-2, dtype=jnp.float32,
+                         offload_device="nvme",
+                         nvme_path=str(tmp_path / "w"), lookahead=2)
+    batches = _batches(3, seed=7)
+    eng.train_batch(batches[0])
+    ckdir = tmp_path / "ck"
+    eng.save_checkpoint(ckdir, tag="good")
+    snap_master = np.array(eng.layer_opts[0].master[0])
+    snap_moment = np.array(eng.layer_opts[0].exp_avg[0])
+
+    eng.train_batch(batches[1])            # async write-back in flight again
+    with crash_save("before_commit"):
+        with pytest.raises(FaultInjected):
+            eng.save_checkpoint(ckdir, tag="crashed")
+    assert resolve_latest_tag(ckdir) == "good"
+    eng.release()
+
+    # fresh process stand-in: new engine, rollback-walking load
+    eng2 = InfinityEngine(
+        make_gpt_layered_model(cfg=DEEP, name="inf-ck", params=params),
+        lr=1e-2, dtype=jnp.float32, offload_device="nvme",
+        nvme_path=str(tmp_path / "w2"), lookahead=2)
+    path, client = eng2.load_checkpoint(ckdir)
+    assert path is not None and client["global_steps"] == 1
+    assert eng2.step_count == 1
+    np.testing.assert_array_equal(eng2.layer_opts[0].master[0], snap_master)
+    np.testing.assert_array_equal(eng2.layer_opts[0].exp_avg[0], snap_moment)
+    # the store was rebuilt from the restored masters
+    np.testing.assert_array_equal(
+        np.asarray(eng2.store.get(0)[0]),
+        snap_master.astype(eng2.store.leaf_meta[0][1]))
+    assert np.isfinite(eng2.train_batch(batches[2]))
+    eng2.release()
+
+
+def test_checkpoint_resume_matches_uninterrupted_run(tmp_path):
+    """save -> load into a fresh engine -> continue: the resumed trajectory
+    must match the uninterrupted one step for step (moments + masters +
+    store all round-tripped; nvme-swapped moments included)."""
+    params = init_gpt_params(DEEP, seed=6)
+    batches = _batches(5, seed=11)
+
+    ref = InfinityEngine(
+        make_gpt_layered_model(cfg=DEEP, name="inf-r", params=params),
+        lr=1e-2, dtype=jnp.float32, offload_device="cpu")
+    ref_losses = [ref.train_batch(b) for b in batches]
+    ref.release()
+
+    eng = InfinityEngine(
+        make_gpt_layered_model(cfg=DEEP, name="inf-r", params=params),
+        lr=1e-2, dtype=jnp.float32, offload_device="cpu",
+        optimizer_nvme_path=str(tmp_path / "opt"))
+    for b in batches[:2]:
+        eng.train_batch(b)
+    eng.save_checkpoint(tmp_path / "ck2")
+    eng.release()
+
+    cont = InfinityEngine(
+        make_gpt_layered_model(cfg=DEEP, name="inf-r", params=params),
+        lr=1e-2, dtype=jnp.float32, offload_device="cpu",
+        optimizer_nvme_path=str(tmp_path / "opt2"))
+    cont.load_checkpoint(tmp_path / "ck2")
+    cont_losses = [cont.train_batch(b) for b in batches[2:]]
+    np.testing.assert_allclose(cont_losses, ref_losses[2:], rtol=1e-6,
+                               atol=1e-6)
+    cont.release()
+
+
+# ----------------------------------------------------------------------
+# streamed decode + streamed serving
+# ----------------------------------------------------------------------
+
+
+def _spill_engines(tmp_path, offload_device="cpu", **cfg_extra):
+    from deepspeed_tpu.inference.engine import init_inference
+    _mk_mesh(data=1)
+    params = init_gpt_params(DEEP, seed=0)
+    ref = init_inference(
+        model=make_gpt_decode_model(cfg=DEEP, name="ref", params=params),
+        config={"dtype": "float32", "kv_cache_dtype": "float32",
+                "greedy": True, "kv_block_size": 16, "max_out_tokens": 128,
+                **cfg_extra})
+    off = {"device": offload_device, "lookahead": 2}
+    if offload_device == "nvme":
+        off["nvme_path"] = str(tmp_path / "swp")
+    eng = init_inference(
+        model=make_gpt_layered_model(cfg=DEEP, name="spill", params=params),
+        config={"dtype": "float32", "kv_cache_dtype": "float32",
+                "greedy": True, "kv_block_size": 16, "max_out_tokens": 128,
+                "zero": {"offload_param": off}, **cfg_extra})
+    return ref, eng
+
+
+def test_streamed_decode_reuses_cache_template(tmp_path):
+    """The PR 3 satellite pattern on the spill engine: a second generate()
+    with matching (B, max_len, dtype) reuses the engine-owned per-layer
+    cache buffers instead of re-allocating HBM — and stays token-identical
+    to the resident engine on BOTH calls (stale content past the written
+    prefix is provably unattended)."""
+    ref, eng = _spill_engines(tmp_path)
+    rng = np.random.default_rng(3)
+    toks1 = rng.integers(0, DEEP.vocab_size, (2, 8)).astype(np.int32)
+    toks2 = rng.integers(0, DEEP.vocab_size, (2, 8)).astype(np.int32)
+    np.testing.assert_array_equal(eng.generate(toks1, max_new_tokens=6),
+                                  ref.generate(toks1, max_new_tokens=6))
+    assert eng._cache_hits == 0
+    np.testing.assert_array_equal(eng.generate(toks2, max_new_tokens=6),
+                                  ref.generate(toks2, max_new_tokens=6))
+    assert eng._cache_hits == 1, "cache template was not reused"
+    # a different shape replaces (not grows) the single retained entry
+    toks3 = rng.integers(0, DEEP.vocab_size, (2, 12)).astype(np.int32)
+    eng.generate(toks3, max_new_tokens=6)
+    assert eng._cache_hits == 1
+    eng.release()
+
+
+@pytest.mark.parametrize("offload_device", ["cpu", "nvme"])
+def test_streamed_serving_token_identical(offload_device, tmp_path):
+    """The router/scheduler stack over STREAMED weights: greedy output on a
+    ragged trace is token-identical to the resident serving engine, at
+    exactly one compile per (per-layer) program, with the HBM weight
+    working set bounded by the staging window."""
+    from deepspeed_tpu.inference.scheduler import Request
+    ref, eng = _spill_engines(tmp_path, offload_device)
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(0, DEEP.vocab_size, (int(L),)).astype(np.int32)
+               for L in [9, 23, 5, 17, 31, 12]]
+    reqs = [Request(uid=i, tokens=p, max_new_tokens=8, stop_on_eos=False)
+            for i, p in enumerate(prompts)]
+    out_ref = ref.serving(max_slots=4, max_context=128,
+                          prefill_chunk=16).run(reqs)
+    serving = eng.serving(max_slots=4, max_context=128, prefill_chunk=16)
+    out = serving.run(reqs)
+    assert set(out) == set(out_ref)
+    for u in out_ref:
+        np.testing.assert_array_equal(out[u].tokens, out_ref[u].tokens,
+                                      err_msg=f"request {u}")
+    assert all(v == 1 for v in serving.compile_stats().values()), \
+        serving.compile_stats()
+    st = serving.stats()["offload"]
+    assert st["staging"]["peak_live_layers"] <= eng.streamer.depth
+    assert st["staging"]["uploads"] >= DEEP.n_layer
+    assert st["host_param_bytes"] == eng.store.host_bytes
+    eng.release()
+
+
+def test_streamed_serving_refuses_resident_only_features(tmp_path):
+    """The streamed mode's envelope is enforced loudly: spec decode, decode
+    windows > 1 and weight-only quant are resident-engine features."""
+    _, eng = _spill_engines(tmp_path)
+    with pytest.raises(ValueError, match="[Ss]peculative"):
+        eng.serving(max_slots=2, max_context=64,
+                    spec_decode={"drafter": "ngram"})
+    with pytest.raises(ValueError, match="decode_steps_per_sync"):
+        eng.serving(max_slots=2, max_context=64, decode_steps_per_sync=4)
+    with pytest.raises(ValueError, match="resident"):
+        eng.serving(max_slots=2, max_context=64,
+                    quantization={"weights": "int8"})
+    eng.release()
+
+
+def test_streamed_serving_memscope_ledger(tmp_path):
+    """Streamed serving under memscope: the ledger attributes the staged
+    weight window (`offload_staged_bytes`), reports the host store
+    (`offload_host_bytes` — informational), and the reconstructed plan
+    prices resident + staging weights next to the pool."""
+    from deepspeed_tpu.inference.scheduler import Request
+    _, eng = _spill_engines(
+        tmp_path, telemetry={"enabled": True, "prometheus": False,
+                             "jsonl": False, "monitor_bridge": False,
+                             "memscope": True, "memscope_programs": False})
+    serving = eng.serving(max_slots=2, max_context=64, prefill_chunk=16)
+    rng = np.random.default_rng(5)
+    reqs = [Request(uid=i,
+                    tokens=rng.integers(0, DEEP.vocab_size, (7,)).astype(np.int32),
+                    max_new_tokens=4, stop_on_eos=False) for i in range(2)]
+    serving.run(reqs)
+    snap = serving.stats()["memory"]
+    assert snap["offload_host_bytes"] == eng.store.host_bytes
+    assert 0 < snap["offload_staged_bytes"] <= \
+        eng.streamer.depth * eng.store.layer_bytes
+    plan = serving.memscope.plan()
+    assert plan.device_bytes["params"] >= \
+        eng.streamer.depth * eng.store.layer_bytes
+    # the staging stall metrics landed in the SERVING registry
+    snap_all = serving.telemetry.registry.snapshot()
+    assert "offload/stage_wait_ms" in snap_all
+    eng.release()
+
+
+# ----------------------------------------------------------------------
+# memscope byte identity (training tier)
+# ----------------------------------------------------------------------
+
+
+def test_memscope_host_column_matches_live_store(tmp_path):
+    """`plan_training_from_infinity`: the host params column equals the
+    live LayerParamStore's bytes EXACTLY (sum over every stored layer
+    buffer), masters/moments equal the optimizers' arrays exactly, and the
+    device staging column bounds the streamer's measured peak."""
+    params = init_gpt_params(DEEP, seed=8)
+    spec = make_gpt_layered_model(cfg=DEEP, name="inf-ms", params=params)
+    eng = InfinityEngine(spec, lr=1e-2, dtype=jnp.float32,
+                         offload_device="nvme",
+                         nvme_path=str(tmp_path / "w"), lookahead=1)
+    eng.train_batch(_batches(1, seed=13)[0])
+    plan = eng.memory_plan()
+    live_store = sum(sum(int(a.nbytes) for a in eng.store.get(i))
+                     for i in range(eng.L))
+    assert plan.host_bytes["params"] == live_store == eng.store.host_bytes
+    live_master = sum(
+        sum(int(m.nbytes) for m in o.master)
+        for o in list(eng.layer_opts) + [eng.resident_opt])
+    assert plan.host_bytes["master"] == live_master
+    assert plan.device_bytes["param_staging"] == \
+        eng.streamer.depth * eng.store.layer_bytes
+    assert eng.peak_param_hbm_bytes <= plan.device_bytes["param_staging"]
+    eng.release()
+
+
+def test_memscope_cli_offload_train_plan(capsys):
+    """`dstpu_memscope --plan train` with the exact-pricing flags: the host
+    column renders the live store's bytes verbatim and the staging window
+    appears as a device row."""
+    import json as json_mod
+    from deepspeed_tpu.telemetry.memscope import main as ms_main
+    rc = ms_main(["--plan", "train", "--params", "1e6", "--offload-param",
+                  "--offload-param-bytes", "123456", "--staging-layers",
+                  "2", "--layer-bytes", "1000", "--json"])
+    assert rc == 0
+    out = json_mod.loads(capsys.readouterr().out.strip())
+    assert out["host_bytes"]["params"] == 123456
+    assert out["device_bytes"]["param_staging"] == 2000
