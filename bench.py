@@ -1304,6 +1304,169 @@ def run_robustness_lane():
     return result
 
 
+def run_scaling_arm():
+    """One weak-scaling arm (child process with its own device count): a
+    tiny GPT trained over a data=N mesh through the engine's explicit 2-hop
+    reduce-scatter/all-gather grad wire (fp32 or int8 qgZ encoding on the
+    SAME structure). Reports tokens/s/chip, and the per-step per-op wire
+    bytes from the comm facade's OWN trace-time accounting
+    (`comm/collectives.py` — reset, retrace, snapshot), not HLO text."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import collectives as coll
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_model
+
+    n = int(os.environ["BENCH_SCALING_N"])
+    wire = os.environ.get("BENCH_SCALING_WIRE", "fp")
+    steps = int(os.environ.get("BENCH_SCALING_STEPS", "3"))
+    seq = int(os.environ.get("BENCH_SCALING_SEQ", "256"))
+    mbs = int(os.environ.get("BENCH_SCALING_MBS", "2"))
+    cfg = GPTConfig(n_layer=2, n_head=4, d_model=128, d_ff=512,
+                    max_seq_len=seq, vocab_size=1024,
+                    dtype=jnp.bfloat16, remat=False)
+    mesh_mod.clear_mesh()
+    model = make_gpt_model(cfg=cfg, name=f"scaling-dp{n}")
+    e, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": mbs,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2, "explicit_grad_reduce": True,
+                              "zero_quantized_gradients": wire == "int8"},
+        "mesh": {"data": n},
+        "steps_per_print": 10**9})
+    batch = {"tokens": np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (e.train_batch_size(), seq)).astype(np.int32)}
+    placed = e._maybe_split_gas(batch)
+    coll.stats.reset()
+    e._train_step.lower(e.state, placed)      # trace → per-step wire plan
+    per_op = {op: int(rec["bytes"])
+              for op, rec in coll.stats.snapshot().items()}
+    loss = e.train_batch(batch)               # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = e.train_batch(batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    tokens = e.train_batch_size() * seq * steps
+    result = {
+        "metric": f"scaling_dp{n}_{wire}_tokens_per_sec_per_chip",
+        "value": round(tokens / dt / n, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "extra": {
+            "devices": n, "wire": wire, "loss": float(loss),
+            "step_time_ms": round(dt / steps * 1e3, 3),
+            "comm_bytes_per_step": per_op,
+            # the grad-reduce wire: rs + ag (fp arm) / a2a + ag (int8 arm)
+            "grad_reduce_bytes_per_step": sum(
+                per_op.get(k, 0) for k in
+                ("reduce_scatter", "all_gather", "all_to_all")),
+        },
+    }
+    print(json.dumps(result))
+    return result
+
+
+def _with_exact_device_count(flags, n):
+    """XLA_FLAGS with --xla_force_host_platform_device_count pinned to n."""
+    import re
+    pat = r"--xla_force_host_platform_device_count=\d+"
+    if re.search(pat, flags):
+        return re.sub(pat, f"--xla_force_host_platform_device_count={n}",
+                      flags)
+    return f"{flags} --xla_force_host_platform_device_count={n}".strip()
+
+
+def run_scaling_lane():
+    """Scaling-efficiency lane: weak scaling over data=N ∈ {1,2,4,8} with
+    the explicit fp32 grad wire (per-arm child process owning exactly N
+    devices), plus an int8-qgZ arm at the widest N. Reports tokens/s/chip
+    per arm, weak-scaling efficiency (per-chip throughput retained dp1→dpN,
+    1.0 = linear), per-op comm bytes/step from the facade stats, and the
+    fp→int8 grad-reduce wire-byte ratio — both arms run the SAME 2-hop
+    reduce-scatter/all-gather structure, so the ratio isolates the wire
+    encoding (analytic 4/(1+4/group) ≈ 3.94x at group 256; gate ≥ 3.5x)."""
+    import subprocess
+
+    import jax
+
+    ns = [int(s) for s in
+          os.environ.get("BENCH_SCALING_NS", "1,2,4,8").split(",")]
+    on_cpu = jax.default_backend() == "cpu"
+    if not on_cpu:
+        # real chips: can't force a device count — run the arms that fit
+        ns = [n for n in ns if n <= jax.device_count()]
+    nmax = max(ns)
+
+    def arm(n, wire):
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith("BENCH_")}
+        if on_cpu:
+            env["XLA_FLAGS"] = _with_exact_device_count(
+                env.get("XLA_FLAGS", "").replace("\n", " "), n)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+        env.update({"BENCH_SCALING_ARM_CHILD": "1",
+                    "BENCH_SCALING_N": str(n),
+                    "BENCH_SCALING_WIRE": wire,
+                    "BENCH_SCALING_STEPS":
+                        os.environ.get("BENCH_SCALING_STEPS", "3"),
+                    "BENCH_SCALING_SEQ":
+                        os.environ.get("BENCH_SCALING_SEQ", "256"),
+                    "BENCH_SCALING_MBS":
+                        os.environ.get("BENCH_SCALING_MBS", "2")})
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, capture_output=True, text=True)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                cand = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(cand, dict) and "metric" in cand:
+                return cand
+        sys.stderr.write(f"scaling arm dp{n}/{wire} failed:\n"
+                         + proc.stderr[-2000:])
+        return None
+
+    arms = {}
+    for n in ns:
+        r = arm(n, "fp")
+        arms[f"dp{n}_fp"] = (r["extra"] | {"tokens_per_sec_chip": r["value"]}
+                             ) if r else {"failed": True}
+    q = arm(nmax, "int8")
+    arms[f"dp{nmax}_int8"] = (q["extra"]
+                              | {"tokens_per_sec_chip": q["value"]}
+                              ) if q else {"failed": True}
+
+    fp1 = arms.get("dp1_fp", {})
+    fpm = arms.get(f"dp{nmax}_fp", {})
+    qm = arms[f"dp{nmax}_int8"]
+    eff = (fpm.get("tokens_per_sec_chip", 0.0)
+           / fp1["tokens_per_sec_chip"]
+           if fp1.get("tokens_per_sec_chip") else 0.0)
+    fp_wire = fpm.get("grad_reduce_bytes_per_step", 0)
+    q_wire = qm.get("grad_reduce_bytes_per_step", 0)
+    ratio = round(fp_wire / q_wire, 4) if q_wire else 0.0
+    result = {
+        "metric": f"scaling_weak_dp{nmax}_tokens_per_sec_per_chip",
+        "value": fpm.get("tokens_per_sec_chip", 0.0),
+        "unit": "tokens/s/chip",
+        # vs linear weak scaling: per-chip throughput retained dp1 → dpN
+        "vs_baseline": round(eff, 4),
+        "extra": {
+            "arms": arms,
+            "weak_scaling_efficiency": round(eff, 4),
+            "wire_ratio_fp_over_int8": ratio,
+            "wire_ratio_gate": 3.5,
+            "wire_ratio_ok": bool(ratio >= 3.5),
+        },
+    }
+    print(json.dumps(result))
+    return result
+
+
 REF_BERT_SAMPLES = {128: 272.0, 512: 52.0}   # V100 samples/s/GPU, fastest-BERT post
 V100_FP16_PEAK = 125.0                        # TFLOPs
 
@@ -1399,6 +1562,12 @@ def main():
         return
     if env("BENCH_OFFLOAD_CHILD") == "1":  # offload (Infinity tier) child
         run_offload_lane()
+        return
+    if env("BENCH_SCALING_ARM_CHILD") == "1":  # one weak-scaling arm
+        run_scaling_arm()
+        return
+    if env("BENCH_SCALING_CHILD") == "1":  # scaling-efficiency sub-lane
+        run_scaling_lane()
         return
     model_name = env("BENCH_MODEL", "gpt2-760m")
     import jax.numpy as jnp
@@ -1657,6 +1826,18 @@ def main():
         if offload is not None:
             print(json.dumps(offload))
 
+    # scaling-efficiency lane (BENCH_SCALING knob): weak scaling dp 1→8
+    # through the explicit compressed-collective grad wire — tokens/s/chip
+    # per arm, facade per-op comm bytes/step, fp→int8 wire ratio (≥3.5x)
+    scaling = None
+    if env("BENCH_SCALING", "1") == "1" and "BENCH_MODEL" not in os.environ:
+        scaling = sub_lane(
+            "scaling", BENCH_SCALING_CHILD="1",
+            BENCH_SCALING_NS=env("BENCH_SCALING_NS", "1,2,4,8"),
+            BENCH_SCALING_STEPS=env("BENCH_SCALING_STEPS", "3"))
+        if scaling is not None:
+            print(json.dumps(scaling))
+
     # BERT lane (reference's second headline; VERDICT r4 item 5): raw
     # samples/s + MFU on both conventions, both reference shapes
     bert = None
@@ -1752,6 +1933,16 @@ def main():
                 robust["extra"]["with_watchdog"]["counters"]
                 .get("watchdog_quarantines", 0),
             "degradation_sheds": robust["extra"]["degradation"]["sheds"],
+        }
+    if scaling is not None:
+        headline["extra"]["scaling"] = {
+            "metric": scaling["metric"], "value": scaling["value"],
+            "vs_baseline": scaling["vs_baseline"],
+            "weak_scaling_efficiency":
+                scaling["extra"]["weak_scaling_efficiency"],
+            "wire_ratio_fp_over_int8":
+                scaling["extra"]["wire_ratio_fp_over_int8"],
+            "wire_ratio_ok": scaling["extra"]["wire_ratio_ok"],
         }
     if bert is not None:
         headline["extra"]["bert"] = bert["extra"]

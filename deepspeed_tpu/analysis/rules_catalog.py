@@ -49,12 +49,19 @@ _STATIC_DYNAMIC_NAMES = (
 
 def _dynamic_names() -> set:
     """Runtime-composed metric names (imports the package, lazily)."""
+    from deepspeed_tpu.comm import collectives as coll_mod
     from deepspeed_tpu.serving import ServingRouter
     from deepspeed_tpu.telemetry import memscope as memscope_mod
     dynamic = {f"router/{k}"
                for k in ServingRouter(replicas=[]).counters}
     dynamic |= set(_STATIC_DYNAMIC_NAMES)
     dynamic |= {f"mem/{k}" for k in memscope_mod.LEDGER_GAUGES}
+    # comm facade per-op stats (CommStats.bind_telemetry f-strings);
+    # the catalog documents the placeholder form once per suffix, like
+    # router/replica/<rid>/ttft_ms — accept both spellings
+    dynamic |= {f"comm/{op}_{suffix}"
+                for op in (*coll_mod.OP_NAMES, "<op>")
+                for suffix in ("bytes", "calls", "ms")}
     return dynamic
 
 

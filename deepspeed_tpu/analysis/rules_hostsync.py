@@ -57,6 +57,7 @@ class HostSyncRule(Rule):
         "deepspeed_tpu/runtime/infinity.py",
         "deepspeed_tpu/launcher/comm_bench.py",
         "deepspeed_tpu/comm/comm.py",
+        "deepspeed_tpu/comm/collectives.py",
     )
 
     def check_module(self, ctx):

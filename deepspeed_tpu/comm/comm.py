@@ -26,6 +26,7 @@ import numpy as np
 from deepspeed_tpu.utils.jax_compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.comm import collectives
 from deepspeed_tpu.comm import mesh as mesh_mod
 from deepspeed_tpu.utils.logging import logger
 
@@ -131,6 +132,9 @@ class CommsLogger:
 
     def append(self, op_name, size_bytes, seconds):
         self.records.setdefault(op_name, []).append((size_bytes, seconds))
+        # route the timing log into the facade stats (and, when a Telemetry
+        # object is bound there, into comm/<op>_bytes + comm/<op>_ms)
+        collectives.stats.record(op_name, size_bytes, seconds)
         if self.verbose:
             logger.info(f"comm op: {op_name} | bytes: {size_bytes} | time (ms): {seconds*1e3:.3f}")
 
@@ -163,7 +167,11 @@ def _nbytes(x):
 
 def _timed(op_name, fn, x, *args, **kwargs):
     if not comms_logger.enabled:
-        return fn(x, *args, **kwargs)
+        out = fn(x, *args, **kwargs)
+        # byte/count stats are always on (cheap); wall-time needs the fence
+        # below, which only runs when the comms logger is enabled
+        collectives.stats.record(op_name, _nbytes(x))
+        return out
     t0 = time.perf_counter()
     out = fn(x, *args, **kwargs)
     # dstpu: ignore[DT001]: comms-logger timing fence — only runs when logging is enabled, and a fence is what makes the timing honest
@@ -189,12 +197,17 @@ def _axis_tuple(axis):
 
 
 def _reduce_fn(op):
-    return {
+    table = {
         ReduceOp.SUM: jax.lax.psum,
         ReduceOp.AVG: jax.lax.pmean,
         ReduceOp.MAX: jax.lax.pmax,
         ReduceOp.MIN: jax.lax.pmin,
-    }[op]
+    }
+    if op not in table:
+        raise ValueError(
+            f"unsupported reduce op {op}; supported: "
+            f"{sorted(o.name for o in table)}")
+    return table[op]
 
 
 @functools.lru_cache(maxsize=256)
@@ -252,7 +265,9 @@ def _make_reduce_scatter(mesh, axes):
 
 def reduce_scatter(tensor, op=ReduceOp.SUM, axis=None, group=None):
     """Reduce across `axis` then scatter leading dim: global → sharded."""
-    assert op in (ReduceOp.SUM, ReduceOp.AVG), "reduce_scatter supports SUM/AVG"
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError(
+            f"reduce_scatter supports ops ('SUM', 'AVG'); got {op}")
     axes = _axis_tuple(axis if axis is not None else group)
     mesh = mesh_mod.get_mesh()
     n = mesh_mod.axis_size(axes)
@@ -298,27 +313,31 @@ def broadcast(tensor, src=0, axis=None, group=None):
 
 
 # ------------------------------------------------------------------
-# In-jit aliases (use these inside shard_map'ped code)
+# In-jit aliases (use these inside shard_map'ped code) — instrumented
+# through the collective registry so byte stats accrue under every consumer
 # ------------------------------------------------------------------
 
-psum = jax.lax.psum
-pmean = jax.lax.pmean
+psum = collectives.psum
+pmean = collectives.pmean
 pmax = jax.lax.pmax
 pmin = jax.lax.pmin
-ppermute = jax.lax.ppermute
+ppermute = collectives.ppermute
 axis_index = jax.lax.axis_index
 
 
 def all_gather_lax(x, axis_name, axis=0, tiled=True):
-    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    return collectives.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter_lax(x, axis_name, scatter_dimension=0, tiled=True):
-    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled)
+    return collectives.reduce_scatter(x, axis_name,
+                                      scatter_dimension=scatter_dimension,
+                                      tiled=tiled)
 
 
 def all_to_all_lax(x, axis_name, split_axis, concat_axis, tiled=True):
-    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
+    return collectives.all_to_all(x, axis_name, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=tiled)
 
 
 # ------------------------------------------------------------------
@@ -381,14 +400,23 @@ def all_to_all_single(output=None, input=None, output_split_sizes=None,
     splits = [int(s) for s in input_split_sizes]
     axes = _axis_tuple(axis if axis is not None else group)
     W = mesh_mod.axis_size(axes)
-    assert len(splits) == W, (len(splits), W)
-    if output_split_sizes is not None:
-        assert list(map(int, output_split_sizes)) == splits, \
-            "global-view uneven all_to_all_single needs symmetric splits " \
-            "(every rank shares one split list)"
+    if len(splits) != W:
+        raise ValueError(
+            f"all_to_all_single: {len(splits)} input splits for axis size {W} "
+            "— need exactly one split per rank")
+    if output_split_sizes is not None and \
+            list(map(int, output_split_sizes)) != splits:
+        raise ValueError(
+            "all_to_all_single: global-view uneven exchange needs symmetric "
+            f"splits (every rank shares one split list); got input "
+            f"{splits} vs output {list(map(int, output_split_sizes))}")
     S = sum(splits)
     rest = tensor.shape[1:]
-    assert tensor.shape[0] == W * S, (tensor.shape, W, S)
+    if tensor.shape[0] != W * S:
+        raise ValueError(
+            f"all_to_all_single: leading dim {tensor.shape[0]} != axis size "
+            f"{W} * sum(splits) {S} — the global view is the concatenation "
+            "of one send block per rank")
     m = max(splits)
     if m * W == S:   # actually even
         return all_to_all(tensor, axis=axis, group=group, split_axis=0,
@@ -507,10 +535,15 @@ def get_global_rank(group=None, group_rank=0, coords=None):
     names = list(mesh.axis_names)
     shape = [mesh.shape[n] for n in names]
     gaxes = [n for n in names if n in _axis_tuple(group)]
-    assert gaxes, f"unknown group axes {group} for mesh axes {names}"
+    if not gaxes:
+        raise ValueError(
+            f"get_global_rank: unknown group axes {group}; mesh axes: {names}")
     gshape = [mesh.shape[n] for n in gaxes]
     total = int(np.prod(gshape))
-    assert 0 <= group_rank < total, (group_rank, total)
+    if not 0 <= group_rank < total:
+        raise ValueError(
+            f"get_global_rank: group_rank {group_rank} out of range for "
+            f"group {gaxes} of size {total}")
     gcoords = dict(zip(gaxes, np.unravel_index(group_rank, gshape)))
     fixed = dict(coords or {})
     full = [int(gcoords.get(n, fixed.get(n, 0))) for n in names]
@@ -546,7 +579,7 @@ def p2p_shift(x, axis_name, shift=1):
     n = mesh_mod.axis_size((axis_name,)) if isinstance(axis_name, str) \
         else mesh_mod.axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
-    return jax.lax.ppermute(x, axis_name, perm)
+    return collectives.ppermute(x, axis_name, perm)
 
 
 def _no_eager_p2p(name):
@@ -588,3 +621,17 @@ def destroy_process_group(group=None):
         except Exception as e:  # already down / never brought up
             logger.warning(f"jax.distributed.shutdown: {e}")
     _INITIALIZED = False
+
+
+# ------------------------------------------------------------------
+# Register the eager facade under the op registry: collectives.run("x", ...)
+# dispatches here; the in-jit forms stay the instrumented lax wrappers.
+# ------------------------------------------------------------------
+
+for _name, _eager in (("all_reduce", all_reduce),
+                      ("all_gather", all_gather),
+                      ("reduce_scatter", reduce_scatter),
+                      ("all_to_all", all_to_all)):
+    collectives.register_op(_name, lax=collectives.get_op(_name).lax,
+                            eager=_eager)
+del _name, _eager

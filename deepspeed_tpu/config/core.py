@@ -145,6 +145,18 @@ class ZeroConfig(ConfigModel):
     zero_hpz_partition_size: int = 1            # ZeRO++ hpZ: secondary shard group size
     zero_quantized_weights: bool = False        # ZeRO++ qwZ: int8 weight all-gather
     zero_quantized_gradients: bool = False      # ZeRO++ qgZ: int8 grad reduce
+    # explicit grad-reduce through the comm facade: one hierarchical
+    # reduce per step — plain psum over the fast (ICI) axes, then a
+    # transform-compressed 2-hop reduce over the declared slow axis
+    # (compressed_comm_axis, default: the outermost data-domain axis).
+    # With zero_quantized_gradients the slow hop runs the int8 qgZ wire.
+    explicit_grad_reduce: bool = False
+    # 1-bit Adam wire: error-feedback sign+scale compression on the slow-axis
+    # grad reduce (pairs with the OneBit* optimizers, whose in-optimizer
+    # compression is simulated — this knob shrinks the actual wire). Implies
+    # explicit_grad_reduce.
+    onebit_gradients: bool = False
+    compressed_comm_axis: Optional[str] = None  # slow-tier mesh axis for the wire
     mics_shard_size: int = -1                   # MiCS: shard group size (<=0 disabled)
     mics_hierarchical_params_gather: bool = False
     ignore_unused_parameters: bool = True

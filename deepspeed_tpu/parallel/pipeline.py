@@ -33,6 +33,7 @@ import numpy as np
 from deepspeed_tpu.utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.comm import collectives as coll
 from deepspeed_tpu.comm import mesh as mesh_mod
 from deepspeed_tpu.comm.mesh import (BATCH_AXES, DATA_AXIS, PIPE_AXIS, SEQ_AXIS,
                                      TENSOR_AXIS, ZERO_INNER_AXIS)
@@ -101,6 +102,34 @@ def partition_layers(n_layers, n_stages, method="uniform", costs=None, names=Non
         out.append((start, start + n))
         start += n
     return out
+
+
+def bubble_fraction(num_stages, num_microbatches, schedule="1f1b"):
+    """Idle-tick fraction of the pipeline schedule.
+
+    Both loops here run `n_ticks` scan iterations while only M of them do
+    useful work per stage, so the bubble is (n_ticks - M) / n_ticks:
+
+      gpipe (fill-drain forward): n_ticks = M + PP - 1  → (PP-1)/(M+PP-1)
+      1f1b  (TrainSchedule):      n_ticks = M + 2PP - 1 → (2PP-1)/(M+2PP-1)
+
+    (The 1F1B loop interleaves one forward AND one backward micro-step per
+    tick, so its tick count — and bubble — spans the combined fwd+bwd
+    schedule; the classic (PP-1)/M figure is this same quantity for the
+    fwd-only fill-drain loop at large M.)
+    """
+    PP, M = int(num_stages), int(num_microbatches)
+    if PP < 1 or M < 1:
+        raise ValueError(f"num_stages={PP} and num_microbatches={M} must be >= 1")
+    schedule = schedule.lower()
+    if schedule == "1f1b":
+        n_ticks = M + 2 * PP - 1
+    elif schedule == "gpipe":
+        n_ticks = M + PP - 1
+    else:
+        raise ValueError(f"unknown pipeline schedule {schedule!r}; "
+                         "expected '1f1b' or 'gpipe'")
+    return float(n_ticks - M) / float(n_ticks)
 
 
 # ----------------------------------------------------------------------
@@ -289,6 +318,84 @@ def tp_block_specs(cfg, blocks_split):
     return specs
 
 
+def make_ulysses_block_fn(cfg, sp):
+    """Transformer block with DeepSpeed-Ulysses sequence parallelism INSIDE the
+    pipeline stage: activations arrive sequence-sharded [B, T/sp, D]; q/k/v are
+    computed locally, the Ulysses all-to-all sandwich (reference
+    `sequence/layer.py:15` `_SeqAllToAll`) trades the sequence shard for a head
+    shard, attention runs over the FULL sequence with H/sp local heads, and the
+    output trades back. RoPE is applied BEFORE the all-to-all using global
+    positions (axis_index(sequence) * T_local offset), so rotary phases match
+    the unsharded model exactly.
+
+    Composes pipe × data × sequence: the `pipe` axis is handled by the outer
+    schedule, `sequence` by this block. Mutually exclusive with in-stage TP
+    (asserted by the caller): both re-shard heads and would fight over them."""
+    from deepspeed_tpu.models.gpt import _attention, _norm, _rope, _act
+    from deepspeed_tpu.parallel.ulysses import seq_all_to_all
+
+    assert not cfg.use_alibi, "alibi slopes need global head indices under Ulysses"
+    assert cfg.attn_layer_types is None and not cfg.sliding_window, \
+        "per-layer local attention is not wired for the Ulysses pipeline block yet"
+    H, Hkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    lcfg = dataclasses.replace(cfg, use_flash_attention=False)
+
+    def block_fn(p, x, rng):
+        B, Tl, D = x.shape
+        t0 = jax.lax.axis_index(SEQ_AXIS) * Tl
+        positions = jnp.broadcast_to(
+            t0 + jnp.arange(Tl, dtype=jnp.int32)[None], (B, Tl))
+
+        h = _norm(x, p["ln1_scale"], p.get("ln1_bias"), cfg.use_rmsnorm,
+                  cfg.norm_eps)
+        qkv = h @ p["attn_qkv_w"] + p["attn_qkv_b"]
+        q, k, v = jnp.split(qkv, [H * hd, (H + Hkv) * hd], axis=-1)
+        q = q.reshape(B, Tl, H, hd)
+        k = k.reshape(B, Tl, Hkv, hd)
+        v = v.reshape(B, Tl, Hkv, hd)
+        if cfg.use_rotary:
+            rd = int(cfg.rotary_pct * hd) // 2 * 2
+            q = _rope(q, positions, rd, cfg.rope_theta)
+            k = _rope(k, positions, rd, cfg.rope_theta)
+        # sequence→head re-shard: [B, T/sp, H, hd] → [B, T, H/sp, hd]
+        q = seq_all_to_all(q, scatter_axis=2, gather_axis=1)
+        k = seq_all_to_all(k, scatter_axis=2, gather_axis=1)
+        v = seq_all_to_all(v, scatter_axis=2, gather_axis=1)
+        T = Tl * sp
+        causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
+        attn = _attention(q, k, v, causal, lcfg)      # full seq, local heads
+        # head→sequence re-shard back: [B, T, H/sp, hd] → [B, T/sp, H, hd]
+        attn = seq_all_to_all(attn, scatter_axis=1, gather_axis=2)
+        attn_o = attn.reshape(B, Tl, H * hd) @ p["attn_out_w"] + p["attn_out_b"]
+
+        use_rms = cfg.use_rmsnorm
+        if cfg.parallel_residual:
+            h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
+        else:
+            x = x + attn_o
+            h2 = _norm(x, p["ln2_scale"], p.get("ln2_bias"), use_rms, cfg.norm_eps)
+        if cfg.use_swiglu:
+            up = jax.nn.silu(h2 @ p["mlp_gate_w"]) * (h2 @ p["mlp_up_w"])
+        else:
+            up = _act(h2 @ p["mlp_up_w"] + p["mlp_up_b"], cfg)
+        down = up @ p["mlp_down_w"] + p["mlp_out_b"]
+        if cfg.parallel_residual:
+            return x + attn_o + down
+        return x + down
+
+    return block_fn
+
+
+def _batch_specs(batch, seq_sharded=False):
+    """shard_map in_specs for the batch: leading dim over the data domain,
+    and — for sequence-parallel pipelines — dim 1 (time) over `sequence`."""
+    def leaf(a):
+        if seq_sharded and a.ndim >= 2:
+            return P(BATCH_AXES, SEQ_AXIS)
+        return P(BATCH_AXES)
+    return jax.tree_util.tree_map(leaf, batch)
+
+
 def _mb_view(batch, i, M):
     """Microbatch i of a microbatch-major local batch."""
     def slice_leaf(a):
@@ -314,7 +421,7 @@ def _make_stage_apply(block_fn, blocks):
 
 def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
                      num_microbatches, remat_blocks=True, block_tp_specs=None,
-                     remat_prevent_cse=False):
+                     remat_prevent_cse=False, seq_sharded=False):
     """Builds loss_fn(params, batch, rng) running the pipelined schedule.
 
     params = {"embed": <replicated>, "blocks": <stacked [PP*Lp, ...] leaves,
@@ -356,8 +463,10 @@ def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
             active = (mb_idx >= 0) & (mb_idx < M)
             # Stage 0 reads its microbatch; others read the handed-off
             # activation. Embed and head run under lax.cond so only the owning
-            # stage pays their flops — safe because both branches are
-            # collective-free (ppermute/psum stay at tick top level).
+            # stage pays their flops — safe because any collective inside a
+            # branch (the sequence-parallel loss psum) runs over an axis whose
+            # ranks all share the branch predicate; pipe ppermute/psum stay at
+            # tick top level.
             mb_i = jnp.clip(t, 0, M - 1)
             x_in = jax.lax.cond(
                 p_idx == 0,
@@ -376,24 +485,25 @@ def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
                 lambda: jnp.asarray(0.0, jnp.float32))
             loss_sum = loss_sum + mb_loss
             n_done = n_done + jnp.where(take, 1, 0)
-            buf = jax.lax.ppermute(y, PIPE_AXIS, perm_fwd)
+            buf = coll.ppermute(y, PIPE_AXIS, perm_fwd, repeats=n_ticks)
             return (buf, loss_sum, n_done), None
 
         (buf, loss_sum, n_done), _ = jax.lax.scan(
             tick, (zeros_act, jnp.asarray(0.0, jnp.float32), jnp.asarray(0, jnp.int32)),
             jnp.arange(n_ticks))
         # broadcast the mean loss to every pipe rank (reference _aggregate_total_loss)
-        total = jax.lax.psum(loss_sum, PIPE_AXIS)
-        count = jax.lax.psum(n_done, PIPE_AXIS)
+        total = coll.psum(loss_sum, PIPE_AXIS)
+        count = coll.psum(n_done, PIPE_AXIS)
         loss = total / jnp.maximum(count, 1)
         # mean over the data domain so grads of pipe-replicated leaves come out as
         # global-batch means
-        return jax.lax.pmean(loss, (DATA_AXIS, ZERO_INNER_AXIS, SEQ_AXIS))
+        return coll.pmean(loss, (DATA_AXIS, ZERO_INNER_AXIS, SEQ_AXIS))
 
     def loss_fn(params, batch, rng):
         mesh = mesh_mod.get_mesh()
-        # batch stays data-sharded on its leading dim (composes PP × DP)
-        batch_spec = jax.tree_util.tree_map(lambda _: P(BATCH_AXES), batch)
+        # batch stays data-sharded on its leading dim (composes PP × DP);
+        # sequence-parallel models also shard the time dim over `sequence`
+        batch_spec = _batch_specs(batch, seq_sharded)
         with mesh_mod.constraints_disabled():
             fn = shard_map(local, mesh=mesh,
                            in_specs=(_pipe_inner_specs(params, block_tp_specs),
@@ -406,7 +516,8 @@ def pipeline_loss_fn(embed_fn, block_fn, head_loss_fn, num_stages,
 
 def pipeline_grad_fn(embed_fn, block_fn, head_loss_fn, num_stages,
                      num_microbatches, remat_blocks=True, block_tp_specs=None,
-                     remat_prevent_cse=False):
+                     remat_prevent_cse=False, seq_sharded=False,
+                     grad_reduce_transform="none"):
     """1F1B-structured pipelined (loss, grads) — reference `TrainSchedule`
     (`runtime/pipe/schedule.py:189`).
 
@@ -434,6 +545,11 @@ def pipeline_grad_fn(embed_fn, block_fn, head_loss_fn, num_stages,
     PP = num_stages
     M = num_microbatches
     R = 2 * PP  # ring slots; a stash entry lives 2*(PP-s)-1 < R ticks
+    if grad_reduce_transform not in ("none", "int8"):
+        raise ValueError(
+            f"pipeline grad_reduce_transform must be one of ('none', 'int8'); "
+            f"got {grad_reduce_transform!r} ('onebit' needs the persistent "
+            f"error-feedback state the engine's onebit_gradients path carries)")
     if remat_blocks:
         # default False: block_fn runs inside the schedule scan, the
         # safe+faster placement (see GPTConfig.remat_prevent_cse)
@@ -544,8 +660,8 @@ def pipeline_grad_fn(embed_fn, block_fn, head_loss_fn, num_stages,
                    "head": ghe["head"]}
             loss_sum = loss_sum + loss_i
 
-            fwd_buf = jax.lax.ppermute(y, PIPE_AXIS, perm_fwd)
-            bwd_buf = jax.lax.ppermute(dx, PIPE_AXIS, perm_bwd)
+            fwd_buf = coll.ppermute(y, PIPE_AXIS, perm_fwd, repeats=n_ticks)
+            bwd_buf = coll.ppermute(dx, PIPE_AXIS, perm_bwd, repeats=n_ticks)
             return (fwd_buf, bwd_buf, xstash, gblocks, ghe, loss_sum), None
 
         (carry_out, _) = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
@@ -554,12 +670,35 @@ def pipeline_grad_fn(embed_fn, block_fn, head_loss_fn, num_stages,
         data_axes = (DATA_AXIS, ZERO_INNER_AXIS, SEQ_AXIS)
         inv_m = 1.0 / M
 
+        def data_mean(g):
+            # mean over the data domain. With a wire transform, the reduce is
+            # hierarchical: plain psum rides the fast inner axes, the
+            # compressed 2-hop wire rides the outermost (slow / DCN-tier)
+            # data axis — the engine's explicit grad-reduce split
+            # (zero.ZeroShardingPolicy.reduce_domain) applied to the
+            # pipeline's post-schedule grad finish.
+            if grad_reduce_transform == "none":
+                return coll.pmean(g, data_axes)
+            n_total, active = 1, []
+            for a in data_axes:
+                s = int(jax.lax.psum(1, a))
+                n_total *= s
+                if s > 1:
+                    active.append(a)
+            if not active:
+                return g
+            slow, fast = active[0], tuple(active[1:])
+            if fast:
+                g = coll.psum(g, fast)
+            g = coll.compressed_all_reduce(g, slow, grad_reduce_transform)
+            return g / n_total
+
         def finish_rep(g, p):  # replicated leaves: tied psum over pipe
-            g = jax.lax.psum(g * inv_m, PIPE_AXIS)
-            return jax.lax.pmean(g, data_axes).astype(p.dtype)
+            g = coll.psum(g * inv_m, PIPE_AXIS)
+            return data_mean(g).astype(p.dtype)
 
         def finish_shard(g, p):  # pipe-sharded leaves stay per-stage
-            return jax.lax.pmean(g * inv_m, data_axes).astype(p.dtype)
+            return data_mean(g * inv_m).astype(p.dtype)
 
         grads = {
             "embed": jax.tree_util.tree_map(finish_rep, ghe["embed"],
@@ -568,13 +707,13 @@ def pipeline_grad_fn(embed_fn, block_fn, head_loss_fn, num_stages,
             "head": jax.tree_util.tree_map(finish_rep, ghe["head"],
                                            params["head"]),
         }
-        loss = jax.lax.psum(loss_sum, PIPE_AXIS) * inv_m
-        loss = jax.lax.pmean(loss, data_axes)
+        loss = coll.psum(loss_sum, PIPE_AXIS) * inv_m
+        loss = coll.pmean(loss, data_axes)
         return loss, grads
 
     def grad_fn(params, batch, rng):
         mesh = mesh_mod.get_mesh()
-        batch_spec = jax.tree_util.tree_map(lambda _: P(BATCH_AXES), batch)
+        batch_spec = _batch_specs(batch, seq_sharded)
         specs = _pipe_inner_specs(params, block_tp_specs)
         with mesh_mod.constraints_disabled():
             fn = shard_map(local, mesh=mesh,
@@ -587,7 +726,8 @@ def pipeline_grad_fn(embed_fn, block_fn, head_loss_fn, num_stages,
 
 
 def pipeline_forward_fn(embed_fn, block_fn, head_fn, num_stages,
-                        num_microbatches, block_tp_specs=None):
+                        num_microbatches, block_tp_specs=None,
+                        seq_sharded=False):
     """Pipelined forward-only schedule (reference `InferenceSchedule`,
     `runtime/pipe/schedule.py:135`): microbatches stream through the stages,
     the last stage applies `head_fn(params, act, micro_batch, rng) -> out
@@ -637,13 +777,13 @@ def pipeline_forward_fn(embed_fn, block_fn, head_fn, num_stages,
             cur = jax.lax.dynamic_slice_in_dim(out_buf, start, out.shape[0], axis=0)
             out_buf = jax.lax.dynamic_update_slice_in_dim(out_buf, cur + out,
                                                           start, axis=0)
-            buf = jax.lax.ppermute(y, PIPE_AXIS, perm_fwd)
+            buf = coll.ppermute(y, PIPE_AXIS, perm_fwd, repeats=n_ticks)
             return (buf, out_buf), None
 
         (buf, out_buf), _ = jax.lax.scan(tick, (zeros_act, out_buf0),
                                          jnp.arange(n_ticks))
         # only the last stage wrote non-zeros; broadcast to all pipe ranks
-        return jax.lax.psum(out_buf, PIPE_AXIS)
+        return coll.psum(out_buf, PIPE_AXIS)
 
     def forward(params, batch, rng=None):
         mesh = mesh_mod.get_mesh()
@@ -652,12 +792,13 @@ def pipeline_forward_fn(embed_fn, block_fn, head_fn, num_stages,
         assert lead % (shards * M) == 0, (
             f"pipelined forward: batch dim {lead} must divide into "
             f"{shards} data shard(s) x {M} microbatches")
-        batch_spec = jax.tree_util.tree_map(lambda _: P(BATCH_AXES), batch)
+        batch_spec = _batch_specs(batch, seq_sharded)
+        out_spec = P(BATCH_AXES, SEQ_AXIS) if seq_sharded else P(BATCH_AXES)
         with mesh_mod.constraints_disabled():
             fn = shard_map(local, mesh=mesh,
                            in_specs=(_pipe_inner_specs(params, block_tp_specs),
                                      batch_spec, P()),
-                           out_specs=P(BATCH_AXES), check_vma=False)
+                           out_specs=out_spec, check_vma=False)
             return fn(params, batch, rng)
 
     return forward
@@ -680,7 +821,8 @@ def pipeline_param_specs(params, block_tp_specs=None):
 
 def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
                             num_microbatches=4, seed=0, schedule="1f1b",
-                            tensor_parallel=None):
+                            tensor_parallel=None, sequence_parallel=None,
+                            grad_reduce_transform="none"):
     """Pipeline-parallel GPT ModelSpec: blocks stacked [PP*Lp, ...] on `pipe`.
 
     schedule: "1f1b" (default — reference TrainSchedule memory bound) trains
@@ -693,7 +835,19 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
     axis size. With tp > 1, block weights use the split-qkv TP layout and the
     stage body runs `make_tp_block_fn` (explicit psum collectives); embed and
     head stay tensor-replicated (their flops run once per tp rank — vocab
-    parallelism is a future optimization)."""
+    parallelism is a future optimization).
+
+    sequence_parallel: Ulysses degree INSIDE each stage (pipe × data ×
+    sequence composition). Default: the current mesh's `sequence` axis size.
+    With sp > 1, the batch arrives time-sharded, the stage body runs
+    `make_ulysses_block_fn` (all-to-all head↔sequence re-sharding), and the
+    batch MUST carry explicit "labels" (the next-token shift crosses shard
+    boundaries). Mutually exclusive with tensor_parallel > 1.
+
+    grad_reduce_transform: "none" | "int8" — wire encoding for the
+    data-domain grad reduce in the 1F1B finish (qgZ over the outermost data
+    axis; the engine's `explicit_grad_reduce` equivalent for models that
+    bring their own grad_fn)."""
     from deepspeed_tpu.models.gpt import (GPTConfig, GPT2_CONFIGS, init_gpt_params,
                                           _block, _norm)
     from deepspeed_tpu.runtime.engine import ModelSpec
@@ -705,6 +859,16 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
         tensor_parallel = (mesh_mod.axis_size(TENSOR_AXIS)
                            if mesh_mod.has_mesh() else 1)
     tp = int(tensor_parallel)
+    if sequence_parallel is None:
+        sequence_parallel = (mesh_mod.axis_size(SEQ_AXIS)
+                             if mesh_mod.has_mesh() else 1)
+    sp = int(sequence_parallel)
+    if tp > 1 and sp > 1:
+        raise ValueError(
+            f"in-stage tensor_parallel={tp} and sequence_parallel={sp} are "
+            "mutually exclusive: both re-shard attention heads. Put the "
+            "degrees on one axis, or compose Ulysses with ring attention "
+            "(parallel/ring.py) outside the pipeline instead")
     raw = init_gpt_params(cfg, seed=seed)
 
     blocks = raw["blocks"]
@@ -714,6 +878,9 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
             f"n_head {cfg.n_head}/n_kv_head {cfg.n_kv_head} must divide tp={tp}"
         blocks = split_block_params(cfg, blocks)
         block_tp_specs = tp_block_specs(cfg, blocks)
+    if sp > 1:
+        assert cfg.n_head % sp == 0 and cfg.n_kv_head % sp == 0, \
+            f"n_head {cfg.n_head}/n_kv_head {cfg.n_kv_head} must divide sp={sp}"
 
     params = {
         "embed": {"wte": raw["wte"], **({"wpe": raw["wpe"]} if not cfg.use_rotary else {})},
@@ -728,7 +895,10 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
         T = tokens.shape[1]
         x = jnp.take(ep["wte"], tokens, axis=0).astype(cfg.dtype)
         if not cfg.use_rotary:
-            pos = jnp.arange(T, dtype=jnp.int32)[None]
+            # sequence-parallel: tokens are the LOCAL time chunk — absolute
+            # positions start at this rank's global offset
+            t0 = jax.lax.axis_index(SEQ_AXIS) * T if sp > 1 else 0
+            pos = t0 + jnp.arange(T, dtype=jnp.int32)[None]
             x = x + jnp.take(ep["wpe"], pos, axis=0).astype(cfg.dtype)
         return x
 
@@ -742,11 +912,19 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
         # gpt_loss contract: explicit "labels" → tokens are already the
         # (possibly curriculum-transformed) inputs; otherwise shift in-place.
         tokens = micro_batch.get("tokens", micro_batch.get("input_ids"))
+        if sp > 1 and micro_batch.get("labels") is None:
+            raise ValueError(
+                "sequence-parallel pipeline needs explicit 'labels': tokens "
+                "are sharded over the `sequence` axis, so the next-token "
+                "shift cannot be derived locally (each shard's boundary "
+                "label lives on the neighbor rank)")
         inputs = tokens if micro_batch.get("labels") is not None else tokens[:, :-1]
         return _embed_tokens(ep, inputs)
 
     if tp > 1:
         block_fn = make_tp_block_fn(cfg, tp)
+    elif sp > 1:
+        block_fn = make_ulysses_block_fn(cfg, sp)
     else:
         def block_fn(lp, x, rng):
             B, T, D = x.shape
@@ -763,14 +941,30 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
         safe = jnp.maximum(labels, 0)
         gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
         mask = (labels >= 0).astype(jnp.float32)
-        return jnp.sum((logz - gold) * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        num = jnp.sum((logz - gold) * mask)
+        den = jnp.sum(mask)
+        if sp > 1:
+            # token-weighted mean over the sequence shards (this rank holds
+            # only T/sp time steps). RAW lax.psum is load-bearing here: under
+            # check_vma=False its transpose is psum again, scaling every
+            # downstream cotangent by sp — which the finish pmean over
+            # data_axes (sequence included) divides back out, turning the
+            # per-shard grads into the SUM over sequence ranks that the true
+            # gradient requires. A custom-vjp identity-backward psum would
+            # undercount by exactly sp. The psum pair runs inside the
+            # last-stage lax.cond, which is safe: the predicate is uniform
+            # across the `sequence` axis (it depends only on the pipe index).
+            num = jax.lax.psum(num, SEQ_AXIS)
+            den = jax.lax.psum(den, SEQ_AXIS)
+        return num / jnp.maximum(den, 1.0)
 
     loss_fn = pipeline_loss_fn(embed_fn, block_fn, head_loss_fn,
                                num_stages=num_stages,
                                num_microbatches=num_microbatches,
                                remat_blocks=cfg.remat,
                                block_tp_specs=block_tp_specs,
-                               remat_prevent_cse=cfg.remat_prevent_cse)
+                               remat_prevent_cse=cfg.remat_prevent_cse,
+                               seq_sharded=sp > 1)
     # training backward: 1F1B schedule (O(PP) live activations); the
     # fill-drain loss_fn above stays as the cheaper eval/forward-only path
     schedule = schedule.lower()
@@ -782,8 +976,15 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
                                 num_microbatches=num_microbatches,
                                 remat_blocks=cfg.remat,
                                 block_tp_specs=block_tp_specs,
-                                remat_prevent_cse=cfg.remat_prevent_cse)
+                                remat_prevent_cse=cfg.remat_prevent_cse,
+                                seq_sharded=sp > 1,
+                                grad_reduce_transform=grad_reduce_transform)
                if schedule == "1f1b" else None)
+    if schedule == "gpipe" and grad_reduce_transform != "none":
+        raise ValueError(
+            "grad_reduce_transform requires the '1f1b' schedule (gpipe trains "
+            "by autodiff through the fill-drain loss — no explicit grad finish "
+            "to compress)")
 
     # pipelined inference forward (reference InferenceSchedule): full-sequence
     # logits, microbatches streamed through the stages
@@ -796,7 +997,8 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
     pipelined_fwd = pipeline_forward_fn(fwd_embed_fn, block_fn, fwd_head_fn,
                                         num_stages=num_stages,
                                         num_microbatches=num_microbatches,
-                                        block_tp_specs=block_tp_specs)
+                                        block_tp_specs=block_tp_specs,
+                                        seq_sharded=sp > 1)
 
     def apply_fn(params, tokens, rng=None):
         # uniform ModelSpec.apply_fn contract: raw [B, T] token array
@@ -804,7 +1006,18 @@ def make_gpt_pipeline_model(cfg=None, name="gpt2-pipe", num_stages=2,
         batch = tokens if isinstance(tokens, dict) else {"tokens": tokens}
         return pipelined_fwd(params, batch, rng)
 
+    pipeline_info = {
+        "num_stages": int(num_stages),
+        "num_microbatches": int(num_microbatches),
+        "schedule": schedule,
+        "tensor_parallel": tp,
+        "sequence_parallel": sp,
+        "grad_reduce_transform": grad_reduce_transform,
+        "bubble_fraction": bubble_fraction(num_stages, num_microbatches,
+                                           schedule),
+    }
     return ModelSpec(loss_fn=loss_fn, params=params, apply_fn=apply_fn,
                      grad_fn=grad_fn,
                      param_specs=pipeline_param_specs(params, block_tp_specs),
+                     pipeline_info=pipeline_info,
                      name=name)

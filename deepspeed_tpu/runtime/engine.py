@@ -75,6 +75,10 @@ class ModelSpec:
     arch_cfg: Any = None                  # architecture config (e.g. GPTConfig)
                                           # — lets the flops profiler build a
                                           # per-module tree for the zoo models
+    pipeline_info: Any = None             # pipeline schedule facts for
+                                          # telemetry: {num_stages,
+                                          # num_microbatches, schedule,
+                                          # bubble_fraction}
     name: str = "model"
 
 
@@ -85,6 +89,25 @@ class TrainState(NamedTuple):
     scaler: LossScaleState
     step: jnp.ndarray            # i32 global step counter
     rng: jnp.ndarray             # PRNG key
+
+
+def _gather_site(spec, axes):
+    """(dim, axes-to-gather-over) for the dim of a stage-3 shard whose spec
+    entry names a gather axis. Entries can be composite tuples like
+    ('data','zero','sequence') and other dims may carry size-1 'tensor'
+    entries BEFORE it — first-non-None picked the wrong dim for the zoo's
+    TP-annotated leaves. Gather over exactly the axes in the entry: under
+    hpZ, weight leaves are secondary-sharded over 'zero' only while
+    axes=('data','zero') — gathering over both would blow the leaf up
+    'data'-fold. Gathers in the SPEC ENTRY's axis order (the shard layout
+    order); deriving from `axes` would interleave shards wrongly if a
+    partitioner ever emitted ('zero','data')."""
+    for i, e in enumerate(spec):
+        names = e if isinstance(e, tuple) else (e,)
+        ax = tuple(a for a in names if a in axes)
+        if ax:
+            return i, ax
+    return None, ()
 
 
 def _normalize_init_fn(init_fn):
@@ -173,6 +196,21 @@ class Engine:
         self.zero_policy = ZeroShardingPolicy(config.zero_optimization, self.mesh)
         self.zero_stage = config.zero_optimization.stage
 
+        # ---- explicit compressed grad-reduce wire (comm facade transforms)
+        # "onebit" > "int8" > "none": onebit_gradients implies the explicit
+        # path; explicit_grad_reduce + zero_quantized_gradients runs the qgZ
+        # int8 wire through the facade; bare explicit_grad_reduce keeps an
+        # fp32 wire (useful as the measured baseline arm).
+        zcfg = config.zero_optimization
+        self._explicit_wire = None
+        if getattr(zcfg, "onebit_gradients", False):
+            self._explicit_wire = "onebit"
+        elif getattr(zcfg, "explicit_grad_reduce", False):
+            self._explicit_wire = "int8" if zcfg.zero_quantized_gradients \
+                else "none"
+        self._comm_err = None            # onebit error-feedback residuals
+        self._comm_err_shardings = None
+
         # ---- LR schedule + optimizer
         self.schedule_fn = None
         self.lr_scheduler = lr_scheduler
@@ -255,6 +293,38 @@ class Engine:
                  f"gas={self.gradient_accumulation_steps_value} | "
                  f"global_bs={self.train_batch_size_value}", ranks=[0])
 
+        # ---- onebit wire: error-feedback residuals, sharded over the slow
+        # axis (one residual copy per slow-tier rank — what compression lost
+        # last step feeds back next step; not checkpointed, a cold restart
+        # just re-pays one step of compression error)
+        if self._explicit_wire == "onebit" and \
+                getattr(model, "grad_fn", None) is None:
+            if self.offload_optimizer_states or self.nvme_offload:
+                raise ValueError(
+                    "onebit_gradients is incompatible with offload_optimizer: "
+                    "the split/host step cannot thread the error-feedback "
+                    "residuals through the fused program")
+            _, slow = self.zero_policy.reduce_domain(
+                getattr(zcfg, "compressed_comm_axis", None))
+            if slow is not None:
+                n_slow = self.spec.axis_sizes()[slow]
+                self._comm_err_shardings = jax.tree_util.tree_map(
+                    lambda p: NamedSharding(self.mesh, P(slow)),
+                    self.state.params)
+                self._comm_err = jax.tree_util.tree_map(
+                    lambda p, s: jax.device_put(
+                        np.zeros((n_slow,) + tuple(p.shape), np.float32), s),
+                    self.state.params, self._comm_err_shardings)
+                opt_type = (config.optimizer.type if config.optimizer
+                            else "").lower()
+                if opt_type.startswith(("onebit", "zeroone")):
+                    log_dist(
+                        f"onebit_gradients: error-feedback 1-bit wire active "
+                        f"over axis {slow!r}, paired with the "
+                        f"{config.optimizer.type} optimizer (its in-optimizer "
+                        "compression shapes momentum; this knob shrinks the "
+                        "actual grad wire)", ranks=[0])
+
         # ---- jitted programs
         if self.host_optimizer is not None:
             self._train_step = None
@@ -292,6 +362,15 @@ class Engine:
         self.telemetry = Telemetry(config.telemetry, subsystem="train",
                                    monitor=self.monitor)
         self._program_flops = None   # per-train_batch flops, measured once
+        # comm facade stats mirror into this registry: comm/<op>_bytes,
+        # comm/<op>_calls, comm/<op>_ms rows (see comm/collectives.py)
+        comm.collectives.stats.bind_telemetry(self.telemetry)
+        # pipeline bubble accounting (parallel/pipeline.py bubble_fraction):
+        # models built by make_gpt_pipeline_model attach their schedule here
+        pinfo = getattr(model, "pipeline_info", None)
+        if pinfo:
+            self.telemetry.set_gauge("train/pipe_bubble_frac",
+                                     float(pinfo.get("bubble_fraction", 0.0)))
 
         # HBM memory ledger + OOM forensics (telemetry/memscope.py):
         # params/master/optimizer byte attribution as mem/* gauges, a
@@ -760,24 +839,7 @@ class Engine:
         param_specs = jax.tree_util.tree_map(lambda s: s.spec, self.param_shardings)
 
         def gather_site(spec):
-            # (dim, axes-to-gather-over) for the dim whose spec entry names a
-            # gather axis. Entries can be composite tuples like
-            # ('data','zero','sequence') and other dims may carry size-1
-            # 'tensor' entries BEFORE it — first-non-None picked the wrong dim
-            # for the zoo's TP-annotated leaves. Gather over exactly the axes
-            # in the entry: under hpZ, weight leaves are secondary-sharded over
-            # 'zero' only while axes=('data','zero') — gathering over both
-            # would blow the leaf up 'data'-fold.
-            for i, e in enumerate(spec):
-                names = e if isinstance(e, tuple) else (e,)
-                # gather in the SPEC ENTRY's axis order (that's the shard
-                # layout order); all_gather over a tuple concatenates in the
-                # order given, so deriving from `axes` would interleave shards
-                # wrongly if a partitioner ever emitted ('zero','data')
-                ax = tuple(a for a in names if a in axes)
-                if ax:
-                    return i, ax
-            return None, ()
+            return _gather_site(spec, axes)
 
         def body(params, micro_batch, rng, scale_state):
             if self.zero_stage == 3:
@@ -819,6 +881,141 @@ class Engine:
 
         return qmicro
 
+    def _explicit_grads_fn(self, wire, fast, slow):
+        """Explicit compressed grad-reduce through the comm facade.
+
+        One `shard_map` spans the whole gas scan, so the step does ONE
+        hierarchical reduce instead of one per micro-batch: a plain psum
+        rides the fast (ICI) axes, then the declared slow axis runs the
+        2-hop transform wire (`comm/collectives.compressed_all_reduce`) —
+        fp32 (`wire="none"`, the measured baseline), int8 qgZ
+        (`wire="int8"`), or the 1-bit Adam error-feedback reduce
+        (`wire="onebit"`, which threads residuals through the step:
+        signature grows a trailing `err` argument and return value).
+
+        Stage-3 shards gather on entry (int8 under qwZ), same as the
+        per-micro quantized path; like it, this needs a data-domain-only
+        mesh.
+        """
+        from deepspeed_tpu.utils.jax_compat import shard_map
+        from deepspeed_tpu.comm import collectives as coll
+        from deepspeed_tpu.runtime import quantized_collectives as qc
+
+        zcfg = self.config.zero_optimization
+        qw = bool(zcfg.zero_quantized_weights) and self.zero_stage == 3
+        sizes = self.spec.axis_sizes()
+        for ax in (mesh_mod.TENSOR_AXIS, mesh_mod.SEQ_AXIS,
+                   mesh_mod.PIPE_AXIS, mesh_mod.EXPERT_AXIS):
+            if sizes[ax] != 1:
+                raise ValueError(
+                    "explicit_grad_reduce/onebit_gradients need a data-"
+                    f"domain-only mesh (axis {ax} has size {sizes[ax]}); "
+                    "pipeline models take the grad_reduce_transform knob "
+                    "instead")
+        axes = fast + (slow,)
+        n_total = 1
+        for a in axes:
+            n_total *= sizes[a]
+        onebit = wire == "onebit"
+        gas = self.gradient_accumulation_steps_value
+        micro_grad = self._micro_grad_fn()
+        group_size = 256
+        predivide = self.config.gradient_predivide_factor or 1.0
+        param_specs = jax.tree_util.tree_map(lambda s: s.spec,
+                                             self.param_shardings)
+
+        def body(params, batch, rng, scale_state, err):
+            if self.zero_stage == 3:
+                def gather(p, spec):
+                    d, ax = _gather_site(spec, axes)
+                    if d is None:
+                        return p
+                    if qw:
+                        return qc.quantized_all_gather_dim(p, ax, d,
+                                                           group_size)
+                    return coll.all_gather(p, ax, axis=d, tiled=True)
+                params = jax.tree_util.tree_map(gather, params, param_specs)
+            with mesh_mod.constraints_disabled():
+                if gas > 1:
+                    def scan_body(carry, mb):
+                        g_acc, loss_acc, i = carry
+                        g, l = micro_grad(params, mb,
+                                          jax.random.fold_in(rng, i),
+                                          scale_state)
+                        g_acc = jax.tree_util.tree_map(
+                            lambda a, b: a + (b.astype(jnp.float32)
+                                              / jnp.asarray(predivide,
+                                                            jnp.float32)),
+                            g_acc, g)
+                        return (g_acc, loss_acc + l.astype(jnp.float32),
+                                i + 1), None
+
+                    zeros = jax.tree_util.tree_map(
+                        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                    (grads, loss_sum, _), _ = jax.lax.scan(
+                        scan_body, (zeros, jnp.asarray(0.0, jnp.float32), 0),
+                        batch)
+                    grads = jax.tree_util.tree_map(
+                        lambda g: g * (predivide / gas), grads)
+                    loss = loss_sum / gas
+                else:
+                    grads, loss = micro_grad(params, batch, rng, scale_state)
+                    grads = jax.tree_util.tree_map(
+                        lambda g: g.astype(jnp.float32), grads)
+            # hierarchical reduce: fast axes in plain fp32, slow axis wired
+            if fast:
+                grads = jax.tree_util.tree_map(
+                    lambda g: coll.psum(g, fast), grads)
+            new_err = err
+            if onebit:
+                err_local = jax.tree_util.tree_map(lambda e: e[0], err)
+                flat_g, treedef = jax.tree_util.tree_flatten(grads)
+                flat_e = jax.tree_util.tree_leaves(err_local)
+                outs = [coll.compressed_all_reduce(g, slow, "onebit", err=e)
+                        for g, e in zip(flat_g, flat_e)]
+                grads = jax.tree_util.tree_unflatten(
+                    treedef, [o[0] for o in outs])
+                new_err = jax.tree_util.tree_unflatten(
+                    treedef, [o[1][None] for o in outs])
+            else:
+                # same 2-hop reduce-scatter + all-gather structure for the
+                # fp32 and int8 wires — the facade byte stats then compare
+                # the ENCODING alone (the bench lane's wire-ratio claim)
+                grads = jax.tree_util.tree_map(
+                    lambda g: coll.compressed_all_reduce(
+                        g, slow, wire, group_size=group_size), grads)
+            grads = jax.tree_util.tree_map(lambda g: g / n_total, grads)
+            loss = jax.lax.pmean(loss, axes)
+            return grads, loss, new_err
+
+        batch_leaf_spec = P(None, mesh_mod.BATCH_AXES) if gas > 1 \
+            else P(mesh_mod.BATCH_AXES)
+        grads_out_specs = jax.tree_util.tree_map(lambda _: P(), param_specs)
+
+        def grads_fn(params, batch, rng, scaler_state, err=None):
+            in_batch_specs = jax.tree_util.tree_map(
+                lambda _: batch_leaf_spec, batch)
+            scaler_specs = jax.tree_util.tree_map(lambda _: P(), scaler_state)
+            if onebit:
+                err_specs = jax.tree_util.tree_map(lambda _: P(slow), err)
+                return shard_map(
+                    body, mesh=self.mesh,
+                    in_specs=(param_specs, in_batch_specs, P(), scaler_specs,
+                              err_specs),
+                    out_specs=(grads_out_specs, P(), err_specs),
+                    check_vma=False,
+                )(params, batch, rng, scaler_state, err)
+            grads, loss = shard_map(
+                lambda p, b, r, s: body(p, b, r, s, None)[:2],
+                mesh=self.mesh,
+                in_specs=(param_specs, in_batch_specs, P(), scaler_specs),
+                out_specs=(grads_out_specs, P()),
+                check_vma=False,
+            )(params, batch, rng, scaler_state)
+            return grads, loss
+
+        return grads_fn
+
     def _grad_accum_dtype(self):
         """Gas accumulator dtype (reference data_types.grad_accum_dtype,
         `runtime/config.py:876`): fp32 default; bf16/fp16 opt-in."""
@@ -836,6 +1033,26 @@ class Engine:
         train step and the offload tier's split grads program."""
         gas = self.gradient_accumulation_steps_value
         zcfg = self.config.zero_optimization
+        wire = getattr(self, "_explicit_wire", None)
+        if wire is not None:
+            if getattr(self.model_spec, "grad_fn", None) is not None:
+                logger.warning(
+                    "explicit_grad_reduce/onebit_gradients ignored: model "
+                    "supplies a custom grad_fn (pipeline 1F1B) — use the "
+                    "pipeline's grad_reduce_transform knob instead")
+            elif wire == "onebit" and self._comm_err is None:
+                logger.warning(
+                    "onebit_gradients: single-device data domain — "
+                    "error-feedback wire disabled")
+            else:
+                fast, slow = self.zero_policy.reduce_domain(
+                    getattr(zcfg, "compressed_comm_axis", None))
+                if slow is None:
+                    logger.warning(
+                        "explicit_grad_reduce: single-device data domain — "
+                        "compressed wire disabled")
+                else:
+                    return self._explicit_grads_fn(wire, fast, slow)
         wants_quantized = zcfg.zero_quantized_gradients or (
             zcfg.zero_quantized_weights and self.zero_stage == 3)
         if wants_quantized and getattr(self.model_spec, "grad_fn", None) is None:
@@ -886,6 +1103,21 @@ class Engine:
     def _build_train_step(self):
         grads_fn = self._make_grads_fn()
         apply_grads = self._apply_grads_fn()
+
+        if self._comm_err is not None:
+            # onebit wire: the error-feedback residuals thread through the
+            # fused step as a third donated argument/output
+            def train_step_ef(state, batch, err):
+                rng = jax.random.fold_in(state.rng, state.step)
+                grads, loss, new_err = grads_fn(state.params, batch, rng,
+                                                state.scaler, err)
+                new_state, metrics = apply_grads(state, grads, loss)
+                return new_state, metrics, new_err
+
+            return jax.jit(train_step_ef,
+                           donate_argnums=(0, 2),
+                           out_shardings=(self.state_shardings, None,
+                                          self._comm_err_shardings))
 
         def train_step(state, batch):
             rng = jax.random.fold_in(state.rng, state.step)
@@ -1079,6 +1311,10 @@ class Engine:
         try:
             if self.host_optimizer is not None:
                 metrics = self._host_train_batch(batch)
+            elif self._comm_err is not None:
+                placed = self._maybe_split_gas(batch)
+                self.state, metrics, self._comm_err = self._run_stateful_step(
+                    self._train_step, placed, self._comm_err)
             else:
                 placed = self._maybe_split_gas(batch)
                 self.state, metrics = self._run_stateful_step(

@@ -14,38 +14,23 @@ bandwidth than bf16 for the payload); dequantization happens on the receiver and
 reduction is always in f32 (error stays bounded by one quantization step per
 hop, same as the reference's scheme).
 
+The quantization rule itself (groupwise symmetric int8, scale = max|x|/127)
+has ONE definition: `comm/collectives.py`'s `group_quant_int8` — the same
+semantics `ops/pallas/quant.py` implements on-chip — and every wire hop here
+goes through the comm facade's instrumented primitives, so per-op byte stats
+(`comm/*` telemetry) accrue under the engine's quantized step for free.
+
 These primitives are used by the engine's quantized step variant
 (`zero_quantized_weights` / `zero_quantized_gradients` config knobs) and are
 directly usable inside any shard_map body.
 """
 
-import functools
-
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from deepspeed_tpu.comm import mesh as mesh_mod
-
-
-def _group_quant(x, group_size):
-    """x: [..., D] → (int8 [..., D], f32 scales [..., D//group_size])."""
-    D = x.shape[-1]
-    g = max(1, D // group_size) if D % group_size == 0 else 1
-    gs = D // g
-    xg = x.astype(jnp.float32).reshape(x.shape[:-1] + (g, gs))
-    amax = jnp.max(jnp.abs(xg), axis=-1)
-    scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(xg / scale[..., None]), -127, 127).astype(jnp.int8)
-    return q.reshape(x.shape), scale
-
-
-def _group_dequant(q, scale, dtype):
-    D = q.shape[-1]
-    g = scale.shape[-1]
-    gs = D // g
-    x = q.astype(jnp.float32).reshape(q.shape[:-1] + (g, gs)) * scale[..., None]
-    return x.reshape(q.shape).astype(dtype)
+from deepspeed_tpu.comm import collectives as coll
+from deepspeed_tpu.comm.collectives import (group_dequant_int8 as _group_dequant,
+                                            group_quant_int8 as _group_quant)
 
 
 def quantized_all_gather(x, axis_name, group_size=256):
@@ -54,11 +39,8 @@ def quantized_all_gather(x, axis_name, group_size=256):
     x: local shard [...]. Returns the concatenated global array along axis 0,
     dequantized to x.dtype.
     """
-    flat = x.reshape(-1)
-    q, scale = _group_quant(flat, group_size)
-    q_all = jax.lax.all_gather(q, axis_name)          # [n, numel] int8
-    s_all = jax.lax.all_gather(scale, axis_name)      # [n, groups] f32
-    deq = _group_dequant(q_all, s_all, x.dtype)       # [n, numel]
+    deq = coll.transform_all_gather(x.reshape(-1), axis_name, "int8",
+                                    group_size)        # [n, numel]
     n = deq.shape[0]
     return deq.reshape((n * x.shape[0],) + x.shape[1:])
 
@@ -73,17 +55,10 @@ def quantized_reduce_scatter(x, axis_name, group_size=256):
     """
     n = jax.lax.psum(1, axis_name)
     N = x.shape[0]
-    assert N % n == 0, f"leading dim {N} not divisible by axis size {n}"
-    chunks = x.reshape((n, N // n) + x.shape[1:])
-    flat = chunks.reshape(n, -1)
-    q, scale = _group_quant(flat, group_size)
-    # all_to_all: split axis 0 (the chunk-owner dim), concat received on new axis
-    q_recv = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
-                                tiled=False)
-    s_recv = jax.lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0,
-                                tiled=False)
-    deq = _group_dequant(q_recv, s_recv, jnp.float32)   # [n, chunk_numel]
-    total = jnp.sum(deq, axis=0)                        # reduce contributions
+    if N % n != 0:
+        raise ValueError(f"leading dim {N} not divisible by axis size {n}")
+    flat = x.reshape(N, -1).reshape(-1)
+    total = coll.transform_reduce_scatter(flat, axis_name, "int8", group_size)
     return total.reshape((N // n,) + x.shape[1:])
 
 
@@ -100,29 +75,17 @@ def qgz_allreduce(x, axis_name, group_size=256):
     x: any-shape local contribution; returns the sum over the axis, replicated,
     in f32. Pads the flat payload to a multiple of the axis size.
     """
-    n = jax.lax.psum(1, axis_name)
-    shape = x.shape
-    flat = x.reshape(-1).astype(jnp.float32)
-    numel = flat.shape[0]
-    pad = (-numel) % n
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
-    mine = quantized_reduce_scatter(flat, axis_name, group_size)
-    # second hop: gather the reduced shards back (int8 wire again)
-    full = quantized_all_gather(mine, axis_name, group_size)
-    return full[:numel].reshape(shape)
+    return coll.compressed_all_reduce(x, axis_name, transform="int8",
+                                      group_size=group_size)
 
 
 def quantized_all_gather_dim(x, axis_name, dim, group_size=256):
     """qwZ all-gather of a leaf sharded on dimension `dim` (inside shard_map):
     int8 payload over the wire, reconstructs the full array in x.dtype."""
-    n = jax.lax.psum(1, axis_name)
     shard_shape = x.shape
-    flat = x.reshape(-1)
-    q, scale = _group_quant(flat, group_size)
-    q_all = jax.lax.all_gather(q, axis_name)
-    s_all = jax.lax.all_gather(scale, axis_name)
-    deq = _group_dequant(q_all, s_all, x.dtype)       # [n, numel]
+    deq = coll.transform_all_gather(x.reshape(-1), axis_name, "int8",
+                                    group_size)        # [n, numel]
+    n = deq.shape[0]
     arr = deq.reshape((n,) + shard_shape)
     arr = jnp.moveaxis(arr, 0, dim)                   # [..., n, k, ...]
     new_shape = shard_shape[:dim] + (n * shard_shape[dim],) + shard_shape[dim + 1:]

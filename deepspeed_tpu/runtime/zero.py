@@ -199,6 +199,26 @@ class ZeroShardingPolicy:
 
     # ---- gradients ----
 
+    def reduce_domain(self, compressed_comm_axis=None):
+        """Split the grad-reduce domain into (fast_axes, slow_axis) for the
+        engine's explicit hierarchical reduce: plain psum rides the fast
+        (ICI) axes, the transform-compressed wire rides the slow axis — on a
+        pod slice the outermost data axis is the DCN tier (the reference
+        qgZ intra-node/inter-node split, `coalesced_collectives.py:31`).
+
+        Returns `(fast_axes, slow_axis)`; `slow_axis` is None when the data
+        domain is a single device (nothing to reduce).
+        """
+        axes = [a for a in mesh_mod.ZERO_AXES if _axis_size(self.mesh, a) > 1]
+        if not axes:
+            return (), None
+        slow = compressed_comm_axis or axes[0]
+        if slow not in axes:
+            raise ValueError(
+                f"compressed_comm_axis {slow!r} is not a data-domain axis "
+                f"with size > 1 on this mesh; candidates: {axes}")
+        return tuple(a for a in axes if a != slow), slow
+
     def grad_shardings(self, params, param_shardings, master_shardings):
         """Sharding constraint applied to grads before the optimizer update.
 

@@ -252,7 +252,7 @@ class TestCommParitySurface:
         np.testing.assert_allclose(out, expect)
         assert out.shape == x.shape
         # asymmetric split lists are rejected (no global-view formulation)
-        with pytest.raises(AssertionError, match="symmetric"):
+        with pytest.raises(ValueError, match="symmetric"):
             comm.all_to_all_single(input=jnp.asarray(x), axis="data",
                                    input_split_sizes=splits,
                                    output_split_sizes=[2, 2, 1, 1])

@@ -312,6 +312,59 @@ def test_dt001_registered_program_runner_taints(tmp_path):
     assert "'out'" in report.findings[0].message
 
 
+def test_dt001_shard_map_collective_body_near_miss(tmp_path):
+    """The comm facade's shard_map collective bodies (now in DT001 scope,
+    `deepspeed_tpu/comm/collectives.py`) do trace-time byte accounting:
+    `int(jax.lax.psum(1, axis))` on a trace-time-concrete axis size,
+    host-side `np.asarray` on a python perm list, and stats mirroring —
+    none of that is a host sync. The facade's EAGER timing fence
+    (`block_until_ready` before the stopwatch stops) IS one and must
+    still fire — in the real tree it carries a reasoned pragma."""
+    report = lint_tree(tmp_path, {"deepspeed_tpu/comm/collectives.py": """
+        import jax
+        import numpy as np
+
+        def ppermute(x, axis_name, perm, *, repeats=1):
+            n = int(jax.lax.psum(1, axis_name))   # trace-time concrete
+            if n > 1:
+                pairs = np.asarray(perm)          # host list: no taint
+                stats.record("ppermute", x.size * x.dtype.itemsize,
+                             calls=repeats)
+            return jax.lax.ppermute(x, axis_name, perm)
+
+        def run_eager(op, x):
+            out = op.eager(x)
+            jax.block_until_ready(out)            # timing fence: fires
+            return out
+        """}, rules=["DT001"])
+    assert rules_of(report) == ["DT001"]
+    assert "block_until_ready" in report.findings[0].message
+
+
+def test_dt004_per_op_registration_loop_is_clean(tmp_path):
+    """Registering per-op jitted shard_map programs in a loop is the comm
+    facade's construction idiom: each `jax.jit(...)` flows into a
+    `register_*()` call and is stored once per process — a loop around a
+    registration is NOT a recompile hazard. A jit built per tick inside a
+    schedule loop (the pipeline's hot path) still fires."""
+    report = lint_tree(tmp_path, {"deepspeed_tpu/comm/ops.py": """
+        import jax
+
+        def enable_collectives(registry, bodies):
+            for name, body in bodies.items():
+                registry.register_op(name,
+                                     jax.jit(body))   # stored once each
+
+        def run_schedule(self, state, ticks):
+            for t in range(ticks):
+                state = jax.jit(self._tick)(state, t)  # per tick: fires
+            return state
+        """}, rules=["DT004"])
+    assert rules_of(report) == ["DT004"]
+    assert "loop body" in report.findings[0].message
+    assert "'run_schedule'" in report.findings[0].message
+
+
 def test_dt004_unhashable_static_default(tmp_path):
     report = lint_tree(tmp_path, {"deepspeed_tpu/models/s.py": """
         import jax
