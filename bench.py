@@ -1304,6 +1304,189 @@ def run_robustness_lane():
     return result
 
 
+def run_fabric_lane():
+    """FABRIC lane (BENCH_SERVING gate): the MULTI-PROCESS serving fabric
+    under real process kills. Three phases over actual replica-server OS
+    processes (serving/transport.py wire, heartbeat liveness):
+
+      * failover arm — a 2-process pool serves BENCH_FABRIC_KILLS rounds of
+        a ragged trace; each round one replica is SIGKILLed while it owns
+        in-flight work. The router detects the death over the wire (socket
+        EOF / heartbeat), quarantines, re-routes and respawns under the
+        restart budget. Reports the completion rate across every round
+        (must be 1.0) and the kill->detection latency distribution;
+      * hung round — SIGSTOP instead of SIGKILL: the process is alive to
+        the OS but beat-less, so detection must come from the HEARTBEAT
+        MISS BUDGET (~interval*budget), never from burning the 300s step
+        timeout. Reports that detection latency separately;
+      * degraded arm — the same kill against a 1-replica pool with restart
+        budget 0: no failover path, so in-flight work is lost. The honest
+        baseline for what the fabric buys.
+
+    value is the failover-arm completion rate; vs_baseline is completion
+    leverage over the degraded arm (failover rate / degraded rate, floored
+    at one request): >1 means the fabric saved work that a budget-less
+    single process lost. The tiny deterministic engine
+    (`testing/fabric.py`) keeps replica boot ~seconds — the lane measures
+    fabric mechanics (detection, reroute, respawn), not model throughput."""
+    import signal as _signal
+
+    from deepspeed_tpu.inference.scheduler import Request
+    from deepspeed_tpu.serving import (RemoteConfig, RemoteReplica,
+                                       ReplicaProcess, ServingRouter)
+    from deepspeed_tpu.testing.chaos import kill_replica_process
+
+    n_req = int(os.environ.get("BENCH_FABRIC_REQUESTS", "8"))
+    rounds = int(os.environ.get("BENCH_FABRIC_KILLS", "3"))
+    hb = float(os.environ.get("BENCH_FABRIC_HEARTBEAT_S", "0.2"))
+    factory = "deepspeed_tpu.testing.fabric:tiny_serving_engine"
+    cfg = RemoteConfig(heartbeat_interval_s=hb, heartbeat_miss_budget=4,
+                       step_timeout_s=300.0)
+    rng = np.random.default_rng(0)
+
+    def batch(tag):
+        return [Request(uid=f"{tag}-{i}",
+                        tokens=rng.integers(0, 200,
+                                            (int(rng.integers(4, 24)),))
+                        .astype(np.int32),
+                        max_new_tokens=6, stop_on_eos=False)
+                for i in range(n_req)]
+
+    def spawn_pool(n):
+        procs = [ReplicaProcess(factory=factory, heartbeat_interval_s=hb,
+                                replica_id=f"r{i}").spawn()
+                 for i in range(n)]
+        handles = []
+        for i, p in enumerate(procs):
+            p.wait_ready(180.0)
+            handles.append(RemoteReplica(process=p, replica_id=f"r{i}",
+                                         config=cfg))
+        return handles
+
+    def drive(router, done, on_step=None, max_stalls=None):
+        stalls = 0
+        while router.in_flight or router._finished_buf:
+            before = router._progress_mark()
+            try:
+                for d in router.step():
+                    done[d.uid] = d
+            except RuntimeError:
+                break               # pool has no reachable replica left
+            if on_step is not None:
+                on_step()
+            if max_stalls is not None:
+                stalls = stalls + 1 \
+                    if router._progress_mark() == before else 0
+                if stalls >= max_stalls:
+                    break
+
+    # ---- failover arm: SIGKILL each round, pool must lose nothing ------
+    handles = spawn_pool(2)
+    submitted = completed = 0
+    detect = []
+    state = {}
+
+    router = ServingRouter(replicas=handles, max_replica_restarts=rounds + 1,
+                           restart_backoff_s=0.0)
+
+    def kill_and_time():
+        if not state["killed"] and any(
+                rec.replica == "r0" for rec in router._pending.values()):
+            kill_replica_process(handles[0], _signal.SIGKILL)
+            state["killed"] = True
+            state["t_kill"] = time.perf_counter()
+        if state["killed"] and state["t_kill"] is not None \
+                and router.counters["replica_failures"] > state["fail0"]:
+            detect.append(time.perf_counter() - state["t_kill"])
+            state["t_kill"] = None
+
+    t_arm = time.perf_counter()
+    for rnd in range(rounds):
+        done = {}
+        state.update(killed=False, t_kill=None,
+                     fail0=router.counters["replica_failures"])
+        for r in batch(f"k{rnd}"):
+            router.submit(r)
+        submitted += n_req
+        drive(router, done, on_step=kill_and_time)
+        completed += len(done)
+    failover_wall = time.perf_counter() - t_arm
+
+    # ---- hung round: SIGSTOP — the heartbeat budget, not the step
+    # timeout, must declare it dead --------------------------------------
+    done = {}
+    for r in batch("stop"):
+        router.submit(r)
+    submitted += n_req
+    while not any(rec.replica == "r0"
+                  for rec in router._pending.values()):
+        for d in router.step():
+            done[d.uid] = d
+    kill_replica_process(handles[0], _signal.SIGSTOP)
+    t_stop = time.perf_counter()
+    # the router's own pre-step liveness read, polled without issuing one
+    # engine RPC: a stopped process stops beating and the miss budget
+    # declares it dead in ~interval*budget seconds
+    while handles[0].heartbeat_alive() \
+            and time.perf_counter() - t_stop < 30.0:
+        time.sleep(0.02)
+    hang_detect_s = time.perf_counter() - t_stop
+    drive(router, done)       # quarantine -> reroute -> respawn, as a crash
+    completed += len(done)
+    pool_after = len(router._healthy())
+    restarts = router.counters["replica_restarts"]
+    failures = router.counters["replica_failures"]
+    reroutes = router.counters["reroutes"]
+    for h in handles:
+        h.close()
+
+    # ---- degraded arm: no failover path at all -------------------------
+    handles1 = spawn_pool(1)
+    router1 = ServingRouter(replicas=handles1, max_replica_restarts=0)
+    deg_done = {}
+    for r in batch("deg"):
+        router1.submit(r)
+    for d in router1.step():
+        deg_done[d.uid] = d
+    kill_replica_process(handles1[0], _signal.SIGKILL)
+    drive(router1, deg_done, max_stalls=3)
+    deg_rate = len(deg_done) / n_req
+    for h in handles1:
+        h.close()
+
+    rate = completed / submitted
+    ds = sorted(detect)
+    result = {
+        "metric": "serving_fabric_failover_completion_rate",
+        "value": round(rate, 4),
+        "unit": "fraction",
+        "vs_baseline": round(rate / max(deg_rate, 1.0 / n_req), 4),
+        "extra": {
+            "requests_per_round": n_req,
+            "kill_rounds": rounds,
+            "submitted": submitted,
+            "completed": completed,
+            "heartbeat_interval_s": hb,
+            "heartbeat_miss_budget": cfg.heartbeat_miss_budget,
+            "step_timeout_s": cfg.step_timeout_s,
+            "kill_detect_p50_s": round(ds[len(ds) // 2], 4) if ds else None,
+            "kill_detect_p99_s": round(
+                ds[min(len(ds) - 1, int(0.99 * len(ds)))], 4) if ds else None,
+            "hang_detect_s": round(hang_detect_s, 4),
+            "replica_failures": failures,
+            "replica_restarts": restarts,
+            "reroutes": reroutes,
+            "pool_size_after": pool_after,
+            "failover_wall_s": round(failover_wall, 2),
+            "degraded": {"completion_rate": round(deg_rate, 4),
+                         "lost": sorted(set(f"deg-{i}" for i in range(n_req))
+                                        - set(deg_done))},
+        },
+    }
+    print(json.dumps(result))
+    return result
+
+
 def run_scaling_arm():
     """One weak-scaling arm (child process with its own device count): a
     tiny GPT trained over a data=N mesh through the engine's explicit 2-hop
@@ -1560,6 +1743,9 @@ def main():
     if env("BENCH_ROBUST_CHILD") == "1":  # robustness sub-lane child
         run_robustness_lane()
         return
+    if env("BENCH_FABRIC_CHILD") == "1":  # multi-process fabric child
+        run_fabric_lane()
+        return
     if env("BENCH_OFFLOAD_CHILD") == "1":  # offload (Infinity tier) child
         run_offload_lane()
         return
@@ -1812,6 +1998,18 @@ def main():
             BENCH_ROBUST_SLOTS=env("BENCH_ROBUST_SLOTS", "4"))
         if robust is not None:
             print(json.dumps(robust))
+
+    # fabric lane (same gate): the multi-process serving fabric under real
+    # SIGKILL/SIGSTOP — failover completion rate vs the no-failover
+    # baseline, kill- and hang-detection latency
+    fabric = None
+    if env("BENCH_SERVING", "1") == "1" and "BENCH_MODEL" not in os.environ:
+        fabric = sub_lane(
+            "fabric", BENCH_FABRIC_CHILD="1",
+            BENCH_FABRIC_REQUESTS=env("BENCH_FABRIC_REQUESTS", "8"),
+            BENCH_FABRIC_KILLS=env("BENCH_FABRIC_KILLS", "3"))
+        if fabric is not None:
+            print(json.dumps(fabric))
 
     # offload lane (BENCH_OFFLOAD knob): the ZeRO-Infinity disk tier with
     # the async double-buffered staging pool vs the blocking baseline —

@@ -50,10 +50,15 @@ _STATIC_DYNAMIC_NAMES = (
 def _dynamic_names() -> set:
     """Runtime-composed metric names (imports the package, lazily)."""
     from deepspeed_tpu.comm import collectives as coll_mod
-    from deepspeed_tpu.serving import ServingRouter
+    from deepspeed_tpu.serving import Autoscaler, ServingRouter
     from deepspeed_tpu.telemetry import memscope as memscope_mod
     dynamic = {f"router/{k}"
                for k in ServingRouter(replicas=[]).counters}
+    # autoscaler decisions ride one f-string (`fabric/{name}`); enumerate
+    # the live counter set so the catalog cannot drift from it
+    dynamic |= {f"fabric/{k}"
+                for k in Autoscaler(ServingRouter(replicas=[]),
+                                    spawn=lambda i: None).counters}
     dynamic |= set(_STATIC_DYNAMIC_NAMES)
     dynamic |= {f"mem/{k}" for k in memscope_mod.LEDGER_GAUGES}
     # comm facade per-op stats (CommStats.bind_telemetry f-strings);

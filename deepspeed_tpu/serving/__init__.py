@@ -26,13 +26,28 @@ AutoTP shards):
     invariant auditor with in-place repair, and `PressureController`'s
     graceful-degradation ladder under sustained overload.
 
-See docs/inference.md "Distributed serving" and "Self-healing &
-degradation".
+This PR adds the multi-process fabric: `transport.py` (stdlib length-
+prefixed-frame RPC + heartbeat push), `remote_replica.py`
+(`RemoteReplica` — every protocol verb over the wire, heartbeat-budget
+liveness, process respawn under the router's restart budget),
+`replica_server.py` (the `bin/dstpu_replica` entrypoint), and
+`autoscaler.py` (elastic scale-up under queue/headroom/degradation
+pressure, graceful drain + reap on scale-down).
+
+See docs/inference.md "Distributed serving", docs/serving_fabric.md, and
+"Self-healing & degradation".
 """
 
+from deepspeed_tpu.serving.autoscaler import Autoscaler, AutoscalerConfig
 from deepspeed_tpu.serving.degradation import PressureController
-from deepspeed_tpu.serving.replica import InProcessReplica, ReplicaHandle
+from deepspeed_tpu.serving.remote_replica import (RemoteConfig,
+                                                  RemoteReplica,
+                                                  ReplicaProcess)
+from deepspeed_tpu.serving.replica import (InProcessReplica, ReplicaHandle,
+                                           ReplicaUnavailableError)
 from deepspeed_tpu.serving.router import RouterConfig, ServingRouter
 
 __all__ = ["ServingRouter", "RouterConfig", "ReplicaHandle",
-           "InProcessReplica", "PressureController"]
+           "InProcessReplica", "PressureController",
+           "ReplicaUnavailableError", "RemoteReplica", "RemoteConfig",
+           "ReplicaProcess", "Autoscaler", "AutoscalerConfig"]

@@ -16,6 +16,14 @@ from deepspeed_tpu.inference.scheduler import (CompletedRequest, Request,
                                                ServingEngine)
 
 
+class ReplicaUnavailableError(RuntimeError):
+    """A replica could not be reached AT ALL — the process died, the wire
+    broke, the call timed out. Distinct from a verb that ran and raised:
+    the router treats this as "quarantine + reroute" at EVERY call site
+    (probes, submit, properties), not just inside step(). Transport errors
+    (serving/transport.py) subclass this."""
+
+
 class ReplicaHandle:
     """Abstract replica surface. Implementations wrap one serving engine
     (or a remote proxy to one). `replica_id` must be unique in a pool;
@@ -147,6 +155,20 @@ class ReplicaHandle:
         None when the engine runs without `telemetry.memscope` — the
         router aggregates these into pool-level `mem/*` gauges."""
         return None
+
+    def compat_descriptor(self) -> Optional[Dict[str, Any]]:
+        """Portable pool-compatibility fingerprint: model cache fingerprint,
+        kv block size, serving-effective kv dtype and int8 scale group —
+        everything `_check_pool_compat` must agree on before blocks can
+        move between pools. JSON-safe so a remote replica can ship it over
+        the wire; None means "unknown" and the join-time gate skips this
+        replica (handoff into it will still fail loudly)."""
+        return None
+
+    def close(self):
+        """Release the replica's resources (final audit + telemetry close
+        for a local engine; shutdown RPC + process reap for a remote one).
+        Default no-op. Idempotent."""
 
     def stats(self) -> Dict[str, Any]:
         raise NotImplementedError
@@ -289,6 +311,20 @@ class InProcessReplica(ReplicaHandle):
 
     def audit_state(self):
         return self.engine.audit_state()
+
+    def compat_descriptor(self):
+        e = self.engine
+        spec = e.engine.model_spec
+        return {
+            "fingerprint": spec.cache_fingerprint or spec.name,
+            "kv_block_size": int(e.block_size),
+            "kv_cache_dtype": str(getattr(e, "kv_cache_dtype",
+                                          e.config.kv_cache_dtype)),
+            "kv_group_size": int(getattr(e, "kv_group_size", 0)),
+        }
+
+    def close(self):
+        self.engine.close()
 
     def stats(self):
         return self.engine.stats()

@@ -77,7 +77,8 @@ from deepspeed_tpu.elasticity.restart_policy import RestartBudget, RestartPolicy
 from deepspeed_tpu.inference.scheduler import (CompletedRequest,
                                                InadmissibleRequestError,
                                                Request, ServingEngine)
-from deepspeed_tpu.serving.replica import InProcessReplica, ReplicaHandle
+from deepspeed_tpu.serving.replica import (InProcessReplica, ReplicaHandle,
+                                           ReplicaUnavailableError)
 from deepspeed_tpu.telemetry import Telemetry
 from deepspeed_tpu.utils.logging import log_dist, logger
 
@@ -189,6 +190,9 @@ class ServingRouter:
         self.replicas: Dict[str, ReplicaHandle] = {}
         self._quarantined: Dict[str, float] = {}   # rid -> earliest restart
         self._dead: set = set()                    # budget exhausted
+        self._draining: set = set()                # graceful scale-down: no
+                                                   # new admission, active
+                                                   # slots run to completion
         self._budgets: Dict[str, RestartBudget] = {}
         self._restart_policy = RestartPolicy(
             max_restarts=config.max_replica_restarts,
@@ -216,7 +220,7 @@ class ServingRouter:
             "reroutes", "ttl_cancelled", "shed", "replica_failures",
             "replica_restarts", "handoffs", "watchdog_strikes",
             "watchdog_quarantines", "hedges", "hedge_wins",
-            "deadline_cancelled")}
+            "deadline_cancelled", "drains", "removed")}
         self._strikes: Dict[str, int] = {}  # consecutive over-deadline steps
         self._hedged: set = set()           # uids ever hedge-dispatched (the
                                             # expected-duplicate allowlist)
@@ -304,56 +308,165 @@ class ServingRouter:
 
     def _check_pool_compat(self, handle):
         """Same model (cache fingerprint) across the pool, same block size
-        when blocks can move between pools (disaggregated handoff)."""
-        if not isinstance(handle, InProcessReplica) or not self.replicas:
+        when blocks can move between pools (disaggregated handoff), same
+        serving-effective KV dtype and int8 scale group. Runs at EVERY
+        join — router construction AND runtime add (autoscaler scale-up) —
+        over `compat_descriptor()`, so in-process and remote replicas gate
+        identically: a divergent replica is refused here with a clear
+        error, never mid-request at its first transplant. A replica whose
+        descriptor is None (unknown backend) is admitted ungated; one that
+        cannot answer at all is refused — joining a dead replica is
+        always a mistake."""
+        try:
+            mine = handle.compat_descriptor()
+        except ReplicaUnavailableError as e:
+            raise ValueError(
+                f"replica {handle.replica_id} is unreachable at join time "
+                f"({e}); refusing to add it to the pool") from None
+        if mine is None or not self.replicas:
             return
-        others = [r for r in self.replicas.values()
-                  if isinstance(r, InProcessReplica)]
-        if not others:
+        ref = ref_rid = None
+        for rid, other in self.replicas.items():
+            if rid in self._dead or rid in self._quarantined:
+                continue
+            try:
+                ref = other.compat_descriptor()
+            except ReplicaUnavailableError:
+                continue
+            if ref is not None:
+                ref_rid = rid
+                break
+        if ref is None:
             return
-        a, b = others[0].engine, handle.engine
-        fa = a.engine.model_spec.cache_fingerprint or a.engine.model_spec.name
-        fb = b.engine.model_spec.cache_fingerprint or b.engine.model_spec.name
-        if fa != fb:
+        if mine["fingerprint"] != ref["fingerprint"]:
             raise ValueError(
                 f"replica {handle.replica_id} serves a different model "
-                f"({fb!r} vs {fa!r}): affinity routing and KV handoff "
+                f"({mine['fingerprint']!r} vs {ref_rid}'s "
+                f"{ref['fingerprint']!r}): affinity routing and KV handoff "
                 f"require one model per pool")
-        if a.block_size != b.block_size:
+        if mine["kv_block_size"] != ref["kv_block_size"]:
             raise ValueError(
-                f"replica {handle.replica_id}: kv_block_size {b.block_size} "
-                f"!= pool's {a.block_size} (blocks must transplant 1:1)")
+                f"replica {handle.replica_id}: kv_block_size "
+                f"{mine['kv_block_size']} != pool's {ref['kv_block_size']} "
+                f"(blocks must transplant 1:1)")
         # serving-EFFECTIVE pool dtype (ServingConfig.quantization may pick
         # int8 over the engine-level kv_cache_dtype), plus the scale group:
         # an int8 pool next to a bf16 one — or two int8 pools with different
         # kv_group_size — would fail mid-request at the first handoff's
-        # transplant instead of here at pool-construction time
-        da = str(getattr(a, "kv_cache_dtype", a.config.kv_cache_dtype))
-        db = str(getattr(b, "kv_cache_dtype", b.config.kv_cache_dtype))
-        if da != db:
+        # transplant instead of here at join time
+        if mine["kv_cache_dtype"] != ref["kv_cache_dtype"]:
             raise ValueError(
-                f"replica {handle.replica_id}: kv_cache_dtype {db} != "
-                f"pool's {da} (transplanted blocks must be byte-identical)")
-        ga = getattr(a, "kv_group_size", 0)
-        gb = getattr(b, "kv_group_size", 0)
-        if da == "int8" and ga != gb:
+                f"replica {handle.replica_id}: kv_cache_dtype "
+                f"{mine['kv_cache_dtype']} != pool's "
+                f"{ref['kv_cache_dtype']} (transplanted blocks must be "
+                f"byte-identical)")
+        if mine["kv_cache_dtype"] == "int8" \
+                and mine["kv_group_size"] != ref["kv_group_size"]:
             raise ValueError(
-                f"replica {handle.replica_id}: kv_group_size {gb} != "
-                f"pool's {ga} (int8 scale leaves must transplant 1:1)")
+                f"replica {handle.replica_id}: kv_group_size "
+                f"{mine['kv_group_size']} != pool's {ref['kv_group_size']} "
+                f"(int8 scale leaves must transplant 1:1)")
 
     @property
     def disaggregated(self) -> bool:
         return any(r.role == "prefill" for r in self.replicas.values())
 
-    def _healthy(self, roles=None) -> List[ReplicaHandle]:
+    def _healthy(self, roles=None,
+                 include_draining: bool = False) -> List[ReplicaHandle]:
         out = []
         for rid, r in self.replicas.items():
             if rid in self._quarantined or rid in self._dead:
+                continue
+            if rid in self._draining and not include_draining:
                 continue
             if roles is not None and r.role not in roles:
                 continue
             out.append(r)
         return out
+
+    # ------------------------------------------------------------------
+    # graceful drain / removal (the autoscaler's scale-down path)
+    # ------------------------------------------------------------------
+
+    def drain_replica(self, rid):
+        """Begin a graceful drain: the replica stops receiving NEW work
+        (dispatch, hedges, handoff targets all skip it) and its queued-but-
+        unstarted requests move back to the router queue; active slots keep
+        stepping to completion. `remove_replica` reaps it once idle — the
+        autoscaler polls for that. A drain never loses a token: requeued
+        requests re-dispatch from scratch (greedy rerun = identical), and
+        running ones finish where they are."""
+        if rid not in self.replicas:
+            raise KeyError(f"unknown replica {rid!r}")
+        if rid in self._draining or rid in self._dead:
+            return
+        if rid in self._quarantined:
+            return          # already failed: quarantine owns its requests
+        self._draining.add(rid)
+        self._count("drains")
+        requeue = []
+        try:
+            for req in self.replicas[rid].drain_queued():
+                rec = self._pending.get(req.uid)
+                if rec is not None and rec.replica == rid:
+                    rec.replica = None
+                    rec.t_dispatch = None
+                    requeue.append(req.uid)
+        except ReplicaUnavailableError as e:
+            self._draining.discard(rid)
+            self._quarantine(rid, e)
+            return
+        self.queue.extendleft(reversed(requeue))
+        if requeue:
+            self._count("reroutes", len(requeue))
+        self._anticipated[rid].clear()
+        log_dist(f"router: draining replica {rid} "
+                 f"(requeued {len(requeue)})", ranks=[0])
+        if self.flightrec.enabled:
+            self.flightrec.record("drain", replica=rid,
+                                  requeued=len(requeue))
+
+    def replica_idle(self, rid) -> bool:
+        """True when a replica owns no work at all — the reap condition."""
+        rep = self.replicas[rid]
+        if rid in self._dead:
+            return True
+        try:
+            return rep.queue_depth == 0 and rep.num_active == 0 \
+                and not any(rec.replica == rid or rec.hedge_replica == rid
+                            for rec in self._pending.values())
+        except ReplicaUnavailableError as e:
+            self._quarantine(rid, e)
+            return False
+
+    def remove_replica(self, rid, close: bool = True) -> ReplicaHandle:
+        """Reap a drained (or dead) replica from the pool. Refuses while it
+        still owns work — call `drain_replica` first and poll
+        `replica_idle`. With `close=True` the handle's resources are
+        released (engine close / remote shutdown + process reap)."""
+        if rid not in self.replicas:
+            raise KeyError(f"unknown replica {rid!r}")
+        if rid not in self._dead and not self.replica_idle(rid):
+            raise RuntimeError(
+                f"replica {rid} still owns work — drain it first")
+        rep = self.replicas.pop(rid)
+        for store in (self._budgets, self._ttft, self._anticipated,
+                      self._strikes, self._quarantined):
+            store.pop(rid, None)
+        self._draining.discard(rid)
+        self._dead.discard(rid)
+        self._count("removed")
+        log_dist(f"router: -replica {rid} (pool: {len(self.replicas)})",
+                 ranks=[0])
+        if self.flightrec.enabled:
+            self.flightrec.record("remove_replica", replica=rid)
+        if close:
+            try:
+                rep.close()
+            except Exception as e:
+                logger.warning(f"router: closing removed replica {rid} "
+                               f"failed: {e}")
+        return rep
 
     def _entry_roles(self):
         """Roles new requests dispatch to."""
@@ -404,8 +517,11 @@ class ServingRouter:
         ttl = ttl_s if ttl_s is not None else self.config.default_ttl_s
         hashes = None
         for rep in self._healthy(self._entry_roles()):
-            hashes = rep.hash_chain(request.tokens)
-            break
+            try:
+                hashes = rep.hash_chain(request.tokens)
+                break
+            except ReplicaUnavailableError as e:
+                self._quarantine(rep.replica_id, e)
         trace = None
         if self.tracer.enabled:
             # the router owns the trace: root span = submit -> completion,
@@ -444,6 +560,7 @@ class ServingRouter:
                     f"router has no healthy replica for roles {roles} "
                     f"(pool={list(self.replicas)}, dead={sorted(self._dead)})")
             last_err = None
+            answered = False
             for rep in reps:
                 try:
                     rep.check_admissible(prompt_len, request.max_new_tokens,
@@ -451,11 +568,20 @@ class ServingRouter:
                                          uid=request.uid,
                                          padded_prompt=padded)
                     last_err = None
+                    answered = True
                     break
                 except InadmissibleRequestError as e:
                     last_err = e
+                    answered = True
+                except ReplicaUnavailableError as e:
+                    self._quarantine(rep.replica_id, e)
             if last_err is not None:
                 raise last_err
+            if not answered:
+                raise RuntimeError(
+                    f"router has no reachable replica for roles {roles} "
+                    f"(pool={list(self.replicas)}, "
+                    f"dead={sorted(self._dead)})")
 
     # ------------------------------------------------------------------
     # routing
@@ -503,14 +629,19 @@ class ServingRouter:
                 need = rep.check_admissible(
                     rec.prompt_len, rec.request.max_new_tokens,
                     prefill_only=self.disaggregated, uid=rec.request.uid)
+                aff = self._affinity(rep, rec.hashes)
+                pending = rep.queue_depth + rep.num_active
+                score = (aff * cfg.affinity_weight
+                         - pending * cfg.load_penalty
+                         - (cfg.block_penalty
+                            if need > rep.available_blocks else 0))
+                saturated = rep.queue_depth >= max_q
             except InadmissibleRequestError:
                 continue
-            aff = self._affinity(rep, rec.hashes)
-            pending = rep.queue_depth + rep.num_active
-            score = (aff * cfg.affinity_weight - pending * cfg.load_penalty -
-                     (cfg.block_penalty if need > rep.available_blocks else 0))
-            scored.append((rep, aff, score, pending,
-                           rep.queue_depth >= max_q))
+            except ReplicaUnavailableError as e:
+                self._quarantine(rep.replica_id, e)
+                continue
+            scored.append((rep, aff, score, pending, saturated))
         if not scored:
             return None, 0, 0.0, False
         open_ = [s for s in scored if not s[4]]
@@ -558,9 +689,17 @@ class ServingRouter:
                     "dispatch", uid=uid, replica=rep.replica_id,
                     affinity=int(aff), score=round(float(score), 3),
                     spilled=bool(spilled))
-            rep.submit(rec.request, prefill_only=self.disaggregated,
-                       hashes=rec.hashes, trace=rec.trace,
-                       deadline_at=rec.deadline_at)
+            try:
+                rep.submit(rec.request, prefill_only=self.disaggregated,
+                           hashes=rec.hashes, trace=rec.trace,
+                           deadline_at=rec.deadline_at)
+            except ReplicaUnavailableError as e:
+                # died between scoring and submit: back to the queue head
+                # (rec.replica is still None, so the quarantine sweep
+                # doesn't double-requeue it), then re-choose
+                self.queue.appendleft(uid)
+                self._quarantine(rep.replica_id, e)
+                continue
             rec.replica = rep.replica_id
             rec.t_dispatch = self._clock()
             self._note_dispatch(rep.replica_id, rec.hashes)
@@ -611,7 +750,14 @@ class ServingRouter:
                 # only queued-but-unstarted dies; a generating request runs
                 # on (a slot PARKED for handoff counts as cancellable — it
                 # holds exported blocks, see ServingEngine.cancel)
-                done = self.replicas[rec.replica].cancel(uid, queued_only=True)
+                try:
+                    done = self.replicas[rec.replica].cancel(uid,
+                                                             queued_only=True)
+                except ReplicaUnavailableError as e:
+                    # the replica died with the request on it: quarantine
+                    # re-owns everything it held (this uid included)
+                    self._quarantine(rec.replica, e)
+                    continue
                 if done is None:
                     continue
             self._count("ttl_cancelled")
@@ -671,8 +817,12 @@ class ServingRouter:
         in-flight request restarts from scratch — greedy decode makes the
         rerun token-identical), and schedule a restart if the budget
         allows."""
+        if rid in self._quarantined or rid in self._dead:
+            return          # already converged (several probes can trip on
+                            # the same dead replica within one router step)
         rep = self.replicas[rid]
         self._count("replica_failures")
+        self._draining.discard(rid)     # a dying drain becomes a plain crash
         logger.warning(f"router: quarantining replica {rid} ({reason!r})")
         try:
             rep.drain_queued()          # engine queue state is re-owned here
@@ -815,15 +965,18 @@ class ServingRouter:
         for rep in self._healthy(self._entry_roles()):
             if rep.replica_id == rec.replica:
                 continue
-            if not (rep.has_free_slot
-                    or rep.queue_depth < self.config.max_replica_queue):
-                continue
             try:
+                if not (rep.has_free_slot
+                        or rep.queue_depth < self.config.max_replica_queue):
+                    continue
                 rep.check_admissible(rec.prompt_len,
                                      rec.request.max_new_tokens,
                                      prefill_only=self.disaggregated,
                                      uid=rec.request.uid)
             except InadmissibleRequestError:
+                continue
+            except ReplicaUnavailableError as e:
+                self._quarantine(rep.replica_id, e)
                 continue
             return rep
         return None
@@ -862,9 +1015,13 @@ class ServingRouter:
             rep = self._hedge_target(rec)
             if rep is None:
                 continue
-            rep.submit(rec.request, prefill_only=self.disaggregated,
-                       hashes=rec.hashes, trace=None,
-                       deadline_at=rec.deadline_at)
+            try:
+                rep.submit(rec.request, prefill_only=self.disaggregated,
+                           hashes=rec.hashes, trace=None,
+                           deadline_at=rec.deadline_at)
+            except ReplicaUnavailableError as e:
+                self._quarantine(rep.replica_id, e)
+                continue
             rec.hedge_replica = rep.replica_id
             self._hedged.add(uid)
             self._note_dispatch(rep.replica_id, rec.hashes)
@@ -884,7 +1041,9 @@ class ServingRouter:
         target, transplant the blocks, release the source. A target without
         room right now leaves the slot parked (prefill-side backpressure)."""
         targets = self._healthy(self._decode_roles())
-        for prep in self._healthy(("prefill",)):
+        # a DRAINING prefill replica still unloads its parked slots (that
+        # is what draining means); a draining decode replica takes no more
+        for prep in self._healthy(("prefill",), include_draining=True):
             for uid in prep.handoff_ready():
                 rec = self._pending.get(uid)
                 if rec is None:        # cancelled while parked
@@ -1014,8 +1173,13 @@ class ServingRouter:
                    for rec in self._pending.values())
 
     def _progress_mark(self):
-        live = self._healthy()
-        work = sum(r.progress() for r in live)
+        live = self._healthy(include_draining=True)
+        work = 0
+        for r in live:
+            try:
+                work += r.progress()
+            except ReplicaUnavailableError:
+                pass        # its death registers as a quarantine next step
         # hedges count as progress: the launch itself changes no queue or
         # token counter until the target's next admission, and run() must
         # not mistake that one-step gap for a wedged pool
@@ -1114,14 +1278,22 @@ class ServingRouter:
         reps = {}
         for rid, rep in self.replicas.items():
             health = ("dead" if rid in self._dead else
-                      "quarantined" if rid in self._quarantined else "up")
+                      "quarantined" if rid in self._quarantined else
+                      "draining" if rid in self._draining else "up")
             entry = {"role": rep.role, "health": health,
                      "restarts": self._budgets[rid].restarts,
                      "ttft_ms": self.replica_ttft(rid)}
-            if health == "up":
-                entry.update(queue=rep.queue_depth, active=rep.num_active,
-                             available_blocks=rep.available_blocks,
-                             engine=rep.stats())
+            if health in ("up", "draining"):
+                try:
+                    entry.update(queue=rep.queue_depth,
+                                 active=rep.num_active,
+                                 available_blocks=rep.available_blocks,
+                                 engine=rep.stats())
+                except ReplicaUnavailableError as e:
+                    # stats() must never crash on a half-dead pool — the
+                    # flight-recorder dump path depends on it
+                    entry["health"] = "unreachable"
+                    entry["error"] = str(e)[:200]
             reps[rid] = entry
         out = {"steps": self.steps, "queue_depth": len(self.queue),
                "in_flight": len(self._pending),
@@ -1157,4 +1329,10 @@ class ServingRouter:
     def total_prefill_chunks(self) -> int:
         """Prefill chunks executed across live replicas — the quantity
         affinity routing minimizes on shared-prefix traffic."""
-        return sum(r.stats()["prefill_chunks"] for r in self._healthy())
+        total = 0
+        for r in self._healthy(include_draining=True):
+            try:
+                total += r.stats()["prefill_chunks"]
+            except ReplicaUnavailableError:
+                pass
+        return total

@@ -37,6 +37,8 @@ and assert the repair — exactly the offline forensics workflow
 """
 
 import dataclasses
+import os
+import signal
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -44,7 +46,23 @@ import numpy as np
 from deepspeed_tpu.serving.replica import ReplicaHandle
 
 __all__ = ["ChaosClock", "ChaosInjected", "ChaosReplica", "ChaosSchedule",
-           "CORRUPTION_KINDS", "SAFE_CORRUPTIONS", "corrupt_pool"]
+           "CORRUPTION_KINDS", "SAFE_CORRUPTIONS", "corrupt_pool",
+           "kill_replica_process"]
+
+
+def kill_replica_process(handle, sig: int = signal.SIGKILL) -> int:
+    """The PROCESS-level chaos fault: deliver `sig` (default `kill -9`) to
+    a `RemoteReplica`'s OS process and return the pid. This is the real
+    thing the in-process `crash` event simulates — the multi-process soak
+    uses it to prove the heartbeat/quarantine/respawn path against an
+    actual dead process. SIGSTOP makes a hung-not-dead replica (heartbeats
+    stop, process survives) — the detection-latency arm of the fabric
+    bench."""
+    proc = getattr(handle, "process", None)
+    if proc is None or proc.pid is None:
+        raise ValueError(f"handle {handle!r} has no OS process to kill")
+    os.kill(proc.pid, sig)
+    return proc.pid
 
 
 class ChaosInjected(RuntimeError):
@@ -381,6 +399,19 @@ class ChaosReplica(ReplicaHandle):
 
     def compile_stats(self):
         return self._inner.compile_stats()
+
+    # base-class DEFAULTS (not raising stubs) — these must forward
+    # explicitly too, or Python resolves them on ReplicaHandle and the
+    # wrapped replica's real answer never surfaces
+
+    def memory_snapshot(self):
+        return self._inner.memory_snapshot()
+
+    def compat_descriptor(self):
+        return self._inner.compat_descriptor()
+
+    def close(self):
+        return self._inner.close()
 
     def __getattr__(self, name):
         return getattr(self._inner, name)

@@ -128,6 +128,14 @@ def pytest_configure(config):
                    "dispatch, degradation ladder, and the chaos soak over "
                    "testing/chaos.py) — fast and CPU-harness-safe, rides "
                    "in tier-1; run it alone with pytest -m chaos)")
+    config.addinivalue_line(
+        "markers", "fabric: multi-process serving fabric suite "
+                   "(tests/test_fabric.py — wire codec round-trips, "
+                   "retry/backoff budgets, heartbeat-miss liveness with "
+                   "injected clocks, in-thread RPC replica parity, the "
+                   "real kill -9 multi-process soak, autoscaler scale-up/"
+                   "drain/reap, pool CLI units) — rides in tier-1; run it "
+                   "alone with pytest -m fabric)")
 
 
 # The slow tier, by measured duration (r5 full-suite run with --durations,

@@ -1,0 +1,734 @@
+"""Multi-process serving fabric (deepspeed_tpu/serving/transport.py,
+remote_replica.py, autoscaler.py): wire codec, bounded retries, heartbeat
+liveness, transport-backed replicas driven by the real router, the
+process-level kill -9 chaos soak, elastic autoscaling with graceful drain,
+and the pool CLI.
+
+Everything rides the `fabric` marker (tier-1; run alone with
+`pytest -m fabric`). The codec/retry/heartbeat units touch no engine; the
+in-thread RPC tests share one module-scoped engine; only the kill -9 soak
+pays for real subprocesses.
+"""
+
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.config.core import MeshConfig
+from deepspeed_tpu.inference.scheduler import (InadmissibleRequestError,
+                                               CompletedRequest, Request)
+from deepspeed_tpu.serving import (Autoscaler, InProcessReplica,
+                                   RemoteConfig, RemoteReplica,
+                                   ReplicaHandle, ReplicaProcess,
+                                   ReplicaUnavailableError, ServingRouter)
+from deepspeed_tpu.serving.remote_replica import (HeartbeatMonitor,
+                                                  ReplicaDeadError)
+from deepspeed_tpu.serving.replica_server import ReplicaServerApp
+from deepspeed_tpu.serving.transport import (FrameError, RemoteCallError,
+                                             RetryPolicy, RpcClient,
+                                             RpcServer, TransportClosed,
+                                             TransportTimeout,
+                                             call_with_retry, decode_frame,
+                                             encode_frame)
+from deepspeed_tpu.serving import pool_cli
+from deepspeed_tpu.testing.chaos import ChaosClock, kill_replica_process
+from deepspeed_tpu.testing import fabric as fabric_mod
+
+pytestmark = pytest.mark.fabric
+
+FACTORY = "deepspeed_tpu.testing.fabric:tiny_serving_engine"
+BS = fabric_mod.BS
+
+
+# ----------------------------------------------------------------------
+# wire codec (no engine, no sockets)
+# ----------------------------------------------------------------------
+
+
+def test_codec_round_trips_every_verb_payload():
+    req = Request(uid="u-1", tokens=np.arange(37, dtype=np.int32),
+                  max_new_tokens=9, eos_token_id=5, stop_on_eos=False,
+                  deadline_ms=125.0, priority=2)
+    done = CompletedRequest(uid="u-1", prompt_len=37,
+                            tokens=np.array([3, 1, 4], np.int32),
+                            finish_reason="eos", cached_prefix_tokens=16,
+                            timing={"first_token": 1.25, "finish": 2.5})
+    msg = {"verb": "submit",
+           "payload": {"request": req, "hashes": [b"\x00\xffhash", b"h2"],
+                       "done": [done], "deadline_in_s": 0.125,
+                       "none": None, "nested": {"a": [1, 2.5, "s", True]}}}
+    out = decode_frame(encode_frame(msg))
+    r = out["payload"]["request"]
+    assert isinstance(r, Request) and r.uid == "u-1" and r.priority == 2
+    assert r.deadline_ms == 125.0 and r.eos_token_id == 5
+    toks = np.asarray(r.tokens)
+    assert toks.dtype == np.int32 and np.array_equal(
+        toks, np.arange(37, dtype=np.int32))
+    d = out["payload"]["done"][0]
+    assert isinstance(d, CompletedRequest) and d.finish_reason == "eos"
+    assert np.array_equal(d.tokens, done.tokens)
+    assert d.tokens.dtype == np.int32 and d.timing["first_token"] == 1.25
+    assert out["payload"]["hashes"] == [b"\x00\xffhash", b"h2"]
+    assert out["payload"]["none"] is None
+    assert out["payload"]["nested"]["a"] == [1, 2.5, "s", True]
+
+
+def test_codec_numpy_scalars_and_2d_arrays():
+    msg = {"n": np.int64(7), "f": np.float32(1.5),
+           "m": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    out = decode_frame(encode_frame(msg))
+    assert out["n"] == 7 and isinstance(out["n"], int)
+    assert out["f"] == 1.5
+    assert out["m"].shape == (2, 3) and out["m"].dtype == np.float32
+
+
+def test_codec_truncated_and_garbage_frames():
+    buf = encode_frame({"verb": "step", "payload": {}})
+    with pytest.raises(FrameError, match="truncated"):
+        decode_frame(buf[:-3])
+    with pytest.raises(FrameError, match="truncated"):
+        decode_frame(buf[:6])                 # shorter than the header
+    with pytest.raises(FrameError, match="garbage"):
+        decode_frame(b"NOPE" + buf[4:])       # bad magic
+    with pytest.raises(FrameError, match="garbage"):
+        # forged header declaring an absurd body length
+        decode_frame(buf[:4] + (1 << 31).to_bytes(4, "big") + buf[8:])
+    with pytest.raises(FrameError, match="garbage"):
+        decode_frame(buf[:8] + b"\x00" * (len(buf) - 8))   # non-JSON body
+
+
+# ----------------------------------------------------------------------
+# retry/backoff budget (injected sleep + rng: zero real waiting)
+# ----------------------------------------------------------------------
+
+
+def _policy(**kw):
+    base = dict(max_retries=3, base_backoff_s=0.1, backoff_factor=2.0,
+                max_backoff_s=10.0, jitter=0.0)
+    base.update(kw)
+    return RetryPolicy(**base)
+
+
+def test_retry_budget_exhaustion_and_backoff_schedule():
+    calls, sleeps = [], []
+
+    def flaky():
+        calls.append(1)
+        raise TransportTimeout("injected")
+
+    with pytest.raises(TransportTimeout):
+        call_with_retry(flaky, idempotent=True, policy=_policy(),
+                        sleep=sleeps.append, rng=lambda: 0.0)
+    assert len(calls) == 4                      # initial + 3 retries
+    assert sleeps == pytest.approx([0.1, 0.2, 0.4])
+
+
+def test_retry_succeeds_mid_budget_and_caps_backoff():
+    state = {"n": 0}
+    sleeps = []
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] <= 2:
+            raise TransportClosed("injected")
+        return "ok"
+
+    out = call_with_retry(flaky, idempotent=True,
+                          policy=_policy(max_retries=5, max_backoff_s=0.15),
+                          sleep=sleeps.append, rng=lambda: 0.0)
+    assert out == "ok" and state["n"] == 3
+    assert sleeps == pytest.approx([0.1, 0.15])   # second delay capped
+
+
+def test_retry_jitter_scales_delay():
+    sleeps = []
+
+    def flaky():
+        raise TransportClosed("injected")
+
+    with pytest.raises(TransportClosed):
+        call_with_retry(flaky, idempotent=True,
+                        policy=_policy(max_retries=1, jitter=0.5),
+                        sleep=sleeps.append, rng=lambda: 1.0)
+    assert sleeps == pytest.approx([0.1 * 1.5])
+
+
+def test_non_idempotent_verbs_never_retry():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise TransportClosed("injected")
+
+    with pytest.raises(TransportClosed):
+        call_with_retry(flaky, idempotent=False, policy=_policy(),
+                        sleep=lambda s: pytest.fail("slept on non-idempotent"),
+                        rng=lambda: 0.0)
+    assert len(calls) == 1
+
+
+def test_remote_call_errors_are_not_retried():
+    calls = []
+
+    def remote_raises():
+        calls.append(1)
+        raise RemoteCallError("step", "ValueError", "engine-side bug")
+
+    with pytest.raises(RemoteCallError):
+        call_with_retry(remote_raises, idempotent=True, policy=_policy(),
+                        sleep=lambda s: None, rng=lambda: 0.0)
+    assert len(calls) == 1      # the wire worked; re-asking can't help
+
+
+# ----------------------------------------------------------------------
+# heartbeat liveness (injected clock + scripted beat source: no sleeps)
+# ----------------------------------------------------------------------
+
+
+class _ScriptedBeats:
+    """Fake beat source: pops scripted (beats, eof) tuples; idle after."""
+
+    def __init__(self, script=()):
+        self.script = list(script)
+        self.closed = False
+
+    def drain(self):
+        return self.script.pop(0) if self.script else (0, False)
+
+    def close(self):
+        self.closed = True
+
+
+def test_heartbeat_miss_budget_with_injected_clock():
+    clk = ChaosClock()
+    src = _ScriptedBeats()
+    mon = HeartbeatMonitor(src, interval_s=1.0, miss_budget=3, clock=clk)
+    src.script = [(1, False)]
+    assert mon.check() and mon.beats == 1
+    clk.advance(2.5)
+    assert mon.check()                    # 2.5 missed intervals < budget 3
+    src.script = [(2, False)]
+    assert mon.check() and mon.beats == 3   # beats reset the window
+    clk.advance(3.5)
+    assert not mon.check()                  # 3.5 > 3: dead
+    assert "no heartbeat" in mon.dead_reason
+    # dead is sticky — resumed beats don't resurrect a declared-dead replica
+    src.script = [(5, False)]
+    clk.advance(0.0)
+    assert not mon.check()
+
+
+def test_heartbeat_eof_is_immediately_dead():
+    mon = HeartbeatMonitor(_ScriptedBeats([(0, True)]), interval_s=1.0,
+                           miss_budget=100, clock=ChaosClock())
+    assert not mon.check()                  # no waiting out the budget
+    assert "EOF" in mon.dead_reason
+
+
+def test_heartbeat_close_closes_source():
+    src = _ScriptedBeats()
+    mon = HeartbeatMonitor(src, interval_s=1.0, miss_budget=3,
+                           clock=ChaosClock())
+    mon.close()
+    assert src.closed
+
+
+# ----------------------------------------------------------------------
+# bare RpcServer/RpcClient (real sockets, trivial verbs, no engine)
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture()
+def echo_server():
+    srv = RpcServer({
+        "echo": lambda p: p,
+        "boom": lambda p: (_ for _ in ()).throw(ValueError("server-side")),
+        "slow": lambda p: time.sleep(p.get("s", 0.3)) or "late",
+    }, heartbeat_interval_s=0.05)
+    srv.serve_in_thread()
+    yield srv
+    srv.shutdown()
+
+
+def test_rpc_round_trip_and_remote_exception(echo_server):
+    c = RpcClient(echo_server.host, echo_server.port)
+    assert c.call("echo", {"x": [1, 2], "b": b"\x01"}) == {"x": [1, 2],
+                                                           "b": b"\x01"}
+    with pytest.raises(RemoteCallError) as ei:
+        c.call("boom", {})
+    assert ei.value.err_type == "ValueError"
+    with pytest.raises(RemoteCallError) as ei:
+        c.call("no_such_verb", {})
+    assert ei.value.err_type == "KeyError"
+    c.close()
+
+
+def test_rpc_timeout_poisons_then_reconnects(echo_server):
+    c = RpcClient(echo_server.host, echo_server.port)
+    with pytest.raises(TransportTimeout):
+        c.call("slow", {"s": 0.5}, timeout_s=0.05)
+    assert c._sock is None                   # poisoned stream was dropped
+    assert c.call("echo", {"ok": 1}) == {"ok": 1}   # fresh connection
+    c.close()
+
+
+def test_rpc_connect_refused_is_transport_closed():
+    with socket.socket() as probe:            # grab a port nobody serves
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    c = RpcClient("127.0.0.1", port, connect_timeout_s=0.5)
+    with pytest.raises(TransportClosed):
+        c.call("echo", {})
+    assert isinstance(TransportClosed("x"), ReplicaUnavailableError)
+
+
+def test_server_survives_garbage_connection(echo_server):
+    with socket.create_connection((echo_server.host,
+                                   echo_server.port)) as s:
+        s.sendall(b"GET / HTTP/1.1\r\n\r\n")   # not a fabric frame
+    c = RpcClient(echo_server.host, echo_server.port)
+    assert c.call("echo", {"still": "serving"}) == {"still": "serving"}
+    c.close()
+
+
+# ----------------------------------------------------------------------
+# transport-backed replica against the real router (in-thread server:
+# real sockets + real engine, no subprocess cost)
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def inf_engine():
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    mesh_mod.init_mesh(MeshConfig(data=1, tensor=1, sequence=1, expert=1,
+                                  pipe=1))
+    serving = fabric_mod.tiny_serving_engine()
+    return serving.engine       # the InferenceEngine (shared params)
+
+
+def _serving(inf_engine, **over):
+    kw = dict(max_slots=2, max_context=96, prefill_chunk=BS,
+              enable_prefix_caching=True)
+    kw.update(over)
+    return inf_engine.serving(**kw)
+
+
+@pytest.fixture()
+def remote_rep(inf_engine):
+    app = ReplicaServerApp(_serving(inf_engine), heartbeat_interval_s=0.1)
+    app.server.serve_in_thread()
+    rep = RemoteReplica(host=app.server.host, port=app.server.port,
+                        replica_id="rem0",
+                        config=RemoteConfig(heartbeat_interval_s=0.1,
+                                            step_timeout_s=60.0))
+    yield rep
+    rep.close_transport()
+    app.server.shutdown()
+
+
+def _prompts(n, seed=3):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 200, (int(rng.integers(4, 24)),))
+            .astype(np.int32) for _ in range(n)]
+
+
+def test_remote_replica_token_parity_through_router(inf_engine, remote_rep):
+    prompts = _prompts(5)
+    router = ServingRouter(replicas=[remote_rep])
+    done = router.run([Request(uid=i, tokens=p, max_new_tokens=6,
+                               stop_on_eos=False)
+                       for i, p in enumerate(prompts)])
+    assert sorted(done) == list(range(5))
+    refs = [inf_engine.generate(p[None], max_new_tokens=6,
+                                stop_on_eos=False)[0] for p in prompts]
+    for i in range(5):
+        assert done[i].finish_reason == "length"
+        assert np.array_equal(done[i].tokens, refs[i]), i
+
+
+def test_remote_signals_compat_and_inadmissible(remote_rep):
+    assert remote_rep.queue_depth == 0 and remote_rep.num_active == 0
+    assert remote_rep.has_free_slot and remote_rep.available_blocks > 0
+    assert remote_rep.prefill_chunk == BS
+    desc = remote_rep.compat_descriptor()
+    assert desc["kv_block_size"] == BS
+    assert desc["kv_cache_dtype"] == "float32"
+    # the engine's own rejection type survives the wire — the router's
+    # routing/validation except-clauses depend on it
+    with pytest.raises(InadmissibleRequestError):
+        remote_rep.check_admissible(10_000, 64)
+    # prefix machinery over the wire: hash chain + affinity probe
+    prompt = np.arange(2 * BS, dtype=np.int32)
+    hashes = remote_rep.hash_chain(prompt)
+    assert hashes and all(isinstance(h, bytes) for h in hashes)
+    assert remote_rep.affinity(hashes) == 0      # nothing registered yet
+
+
+def test_remote_deadline_survives_dispatch(inf_engine, remote_rep):
+    """Satellite: `set_clock` cannot cross the process boundary, so the
+    router's absolute deadline is converted to a remaining budget at the
+    handle and re-anchored on the server's own clock. A ~zero budget must
+    retire ENGINE-side with finish_reason="deadline"; a generous one must
+    run to "length"."""
+    clk = ChaosClock(start=1000.0)
+    remote_rep.set_clock(clk)        # LOCAL swap only — never forwarded
+    prompt = np.arange(8, dtype=np.int32)
+    # 2ms of budget left on the router clock: survives the handle-side
+    # max(0, ...) but is long expired by the time the server steps
+    remote_rep.submit(Request(uid="dl0", tokens=prompt, max_new_tokens=32,
+                              stop_on_eos=False), deadline_at=1000.002)
+    remote_rep.submit(Request(uid="dl1", tokens=prompt, max_new_tokens=4,
+                              stop_on_eos=False), deadline_at=1000.0 + 60.0)
+    done = {}
+    for _ in range(200):
+        for d in remote_rep.step():
+            done[d.uid] = d
+        if len(done) == 2:
+            break
+    assert done["dl0"].finish_reason == "deadline"
+    assert done["dl1"].finish_reason == "length"
+    assert len(done["dl1"].tokens) == 4
+
+
+def test_remote_deadline_through_router_clock(inf_engine, remote_rep):
+    clk = ChaosClock(start=50.0)
+    router = ServingRouter(replicas=[remote_rep], clock=clk)
+    prompt = np.arange(8, dtype=np.int32)
+    done = router.run([Request(uid="r-dl", tokens=prompt, max_new_tokens=32,
+                               stop_on_eos=False, deadline_ms=2.0)])
+    assert done["r-dl"].finish_reason == "deadline"
+
+
+class _FakeCompat(ReplicaHandle):
+    """Descriptor-only handle for join-gate tests (never dispatched to)."""
+
+    def __init__(self, rid, desc=None, unreachable=False):
+        self.replica_id = rid
+        self._desc = desc
+        self._unreachable = unreachable
+
+    def compat_descriptor(self):
+        if self._unreachable:
+            raise ReplicaUnavailableError("injected: host down")
+        return self._desc
+
+
+_DESC = {"fingerprint": "modelA", "kv_block_size": 16,
+         "kv_cache_dtype": "float32", "kv_group_size": 0}
+
+
+def test_pool_compat_gates_runtime_joins():
+    """Satellite: `_check_pool_compat` runs at EVERY add_replica — a
+    divergent replica is refused at join time with a clear error, not at
+    its first transplant."""
+    router = ServingRouter(replicas=[_FakeCompat("a", dict(_DESC))])
+    with pytest.raises(ValueError, match="different model"):
+        router.add_replica(_FakeCompat("b", dict(_DESC,
+                                                 fingerprint="modelB")))
+    with pytest.raises(ValueError, match="kv_block_size"):
+        router.add_replica(_FakeCompat("c", dict(_DESC, kv_block_size=32)))
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        router.add_replica(_FakeCompat("d", dict(_DESC,
+                                                 kv_cache_dtype="int8")))
+    # group size only matters once the pool itself is quantized
+    router2 = ServingRouter(replicas=[_FakeCompat(
+        "a", dict(_DESC, kv_cache_dtype="int8", kv_group_size=32))])
+    with pytest.raises(ValueError, match="kv_group_size"):
+        router2.add_replica(_FakeCompat(
+            "e", dict(_DESC, kv_cache_dtype="int8", kv_group_size=64)))
+    # matching int8 pair joins fine
+    router2.add_replica(_FakeCompat(
+        "b", dict(_DESC, kv_cache_dtype="int8", kv_group_size=32)))
+    with pytest.raises(ValueError, match="unreachable at join"):
+        router.add_replica(_FakeCompat("f", unreachable=True))
+    assert list(router.replicas) == ["a"]
+
+
+def test_remote_compat_gate_against_real_descriptor(inf_engine, remote_rep):
+    router = ServingRouter(replicas=[remote_rep])
+    divergent = dict(remote_rep.compat_descriptor(), kv_block_size=32)
+    with pytest.raises(ValueError, match="kv_block_size"):
+        router.add_replica(_FakeCompat("bad", divergent))
+
+
+# ----------------------------------------------------------------------
+# THE soak: kill -9 a real replica process mid-trace
+# ----------------------------------------------------------------------
+
+
+def test_kill9_soak_exactly_once_and_parity(inf_engine):
+    """The acceptance gate: a 2-process pool loses one replica to SIGKILL
+    mid-trace. Required: exactly-once completion, greedy token parity with
+    the single-replica oracle, heartbeat/transport detection WITHOUT
+    blocking a full step timeout (step_timeout_s=300 here; the whole test
+    finishes in well under a tenth of that), and a budgeted respawn."""
+    cfg = RemoteConfig(heartbeat_interval_s=0.2, heartbeat_miss_budget=4,
+                       step_timeout_s=300.0)
+    procs = [ReplicaProcess(factory=FACTORY, factory_kwargs={},
+                            heartbeat_interval_s=0.2, replica_id=f"r{i}",
+                            env={"JAX_PLATFORMS": "cpu"}).spawn()
+             for i in range(2)]
+    handles = []
+    try:
+        for i, p in enumerate(procs):
+            p.wait_ready(180)
+            handles.append(RemoteReplica(process=p, replica_id=f"r{i}",
+                                         config=cfg))
+        router = ServingRouter(replicas=handles, max_replica_restarts=1,
+                               restart_backoff_s=0.0)
+        prompts = _prompts(8, seed=11)
+        for i, p in enumerate(prompts):
+            router.submit(Request(uid=i, tokens=p, max_new_tokens=6,
+                                  stop_on_eos=False))
+        out, killed, t_kill, t_detect = {}, False, None, None
+        t0 = time.monotonic()
+        while router.in_flight or router._finished_buf:
+            assert time.monotonic() - t0 < 240, "soak wedged"
+            for d in router.step():
+                out[d.uid] = d
+            if not killed and any(rec.replica == "r0"
+                                  for rec in router._pending.values()):
+                kill_replica_process(handles[0], signal.SIGKILL)
+                t_kill = time.monotonic()
+                killed = True
+            if killed and t_detect is None \
+                    and router.counters["replica_failures"] >= 1:
+                t_detect = time.monotonic()
+        assert killed, "r0 never owned work — kill never fired"
+        # exactly-once: every uid completes exactly one time
+        assert sorted(out) == list(range(8))
+        assert router.counters["replica_failures"] == 1
+        assert router.counters["reroutes"] >= 1
+        assert router.counters["replica_restarts"] == 1    # respawned
+        # detection came from heartbeat/EOF, not from a step timeout
+        assert t_detect is not None and t_detect - t_kill < 30.0
+        # greedy parity vs the single-replica oracle (seeded params make
+        # the subprocess engines bit-identical to the fixture's)
+        refs = [inf_engine.generate(p[None], max_new_tokens=6,
+                                    stop_on_eos=False)[0] for p in prompts]
+        for i in range(8):
+            assert out[i].finish_reason == "length"
+            assert np.array_equal(out[i].tokens, refs[i]), i
+        # the respawned r0 is live and serving again
+        assert router.stats()["replicas"]["r0"]["health"] == "up"
+    finally:
+        for h in handles:
+            h.close()
+        for p in procs:
+            p.kill()
+            p.wait()
+
+
+# ----------------------------------------------------------------------
+# autoscaler: scale-up under pressure, graceful drain + reap
+# ----------------------------------------------------------------------
+
+
+def _spawner(inf_engine, prefix="auto"):
+    def spawn(i):
+        return InProcessReplica(engine=_serving(inf_engine),
+                                replica_id=f"{prefix}{i}")
+    return spawn
+
+
+def test_autoscaler_scales_up_under_queue_pressure(inf_engine):
+    router = ServingRouter(replicas=[_serving(inf_engine)])
+    clk = ChaosClock()
+    scaler = Autoscaler(router, spawn=_spawner(inf_engine, "up"),
+                        clock=clk, min_replicas=1, max_replicas=2,
+                        scale_up_queue_per_replica=4.0, sustain_up=2,
+                        cooldown_ticks=0, warmup_prompts=0)
+    prompts = _prompts(10, seed=5)
+    for i, p in enumerate(prompts):
+        router.submit(Request(uid=i, tokens=p, max_new_tokens=4,
+                              stop_on_eos=False))
+    assert scaler.tick() is None            # pressure tick 1 of sustain 2
+    assert scaler.tick() == "scale_up"
+    assert len(router.replicas) == 2
+    assert scaler.counters["scale_up"] == 1
+    assert scaler.counters["joins"] == 1
+    done = router.run([])
+    assert router.counters["completed"] == 10
+    assert len(done) == 10
+    # a third tick under no pressure must not flap
+    assert scaler.tick() is None
+    assert len(router.replicas) == 2
+
+
+def test_autoscaler_warmup_gives_join_affinity(inf_engine):
+    rng = np.random.default_rng(9)
+    prefix = rng.integers(0, 200, (2 * BS,)).astype(np.int32)
+    router = ServingRouter(replicas=[_serving(inf_engine)])
+    scaler = Autoscaler(router, spawn=_spawner(inf_engine, "warm"),
+                        min_replicas=1, max_replicas=2,
+                        scale_up_queue_per_replica=1.0, sustain_up=1,
+                        cooldown_ticks=0, warmup_prompts=1)
+    scaler.note_prompt(prefix)
+    for i in range(4):
+        router.submit(Request(uid=f"w{i}", tokens=prefix,
+                              max_new_tokens=2, stop_on_eos=False))
+    assert scaler.tick() == "scale_up"
+    assert scaler.counters["warmup_prompts"] == 1
+    joined = router.replicas["warm0"]
+    hashes = joined.hash_chain(prefix)
+    assert joined.affinity(hashes) > 0      # warm blocks before 1st request
+    router.run([])
+
+
+def test_autoscaler_drains_and_reaps_when_idle(inf_engine):
+    router = ServingRouter(replicas=[_serving(inf_engine),
+                                     _serving(inf_engine)])
+    scaler = Autoscaler(router, spawn=_spawner(inf_engine, "dn"),
+                        min_replicas=1, max_replicas=3, sustain_down=3,
+                        cooldown_ticks=0)
+    done = router.run([Request(uid=i, tokens=p, max_new_tokens=3,
+                               stop_on_eos=False)
+                       for i, p in enumerate(_prompts(4, seed=6))])
+    assert len(done) == 4
+    actions = [scaler.tick() for _ in range(5)]
+    assert "scale_down" in actions and "reap" in actions
+    assert len(router.replicas) == 1        # drained to min_replicas
+    assert router.counters["drains"] == 1
+    assert router.counters["removed"] == 1
+    assert scaler.counters["reaps"] == 1
+    # never below the floor, no matter how idle
+    for _ in range(20):
+        scaler.tick()
+    assert len(router.replicas) == 1
+
+
+def test_autoscaler_join_refused_on_divergent_spawn(inf_engine):
+    router = ServingRouter(replicas=[_serving(inf_engine)])
+    scaler = Autoscaler(router,
+                        spawn=lambda i: _FakeCompat(f"bad{i}",
+                                                    dict(_DESC,
+                                                         fingerprint="X")),
+                        min_replicas=1, max_replicas=2,
+                        scale_up_queue_per_replica=1.0, sustain_up=1,
+                        cooldown_ticks=0, warmup_prompts=0)
+    for i, p in enumerate(_prompts(4, seed=7)):
+        router.submit(Request(uid=i, tokens=p, max_new_tokens=2,
+                              stop_on_eos=False))
+    assert scaler.tick() is None
+    assert scaler.counters["join_refused"] == 1
+    assert len(router.replicas) == 1        # the orphan never joined
+    router.run([])
+
+
+def test_graceful_drain_loses_no_tokens(inf_engine):
+    """Direct drain path (what the autoscaler drives): queued work
+    requeues, active slots finish in place, the reap refuses until idle —
+    and every token matches the oracle."""
+    router = ServingRouter(replicas=[_serving(inf_engine),
+                                     _serving(inf_engine)])
+    prompts = _prompts(6, seed=8)
+    for i, p in enumerate(prompts):
+        router.submit(Request(uid=i, tokens=p, max_new_tokens=5,
+                              stop_on_eos=False))
+    out = {}
+    for d in router.step():                  # dispatch + some progress
+        out[d.uid] = d
+    with pytest.raises(RuntimeError, match="still owns work"):
+        router.remove_replica("r0")          # must drain first
+    router.drain_replica("r0")
+    assert "r0" in router._draining
+    assert router.stats()["replicas"]["r0"]["health"] == "draining"
+    while router.in_flight or router._finished_buf:
+        for d in router.step():
+            out[d.uid] = d
+    assert sorted(out) == list(range(6))
+    refs = [inf_engine.generate(p[None], max_new_tokens=5,
+                                stop_on_eos=False)[0] for p in prompts]
+    for i in range(6):
+        assert np.array_equal(out[i].tokens, refs[i]), i
+    assert router.replica_idle("r0")
+    router.remove_replica("r0", close=False)   # shares the module engine
+    assert list(router.replicas) == ["r1"]
+    assert router.counters["drains"] == 1
+    assert router.counters["removed"] == 1
+
+
+# ----------------------------------------------------------------------
+# pool CLI units
+# ----------------------------------------------------------------------
+
+
+def test_pool_cli_load_config_inline_and_file(tmp_path):
+    cfg = pool_cli.load_config('{"factory": "m:f", "replicas": 3}')
+    assert cfg["factory"] == "m:f" and cfg["replicas"] == 3
+    assert cfg["kwargs"] == {} and cfg["router"] == {}
+    p = tmp_path / "pool.json"
+    p.write_text('{"factory": "m:f"}')
+    assert pool_cli.load_config(str(p))["replicas"] == 2   # default
+    with pytest.raises(ValueError, match="factory"):
+        pool_cli.load_config('{"replicas": 2}')
+    with pytest.raises(ValueError, match="replicas"):
+        pool_cli.load_config('{"factory": "m:f", "replicas": 0}')
+
+
+def test_pool_cli_status_table_and_rows(inf_engine):
+    rep = InProcessReplica(engine=_serving(inf_engine), replica_id="cli0")
+    row = pool_cli.replica_row(rep)
+    assert row["id"] == "cli0" and row["alive"] is True
+    assert row["queue"] == 0 and row["active"] == 0
+    table = pool_cli.status_table([row, {"id": "cli1", "role": "mixed",
+                                         "alive": False}])
+    lines = table.splitlines()
+    assert "id" in lines[0] and "alive" in lines[0]
+    assert any("cli0" in ln for ln in lines)
+    assert any("cli1" in ln and "False" in ln for ln in lines)
+
+
+# ----------------------------------------------------------------------
+# router hardening: a dead replica discovered OUTSIDE step()
+# ----------------------------------------------------------------------
+
+
+class _DeadOnProbe(ReplicaHandle):
+    """Unreachable from the first probe — like a process that died between
+    router construction and the first dispatch."""
+
+    def __init__(self, rid):
+        self.replica_id = rid
+
+    def compat_descriptor(self):
+        return None
+
+    def hash_chain(self, prompt):
+        raise TransportClosed("injected: peer vanished")
+
+    def check_admissible(self, *a, **k):
+        raise TransportClosed("injected: peer vanished")
+
+    def drain_queued(self):
+        raise TransportClosed("injected: peer vanished")
+
+    def progress(self):
+        raise TransportClosed("injected: peer vanished")
+
+    @property
+    def can_restart(self):
+        return False
+
+    def stats(self):
+        raise TransportClosed("injected: peer vanished")
+
+
+def test_router_quarantines_replica_dead_outside_step(inf_engine):
+    router = ServingRouter(replicas=[_serving(inf_engine)])
+    router.add_replica(_DeadOnProbe("ghost"))
+    prompts = _prompts(3, seed=12)
+    done = router.run([Request(uid=i, tokens=p, max_new_tokens=3,
+                               stop_on_eos=False)
+                       for i, p in enumerate(prompts)])
+    assert sorted(done) == [0, 1, 2]          # traffic survived the ghost
+    assert router.counters["replica_failures"] >= 1
+    assert router.stats()["replicas"]["ghost"]["health"] == "dead"
+    # and stats() stayed serviceable throughout (no crash on unreachable)
+    assert router.stats()["replicas"]["r0"]["health"] == "up"

@@ -148,6 +148,44 @@ def test_dt002_near_misses_stay_silent(tmp_path):
     assert report.findings == []
 
 
+def test_dt002_fabric_transport_fixture_pair(tmp_path):
+    """The multi-process fabric's transport/remote-replica modules live in
+    `serving/` and are therefore DT002 territory: liveness math (heartbeat
+    miss budgets, deadline translation) must ride injected clocks, or the
+    chaos suite's no-real-sleeps proofs go dishonest. One near-miss pair
+    shaped like those modules: a monitor that CALLS the wall clock fires;
+    the sanctioned reference-bind default (what transport.py,
+    remote_replica.py, and replica_server.py actually do) stays silent."""
+    report = lint_tree(tmp_path, {
+        "deepspeed_tpu/serving/transport_bad.py": """
+        import time
+
+        class HeartbeatMonitor:
+            def __init__(self, interval_s):
+                self.interval_s = interval_s
+                self._last_beat_t = time.monotonic()
+
+            def missed(self):
+                return (time.monotonic() - self._last_beat_t) \\
+                    / self.interval_s
+        """,
+        "deepspeed_tpu/serving/transport_ok.py": """
+        import time
+
+        class HeartbeatMonitor:
+            def __init__(self, interval_s, clock=None):
+                self._clock = clock if clock is not None else time.monotonic
+                self.interval_s = interval_s
+                self._last_beat_t = self._clock()
+
+            def missed(self):
+                return (self._clock() - self._last_beat_t) \\
+                    / self.interval_s
+        """}, rules=["DT002"])
+    assert rules_of(report) == ["DT002", "DT002"]
+    assert all("transport_bad" in f.path for f in report.findings)
+
+
 # ----------------------------------------------------------------------
 # DT003 donation-safety
 # ----------------------------------------------------------------------
