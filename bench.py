@@ -145,6 +145,7 @@ backward-scan slice/stash traffic + attention recompute — memory-bound at
 197TF:819GB/s, not schedulable away at seq 512.
 """
 
+import dataclasses
 import json
 import os
 import sys
@@ -1650,6 +1651,134 @@ def run_scaling_lane():
     return result
 
 
+def run_moe_lane():
+    """MOE lane (BENCH_MOE gate, child-process pattern): sparse-FLOPs MoE-GPT
+    vs its iso-FLOPs dense twin, trained through the engine over an
+    expert=EP x data=DP mesh. Top-1 routing activates exactly ONE d_ff-sized
+    expert per token, so a dense GPT with the SAME d_ff is the equal-compute
+    baseline — the MoE model simply carries num_experts x the MLP parameters
+    at (ideally) the same step time. Reports tokens/s + 6N-active-param MFU
+    for both arms, the facade-measured all_to_all dispatch bytes/step
+    (trace-time accounting, `comm/collectives.py` — reset, retrace,
+    snapshot), and the capacity-scaling check the acceptance gate names:
+    retracing the same loss at 2x capacity_factor must move ~2x the bytes."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import collectives as coll
+    from deepspeed_tpu.comm import mesh as mesh_mod
+    from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_model
+    from deepspeed_tpu.models.moe_gpt import (MoEGPTConfig, moe_gpt_loss,
+                                              make_moe_gpt_model)
+
+    env = os.environ.get
+    steps = int(env("BENCH_MOE_STEPS", "3"))
+    seq = int(env("BENCH_MOE_SEQ", "256"))
+    mbs = int(env("BENCH_MOE_MBS", "2"))
+    ep = int(env("BENCH_MOE_EP", "4"))
+    dp = int(env("BENCH_MOE_DP", "2"))
+    experts = int(env("BENCH_MOE_EXPERTS", "4"))
+    peak = peak_bf16_tflops()
+
+    dims = dict(n_layer=4, n_head=4, d_model=128, d_ff=512, max_seq_len=seq,
+                vocab_size=1024, dtype=jnp.bfloat16, remat=False)
+
+    def arm(make_model, mesh, mbs_arm, cf_probe=None):
+        mesh_mod.clear_mesh()
+        e, _, _, _ = deepspeed_tpu.initialize(model=make_model(), config={
+            "train_micro_batch_size_per_gpu": mbs_arm,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1},
+            "mesh": mesh,
+            "steps_per_print": 10**9})
+        # seq+1 raw tokens -> the shifted inputs keep T=seq (a power of two:
+        # the facade shard_map path needs N % (dp*ep) == 0)
+        batch = {"tokens": np.random.default_rng(0).integers(
+            0, 1024, (e.train_batch_size(), seq + 1)).astype(np.int32)}
+        placed = e._maybe_split_gas(batch)
+        coll.stats.reset()
+        e._train_step.lower(e.state, placed)    # trace -> per-step wire plan
+        per_op = {op: int(rec["bytes"])
+                  for op, rec in coll.stats.snapshot().items()}
+        probe_bytes = None
+        if cf_probe is not None:
+            # same loss, 2x capacity: the dispatch payload [E, C, D] doubles
+            # with C, and the facade's trace-time stats must see it
+            rng = jax.random.PRNGKey(0)
+            coll.stats.reset()
+            jax.jit(lambda p, b, r: moe_gpt_loss(p, b, r, cf_probe)).lower(
+                e.state.params, placed, rng)
+            probe_bytes = int(coll.stats.snapshot()
+                              .get("all_to_all", {}).get("bytes", 0))
+        loss = e.train_batch(batch)             # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = e.train_batch(batch)
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / steps
+        tokens = e.train_batch_size() * seq
+        n_params = sum(int(x.size) for x in
+                       jax.tree_util.tree_leaves(e.state.params))
+        out = {"tokens_per_sec": round(tokens / dt, 2),
+               "step_time_ms": round(dt * 1e3, 3),
+               "loss": float(loss), "n_params": n_params,
+               "comm_bytes_per_step": per_op,
+               "probe_2x_capacity_a2a_bytes": probe_bytes}
+        del e
+        return out
+
+    mcfg = MoEGPTConfig(num_experts=experts, moe_freq=2,
+                        capacity_factor=1.0, min_capacity=4, **dims)
+    mcfg2 = dataclasses.replace(mcfg, capacity_factor=2.0)
+    # equal GLOBAL batch on equal chips: the expert axis does not multiply
+    # the data domain, so the MoE arm's micro-batch carries the ep factor
+    moe = arm(lambda: make_moe_gpt_model(mcfg, name=f"moe-e{experts}"),
+              {"data": dp, "expert": ep}, mbs * ep, cf_probe=mcfg2)
+    dense = arm(lambda: make_gpt_model(cfg=GPTConfig(**dims),
+                                       name="dense-isoflops"),
+                {"data": dp * ep}, mbs)
+
+    # top-1 MoE activates one expert per token -> active params equal the
+    # dense twin's; 6N-model-flops MFU is comparable across the two arms
+    n_active = dense["n_params"]
+    chips = dp * ep
+
+    def mfu(tps):
+        return round(6.0 * n_active * tps / chips / 1e12 / peak, 4)
+
+    a2a = int(moe["comm_bytes_per_step"].get("all_to_all", 0))
+    probe = moe["probe_2x_capacity_a2a_bytes"] or 0
+    result = {
+        "metric": f"moe_e{experts}_ep{ep}_tokens_per_sec_per_chip",
+        "value": round(moe["tokens_per_sec"] / chips, 2),
+        "unit": "tokens/s/chip",
+        # throughput retained vs the iso-FLOPs dense twin (1.0 = sparse
+        # capacity for free; the gap is routing + dispatch cost)
+        "vs_baseline": round(moe["tokens_per_sec"] / dense["tokens_per_sec"],
+                             4) if dense["tokens_per_sec"] else 0.0,
+        "extra": {
+            "experts": experts, "ep": ep, "dp": dp,
+            "moe": {k: v for k, v in moe.items()
+                    if k != "probe_2x_capacity_a2a_bytes"},
+            "dense_isoflops": dense,
+            "mfu_moe": mfu(moe["tokens_per_sec"]),
+            "mfu_dense": mfu(dense["tokens_per_sec"]),
+            "param_capacity_ratio": round(
+                moe["n_params"] / dense["n_params"], 3),
+            # acceptance gate: facade-sourced dispatch bytes, nonzero and
+            # scaling with capacity_factor (cf 1.0 -> 2.0 ~doubles them)
+            "all_to_all_bytes_per_step": a2a,
+            "all_to_all_bytes_2x_capacity": probe,
+            "capacity_scaling_ratio": round(probe / a2a, 3) if a2a else 0.0,
+            "dispatch_bytes_nonzero": bool(a2a > 0),
+        },
+    }
+    print(json.dumps(result))
+    return result
+
+
 REF_BERT_SAMPLES = {128: 272.0, 512: 52.0}   # V100 samples/s/GPU, fastest-BERT post
 V100_FP16_PEAK = 125.0                        # TFLOPs
 
@@ -1754,6 +1883,9 @@ def main():
         return
     if env("BENCH_SCALING_CHILD") == "1":  # scaling-efficiency sub-lane
         run_scaling_lane()
+        return
+    if env("BENCH_MOE_CHILD") == "1":     # MoE vs iso-FLOPs dense sub-lane
+        run_moe_lane()
         return
     model_name = env("BENCH_MODEL", "gpt2-760m")
     import jax.numpy as jnp
@@ -2035,6 +2167,33 @@ def main():
             BENCH_SCALING_STEPS=env("BENCH_SCALING_STEPS", "3"))
         if scaling is not None:
             print(json.dumps(scaling))
+
+    # MoE lane (BENCH_MOE knob): sparse-FLOPs MoE-GPT vs its iso-FLOPs dense
+    # twin over an expert x data mesh — tokens/s + MFU per arm, facade-
+    # measured all_to_all dispatch bytes/step, capacity-scaling byte check
+    moe = None
+    if env("BENCH_MOE", "1") == "1" and "BENCH_MODEL" not in os.environ:
+        import jax
+        moe_ep = int(env("BENCH_MOE_EP", "4"))
+        moe_dp = int(env("BENCH_MOE_DP", "2"))
+        moe_overrides = {}
+        if jax.default_backend() == "cpu":
+            # CPU harness: the child owns exactly ep x dp host devices
+            moe_overrides["XLA_FLAGS"] = _with_exact_device_count(
+                os.environ.get("XLA_FLAGS", "").replace("\n", " "),
+                moe_ep * moe_dp)
+            moe_overrides["JAX_PLATFORMS"] = "cpu"
+        elif jax.device_count() < moe_ep * moe_dp:
+            moe_ep = min(moe_ep, jax.device_count())
+            moe_dp = max(1, jax.device_count() // moe_ep)
+        moe = sub_lane(
+            "moe", BENCH_MOE_CHILD="1",
+            BENCH_MOE_STEPS=env("BENCH_MOE_STEPS", "3"),
+            BENCH_MOE_EP=str(moe_ep), BENCH_MOE_DP=str(moe_dp),
+            BENCH_MOE_EXPERTS=env("BENCH_MOE_EXPERTS", "4"),
+            **moe_overrides)
+        if moe is not None:
+            print(json.dumps(moe))
 
     # BERT lane (reference's second headline; VERDICT r4 item 5): raw
     # samples/s + MFU on both conventions, both reference shapes

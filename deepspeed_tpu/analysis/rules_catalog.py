@@ -44,6 +44,12 @@ _STATIC_DYNAMIC_NAMES = (
     "train/hbm_bytes_in_use",         # gauge set via a (src, dst) table
     "train/hbm_peak_bytes",
     "Checkpoint/save_ms",             # routed through record_events
+    # MoE grad-path extras: slash-keyed scalars the loss aux dict exports
+    # through the engine's generic gauge loop (`_after_step` publishes
+    # every "<sub>/<name>" metric) — no literal recording site
+    "moe/aux_loss",
+    "moe/overflow_tokens",
+    "moe/dropped_frac",
 )
 
 

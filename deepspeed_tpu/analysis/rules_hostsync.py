@@ -58,6 +58,7 @@ class HostSyncRule(Rule):
         "deepspeed_tpu/launcher/comm_bench.py",
         "deepspeed_tpu/comm/comm.py",
         "deepspeed_tpu/comm/collectives.py",
+        "deepspeed_tpu/parallel/moe.py",
     )
 
     def check_module(self, ctx):

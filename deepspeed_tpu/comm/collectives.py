@@ -438,6 +438,36 @@ def transform_reduce_scatter(x, axis_name, transform="int8",
     return jnp.sum(deq, axis=0)
 
 
+def transform_all_to_all(x, axis_name, *, split_axis, concat_axis,
+                         tiled=True, transform="none",
+                         group_size=DEFAULT_GROUP_SIZE, out_dtype=None):
+    """All-to-all with an encoded wire — the MoE expert-dispatch primitive.
+
+    ``transform="none"`` degenerates to the plain instrumented all_to_all.
+    With ``"int8"`` the groupwise-quantized payload (int8 values + f32 group
+    scales, both keeping the input's leading dims) crosses the wire and the
+    receiver dequantizes — the ZeRO++ qgZ rule applied to activation dispatch.
+    ``"onebit"`` is rejected: sign+mean-magnitude destroys routed activations
+    (it is a gradient wire with error feedback, not an activation codec).
+    """
+    if transform == "onebit":
+        raise ValueError(
+            "transform_all_to_all does not support 'onebit' — the 1-bit wire "
+            "is an error-feedback gradient codec, not an activation codec; "
+            "use transform='int8' for compressed expert dispatch")
+    out_dtype = out_dtype or x.dtype
+    if transform == "none":
+        return all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)
+    t = get_transform(transform, group_size)
+    payloads, meta = t.encode(x)
+    moved = tuple(
+        all_to_all(p, axis_name, split_axis=split_axis,
+                   concat_axis=concat_axis, tiled=tiled)
+        for p in payloads)
+    return t.decode(moved, meta).astype(out_dtype)
+
+
 def compressed_all_reduce(x, axis_name, transform="none",
                           group_size=DEFAULT_GROUP_SIZE, err=None):
     """SUM over `axis_name` with a compressed wire (inside shard_map).

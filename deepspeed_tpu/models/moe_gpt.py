@@ -6,12 +6,21 @@ transformer MLPs, and MoE *inference* via the expert-parallel containers
 `_create_ep_parallel_group`).
 
 TPU-native formulation: every `moe_freq`-th block's MLP is a GShard-style
-expert layer — gate → top-1 dispatch einsum constrained onto the `expert` mesh
-axis (XLA inserts the all-to-all pair) → expert FFN batched over the expert
-dim → combine einsum. Static capacity, masked overflow (no dynamic shapes).
-Inference gating drops jitter/aux-loss and keeps argmax routing; the decode
-path routes single tokens with a plain one-hot combine (capacity is irrelevant
-at batch-per-step granularity).
+expert layer. Training routes with masked static-capacity top-1 gating —
+through the comm facade's instrumented all_to_all inside `shard_map` when a
+mesh with expert parallelism is active (`parallel/moe.py`'s
+`expert_parallel_moe`; dispatch bytes land in `comm/all_to_all_bytes`), and
+through the dispatch-einsum + sharding-constraint fallback otherwise (XLA
+emits the all-to-all pair). Capacity overflow masks tokens (no dynamic
+shapes); drop/overflow counts surface as `moe/*` telemetry via the loss aux.
+
+Inference routes **capacity-free**: every token goes to its argmax expert
+with a one-hot combine (`_moe_mlp_nodrop`). That choice is deliberate — the
+routing decision depends only on the token itself, never on batch
+composition or chunk boundaries, which is exactly the invariance the paged
+serving path needs for token-identical continuous batching (a prompt chunked
+3 ways routes identically to the same prompt in one pass). Capacity is a
+training-throughput construct; at serving granularity it only creates drops.
 """
 
 import dataclasses
@@ -24,13 +33,17 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.mesh import (BATCH_AXES, EXPERT_AXIS, SEQ_AXIS,
-                                     TENSOR_AXIS, shard_constraint)
+                                     TENSOR_AXIS, get_mesh, has_mesh,
+                                     shard_constraint)
 from deepspeed_tpu.models.gpt import (GPTConfig, _attn_half, _block,
                                       _block_decode, _decode_attn_half, _embed,
-                                      _norm, _residual_mlp,
-                                      init_gpt_params, gpt_param_specs,
-                                      init_kv_cache)
-from deepspeed_tpu.parallel.moe import top1_gating
+                                      _lm_head, _norm, _paged_attn_half,
+                                      _residual_mlp, gpt_cache_identity,
+                                      init_gpt_params, init_kv_cache,
+                                      init_paged_kv_pool, gpt_param_specs)
+from deepspeed_tpu.parallel.moe import (can_use_expert_shard_map,
+                                        expert_parallel_moe,
+                                        gating_drop_stats, top1_gating)
 from deepspeed_tpu.runtime.engine import ModelSpec
 
 
@@ -42,6 +55,7 @@ class MoEGPTConfig(GPTConfig):
     eval_capacity_factor: float = 2.0
     min_capacity: int = 4
     moe_aux_weight: float = 0.01
+    moe_dispatch_wire: str = "none"   # WireTransform on the facade a2a pair
 
     def moe_layer_ids(self):
         return [i for i in range(self.n_layer) if i % self.moe_freq == 1]
@@ -81,49 +95,114 @@ def moe_gpt_param_specs(cfg: MoEGPTConfig):
     return specs
 
 
-def _expert_ffn(xe, mp, cfg):
+def _expert_ffn(xe, mp, cfg, constrain=True):
     """xe: [E, C, D] tokens per expert → [E, C, D]; batched expert FFN on the
-    expert mesh axis."""
+    expert mesh axis. `constrain=False` for shard_map bodies (manual sharding
+    forbids constraints — the expert dim is already local there)."""
     h = jnp.einsum("ecd,edf->ecf", xe, mp["w_up"]) + mp["b_up"][:, None, :]
     h = jax.nn.gelu(h) if cfg.activation == "gelu" else jax.nn.relu(h)
-    h = shard_constraint(h, EXPERT_AXIS, None, TENSOR_AXIS)
+    if constrain:
+        h = shard_constraint(h, EXPERT_AXIS, None, TENSOR_AXIS)
     return jnp.einsum("ecf,efd->ecd", h, mp["w_down"]) + mp["b_down"][:, None, :]
 
 
-def _moe_mlp(x, mp, cfg: MoEGPTConfig, training=True):
-    """x: [B, T, D] → (out, l_aux). GShard dispatch/combine einsums."""
+def _moe_mlp(x, mp, cfg: MoEGPTConfig, training=True, mesh=None):
+    """x: [B, T, D] → (out, l_aux, drop_stats). Static-capacity top-1 routing.
+
+    With a mesh that `can_use_expert_shard_map` accepts, dispatch/combine run
+    inside shard_map with the facade's all_to_all pair (per-shard gating,
+    local capacity); otherwise the GShard dispatch/combine einsums + expert
+    sharding constraint (XLA inserts the a2a — invisible to facade stats).
+    """
     B, T, D = x.shape
-    xf = x.reshape(B * T, D)
-    logits = (xf @ mp["gate_w"]).astype(jnp.float32)
+    E = cfg.num_experts
     cf = cfg.capacity_factor if training else cfg.eval_capacity_factor
-    l_aux, dispatch, combine, _counts = top1_gating(
+    xf = x.reshape(B * T, D)
+
+    if mesh is None and has_mesh():
+        # lazy resolution: the engine builds the mesh after the ModelSpec, so
+        # a loss traced under an active expert mesh picks up facade dispatch
+        # automatically; can_use_expert_shard_map rejects unsuitable meshes
+        mesh = get_mesh()
+    if can_use_expert_shard_map(mesh, E, B * T):
+        eparams = {k: mp[k] for k in ("w_up", "b_up", "w_down", "b_down")}
+        out, l_aux, _counts, stats = expert_parallel_moe(
+            xf, mp["gate_w"], eparams,
+            lambda xe, p: _expert_ffn(xe, p, cfg, constrain=False), mesh,
+            num_experts=E, capacity_factor=cf, min_capacity=cfg.min_capacity,
+            dispatch_wire=cfg.moe_dispatch_wire)
+        return out.reshape(B, T, D), l_aux, stats
+
+    logits = (xf @ mp["gate_w"]).astype(jnp.float32)
+    l_aux, dispatch, combine, counts = top1_gating(
         logits, capacity_factor=cf, min_capacity=cfg.min_capacity)
+    stats = gating_drop_stats(dispatch, counts)
     # dispatch: [N, E, C] — einsum routes tokens to expert slots; the sharding
     # constraint on the expert dim makes XLA emit the a2a (reference _AllToAll)
     xe = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), xf)
     xe = shard_constraint(xe, EXPERT_AXIS, None, None)
     ye = _expert_ffn(xe, mp, cfg)
     out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), ye)
+    return out.reshape(B, T, D), l_aux, stats
+
+
+def _moe_mlp_nodrop(x, mp, cfg: MoEGPTConfig):
+    """Capacity-free inference routing: x [B, T, D] → (out, l_aux).
+
+    Every token goes to its argmax expert, weighted by the gate probability —
+    routing depends only on the token, so any batching/chunking of the same
+    tokens produces identical outputs (the paged-serving parity invariant).
+    Dispatches every token to all experts' rows and masks (E× FFN flops for
+    static shapes; decode is bandwidth-bound, prefill chunks are short).
+    The me·ce aux loss is still reported (eval-time routing balance).
+    """
+    B, T, D = x.shape
+    E = cfg.num_experts
+    xf = x.reshape(B * T, D)
+    logits = (xf @ mp["gate_w"]).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top = jnp.argmax(probs, axis=-1)                       # [N]
+    gate = jnp.max(probs, axis=-1).astype(x.dtype)         # [N]
+    onehot = jax.nn.one_hot(top, E, dtype=x.dtype)         # [N, E]
+    l_aux = jnp.sum(jnp.mean(probs, axis=0)
+                    * jnp.mean(onehot.astype(jnp.float32), axis=0)) * E
+    xe = jnp.einsum("ne,nd->end", onehot, xf)              # [E, N, D]
+    ye = _expert_ffn(xe, mp, cfg)                          # [E, N, D]
+    out = jnp.einsum("ne,end->nd", onehot, ye) * gate[:, None]
     return out.reshape(B, T, D), l_aux
 
 
-def moe_gpt_forward(params, tokens, cfg: MoEGPTConfig, training=True, rng=None):
-    """[B, T] → (logits, total_l_aux). Python loop over layers (MoE layers break
-    the homogeneous scan; L is moderate for MoE models)."""
+def _zero_drop_stats():
+    z = jnp.asarray(0.0, jnp.float32)
+    return {"routed": z, "kept": z, "overflow_tokens": z, "dropped_frac": z}
+
+
+def _sum_drop_stats(acc, s):
+    acc = {k: acc[k] + s[k] for k in ("routed", "kept", "overflow_tokens")}
+    acc["dropped_frac"] = acc["overflow_tokens"] / jnp.maximum(acc["routed"], 1.0)
+    return acc
+
+
+def moe_gpt_forward(params, tokens, cfg: MoEGPTConfig, training=True, rng=None,
+                    mesh=None, return_stats=False):
+    """[B, T] → (logits, total_l_aux[, drop_stats]). Python loop over layers
+    (MoE layers break the homogeneous scan; L is moderate for MoE models)."""
     B, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
     x = _embed(params, tokens, positions, cfg)
     x = shard_constraint(x, BATCH_AXES, SEQ_AXIS, None)
 
     l_aux_total = jnp.asarray(0.0, jnp.float32)
+    stats_total = _zero_drop_stats()
     moe_ids = set(cfg.moe_layer_ids())
     for lid in range(cfg.n_layer):
         p = jax.tree_util.tree_map(lambda a: a[lid], params["blocks"])
         if lid in moe_ids:
             # attention half from the dense block, MLP half replaced by MoE
-            x = _moe_block(x, p, params["moe"][str(lid)], cfg, positions, training)
-            x, l_aux = x
+            x, l_aux, stats = _moe_block(x, p, params["moe"][str(lid)], cfg,
+                                         positions, training, mesh)
             l_aux_total = l_aux_total + l_aux
+            stats_total = _sum_drop_stats(stats_total, stats)
         else:
             x = _block(x, p, cfg, positions)
 
@@ -131,44 +210,63 @@ def moe_gpt_forward(params, tokens, cfg: MoEGPTConfig, training=True, rng=None):
               cfg.norm_eps)
     head = params["lm_head"] if not cfg.tie_embeddings else params["wte"]
     logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+    if return_stats:
+        return logits, l_aux_total, stats_total
     return logits, l_aux_total
 
 
-def _moe_block(x, p, mp, cfg, positions, training):
+def _moe_block(x, p, mp, cfg, positions, training, mesh=None):
     """Transformer block with MoE MLP (attention half shared with gpt._block,
     so alibi/sliding-window/parallel-residual behave identically)."""
     aux = []
 
     def moe_fn(h):
-        out, l_aux = _moe_mlp(h, mp, cfg, training)
-        aux.append(l_aux)
+        if training:
+            out, l_aux, stats = _moe_mlp(h, mp, cfg, training=True, mesh=mesh)
+        else:
+            out, l_aux = _moe_mlp_nodrop(h, mp, cfg)
+            stats = _zero_drop_stats()
+        aux.append((l_aux, stats))
         return out
 
     attn_out, _, _ = _attn_half(x, p, cfg, positions)
     x = _residual_mlp(x, attn_out, p, cfg, mlp_fn=moe_fn)
-    return shard_constraint(x, BATCH_AXES, SEQ_AXIS, None), aux[0]
+    l_aux, stats = aux[0]
+    return shard_constraint(x, BATCH_AXES, SEQ_AXIS, None), l_aux, stats
 
 
-def moe_gpt_loss(params, batch, rng, cfg: MoEGPTConfig):
+def moe_gpt_loss(params, batch, rng, cfg: MoEGPTConfig, mesh=None):
     tokens = batch.get("tokens", batch.get("input_ids"))
     labels = batch.get("labels")
     if labels is None:
         inputs, labels = tokens[:, :-1], tokens[:, 1:]
     else:
         inputs = tokens
-    logits, l_aux = moe_gpt_forward(params, inputs, cfg, training=True, rng=rng)
+    logits, l_aux, stats = moe_gpt_forward(params, inputs, cfg, training=True,
+                                           rng=rng, mesh=mesh,
+                                           return_stats=True)
     logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
     safe = jnp.maximum(labels, 0)
     gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
     mask = (labels >= 0).astype(jnp.float32)
     nll = ((logz - gold) * mask).sum() / jnp.maximum(mask.sum(), 1.0)
-    return nll + cfg.moe_aux_weight * l_aux, {"lm_loss": nll, "l_aux": l_aux}
+    # slash-keyed entries flow to telemetry gauges (runtime/engine.py threads
+    # them through the grad path into `moe/*` — docs/profiling.md catalog)
+    aux = {"lm_loss": nll, "l_aux": l_aux,
+           "moe/aux_loss": l_aux,
+           "moe/overflow_tokens": stats["overflow_tokens"],
+           "moe/dropped_frac": stats["dropped_frac"]}
+    return nll + cfg.moe_aux_weight * l_aux, aux
 
 
-def make_moe_gpt_model(cfg: MoEGPTConfig, name="moe-gpt", seed=0) -> ModelSpec:
+def make_moe_gpt_model(cfg: MoEGPTConfig, name="moe-gpt", seed=0,
+                       mesh=None) -> ModelSpec:
+    """Pass ``mesh=`` to route expert dispatch through the comm facade's
+    all_to_all (shard_map over the expert axis) instead of the einsum path."""
     params = init_moe_gpt_params(cfg, seed=seed)
-    return ModelSpec(loss_fn=partial(moe_gpt_loss, cfg=cfg), params=params,
+    return ModelSpec(loss_fn=partial(moe_gpt_loss, cfg=cfg, mesh=mesh),
+                     params=params,
                      param_specs=moe_gpt_param_specs(cfg), has_aux=True,
                      apply_fn=partial(moe_gpt_forward, cfg=cfg, training=False),
                      name=name)
@@ -180,21 +278,19 @@ def make_moe_gpt_model(cfg: MoEGPTConfig, name="moe-gpt", seed=0) -> ModelSpec:
 
 
 def _moe_mlp_decode(x, mp, cfg):
-    """Single-token routing: x [B, 1, D]; every token goes to its argmax expert
-    (capacity-free — one token per step cannot overflow)."""
-    B, _, D = x.shape
-    xf = x.reshape(B, D)
-    logits = (xf @ mp["gate_w"]).astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)
-    top = jnp.argmax(probs, axis=-1)                       # [B]
-    gate = jnp.max(probs, axis=-1).astype(x.dtype)         # [B]
-    onehot = jax.nn.one_hot(top, cfg.num_experts, dtype=x.dtype)  # [B, E]
-    # dispatch every token to all experts' slots, mask by routing (E is small;
-    # trades E× FFN flops for static shapes — decode is bandwidth-bound anyway)
-    xe = jnp.einsum("be,bd->ebd", onehot, xf)              # [E, B, D]
-    ye = _expert_ffn(xe, mp, cfg)                          # [E, B, D]
-    out = jnp.einsum("be,ebd->bd", onehot, ye) * gate[:, None]
-    return out.reshape(B, 1, D)
+    """Single-token routing (kept for the contiguous decode path): the
+    [B, 1, D] special case of `_moe_mlp_nodrop`."""
+    out, _ = _moe_mlp_nodrop(x, mp, cfg)
+    return out
+
+
+def moe_cache_identity(cfg: MoEGPTConfig, name: str = "") -> str:
+    """`gpt_cache_identity` plus the MoE fields that change KV VALUES: expert
+    count and placement change every MoE layer's output, hence every later
+    layer's K/V. Capacity knobs are absent on purpose — inference routing is
+    capacity-free, so they cannot change cached bytes."""
+    return (f"moe:{cfg.num_experts}|{cfg.moe_freq}|"
+            + gpt_cache_identity(cfg, name))
 
 
 def make_moe_gpt_decode_model(cfg: MoEGPTConfig, params=None, name="moe-gpt", seed=0):
@@ -215,7 +311,7 @@ def make_moe_gpt_decode_model(cfg: MoEGPTConfig, params=None, name="moe-gpt", se
             vs.append(jnp.moveaxis(v, 1, 2))
             if lid in moe_ids:
                 mp = params["moe"][str(lid)]
-                moe_fn = lambda h, mp=mp: _moe_mlp(h, mp, cfg, training=False)[0]
+                moe_fn = lambda h, mp=mp: _moe_mlp_nodrop(h, mp, cfg)[0]
                 x = _residual_mlp(x, attn_out, p, cfg, mlp_fn=moe_fn)
             else:
                 x = _residual_mlp(x, attn_out, p, cfg)
@@ -256,9 +352,68 @@ def make_moe_gpt_decode_model(cfg: MoEGPTConfig, params=None, name="moe-gpt", se
     def init_cache(batch_size, max_len, dtype=jnp.bfloat16):
         return init_kv_cache(cfg, batch_size, max_len, dtype)
 
+    # paged-pool serving contract (see DecodeModelSpec): same pool layout and
+    # attention machinery as gpt.py's paged path, but the stacked-layer scan
+    # becomes a Python loop — MoE layers are heterogeneous (per-layer expert
+    # trees), and the capacity-free routing keeps every chunking of a prompt
+    # token-identical, which is what continuous batching relies on.
+
+    def _loop_paged(params, x, pool, block_tables, positions, phase=None):
+        slices = []
+        for lid in range(cfg.n_layer):
+            p = jax.tree_util.tree_map(lambda a: a[lid], params["blocks"])
+            pool_l = {k: v[lid] for k, v in pool.items()}
+            attn_out, pool_l = _paged_attn_half(x, p, pool_l, positions,
+                                                block_tables, cfg, phase=phase)
+            if lid in moe_ids:
+                mp = params["moe"][str(lid)]
+                moe_fn = lambda h, mp=mp: _moe_mlp_nodrop(h, mp, cfg)[0]
+                x = _residual_mlp(x, attn_out, p, cfg, constrain=False,
+                                  mlp_fn=moe_fn)
+            else:
+                x = _residual_mlp(x, attn_out, p, cfg, constrain=False)
+            slices.append(pool_l)
+        pool = {k: jnp.stack([s[k] for s in slices], 0) for k in pool}
+        return x, pool
+
+    def prefill_paged_fn(params, tokens, start_pos, last_idx, pool,
+                         block_tables):
+        B, C = tokens.shape
+        positions = start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None]
+        x = _embed(params, tokens, positions, cfg)
+        x, pool = _loop_paged(params, x, pool, block_tables, positions)
+        last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)
+        logits = _lm_head(params, last, cfg)[:, 0]
+        return logits, pool
+
+    def decode_paged_fn(params, token, pos, pool, block_tables):
+        x = _embed(params, token[:, None], pos[:, None], cfg)
+        x, pool = _loop_paged(params, x, pool, block_tables, pos[:, None])
+        logits = _lm_head(params, x, cfg)[:, 0]
+        return logits, pool
+
+    def verify_paged_fn(params, tokens, pos, pool, block_tables):
+        B, C = tokens.shape
+        positions = pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None]
+        x = _embed(params, tokens, positions, cfg)
+        x, pool = _loop_paged(params, x, pool, block_tables, positions,
+                              phase="verify")
+        logits = _lm_head(params, x, cfg)
+        return logits, pool
+
+    def init_paged_pool(num_blocks, block_size, dtype=jnp.bfloat16,
+                        kv_group_size=0):
+        return init_paged_kv_pool(cfg, num_blocks, block_size, dtype,
+                                  kv_group_size)
+
     return DecodeModelSpec(prefill_fn=prefill_fn, decode_fn=decode_fn,
                            init_cache=init_cache, params=params,
-                           param_specs=moe_gpt_param_specs(cfg), name=name)
+                           param_specs=moe_gpt_param_specs(cfg), name=name,
+                           prefill_paged_fn=prefill_paged_fn,
+                           decode_paged_fn=decode_paged_fn,
+                           verify_paged_fn=verify_paged_fn,
+                           init_paged_pool=init_paged_pool,
+                           cache_fingerprint=moe_cache_identity(cfg, name))
 
 
 def _moe_block_decode(x, p, mp, cache_k, cache_v, pos, cfg):
@@ -267,3 +422,19 @@ def _moe_block_decode(x, p, mp, cache_k, cache_v, pos, cfg):
     x = _residual_mlp(x, attn_out, p, cfg, constrain=False,
                       mlp_fn=lambda h: _moe_mlp_decode(h, mp, cfg))
     return x, cache_k, cache_v
+
+
+def moe_expert_store(params, layer_id):
+    """One MoE layer's stacked expert tree as a `LayerParamStore` — experts
+    play the role of layers, so `LayerStreamer(..., cyclic=True)` stages
+    expert weights through a small HBM window exactly like PR 15's layer
+    streaming (expert weights are the ideal streamed tier: each token's
+    forward touches one expert, the rest are cold).
+
+    Returns (store, expert_tree) — `store.layer_params(e)`-style access comes
+    from the streamer; `expert_tree` is the [E, ...] source for parity checks.
+    """
+    from deepspeed_tpu.runtime.param_swap import LayerParamStore
+    mp = params["moe"][str(layer_id)]
+    expert_tree = {k: v for k, v in mp.items() if k != "gate_w"}
+    return LayerParamStore(expert_tree), expert_tree

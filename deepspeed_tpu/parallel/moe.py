@@ -6,10 +6,28 @@ Reference: `deepspeed/moe/` — `MoE` layer (`moe/layer.py:16`), `MOELayer` +
 (`utils/groups.py:113,207`).
 
 TPU-native formulation (GShard-style, fully static shapes): gating produces
-dispatch/combine tensors; token routing is einsum + a sharding constraint that
-puts the expert dimension on the `expert` mesh axis — XLA emits the all-to-all
-pair the reference issues by hand. Capacity overflow drops tokens by masking
-(no dynamic shapes under jit — the "hard part" called out in SURVEY §7).
+dispatch/combine tensors; capacity overflow drops tokens by masking (no
+dynamic shapes under jit — the "hard part" called out in SURVEY §7). Token
+routing runs one of three ways:
+
+  * **facade-routed** (`expert_parallel_moe`) — the first-class path when a
+    mesh with `expert` axis size > 1 is active: gating + dispatch/combine run
+    inside `shard_map`, and the expert exchange is two explicit
+    `comm/collectives.py` all_to_alls (the reference `_AllToAll` pair). The
+    facade records trace-time byte/call stats (`comm/all_to_all_bytes`) and
+    the wire is `WireTransform`-compressible (``dispatch_wire="int8"``).
+    Tokens shard over (data, zero, expert) jointly; experts shard over
+    `expert`. Each shard gates its own tokens against a *local* capacity
+    ``ceil(n_local/E · cf)`` — the reference's per-rank gating.
+  * **einsum fallback** — no mesh / ep==1 / a composition the shard_map path
+    does not cover (tensor- or sequence-sharded activations): dispatch is an
+    einsum plus a sharding constraint that puts the expert dim on the
+    `expert` mesh axis, and XLA emits the all-to-all pair itself (invisible
+    to the facade's byte accounting).
+  * **dropless** (`dropless_moe`) — no capacity, no drops: the Pallas token
+    sort kernel (`ops/pallas/token_sort.py`) ranks each token within its
+    expert's queue and tokens scatter into an [E, N] buffer (capacity = N is
+    the only static dropless bound; memory E·N·D — for moderate N).
 """
 
 import dataclasses
@@ -19,8 +37,12 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.comm.mesh import EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS, shard_constraint
+from deepspeed_tpu.comm import collectives as coll
+from deepspeed_tpu.comm.mesh import (BATCH_AXES, EXPERT_AXIS, SEQ_AXIS,
+                                     TENSOR_AXIS, shard_constraint)
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 
 def _capacity(num_tokens, num_experts, capacity_factor, min_capacity):
@@ -69,8 +91,15 @@ def top1_gating(logits, capacity_factor=1.0, min_capacity=4, noisy_gate_policy=N
 
 
 def top2_gating(logits, capacity_factor=1.0, min_capacity=4, rng=None):
-    """Top-2 gating (reference `top2gating`, `moe/sharded_moe.py:282`) with
-    renormalized top-2 weights and second-expert random tie-breaking jitter."""
+    """Top-2 gating (reference `top2gating`, `moe/sharded_moe.py:282`).
+
+    The second-expert tie-breaking jitter takes an **explicit** `jax.random`
+    key — no hidden seed state, so replay under the chaos/parity harnesses is
+    deterministic; ``rng=None`` means no jitter. Top-2 weights are
+    renormalized **after** the capacity drop: a token whose second expert
+    overflowed gives its full combine weight to the surviving expert (the
+    pre-drop renorm leaked the dropped expert's share to nobody).
+    """
     N, E = logits.shape
     C = _capacity(N, E, 2 * capacity_factor, min_capacity)
 
@@ -78,7 +107,13 @@ def top2_gating(logits, capacity_factor=1.0, min_capacity=4, rng=None):
     idx1 = jnp.argmax(gates, axis=-1)
     mask1 = _one_hot(idx1, E)
     gates_wo1 = gates * (1 - mask1)
-    idx2 = jnp.argmax(gates_wo1, axis=-1)
+    if rng is not None:
+        # jitter only the *selection* of the second expert (reference RSample);
+        # combine weights below still come from the clean gate probabilities.
+        noisy = gates_wo1 + jax.random.gumbel(rng, gates_wo1.shape) * 1e-2
+        idx2 = jnp.argmax(jnp.where(mask1 > 0, -jnp.inf, noisy), axis=-1)
+    else:
+        idx2 = jnp.argmax(gates_wo1, axis=-1)
     mask2 = _one_hot(idx2, E)
 
     me = jnp.mean(gates, axis=0)
@@ -90,8 +125,9 @@ def top2_gating(logits, capacity_factor=1.0, min_capacity=4, rng=None):
     keep1 = (pos1 <= C) & (mask1 > 0)
     keep2 = (pos2 <= C) & (mask2 > 0)
 
-    g1 = jnp.sum(gates * mask1, axis=-1)
-    g2 = jnp.sum(gates * mask2, axis=-1)
+    # renormalize over the experts that *survived* the capacity drop
+    g1 = jnp.sum(gates * mask1, axis=-1) * jnp.any(keep1, axis=-1)
+    g2 = jnp.sum(gates * mask2, axis=-1) * jnp.any(keep2, axis=-1)
     denom = jnp.maximum(g1 + g2, 1e-9)
     g1, g2 = g1 / denom, g2 / denom
 
@@ -108,6 +144,189 @@ def top2_gating(logits, capacity_factor=1.0, min_capacity=4, rng=None):
     return l_aux, dispatch, combine, exp_counts
 
 
+def gating_drop_stats(dispatch, exp_counts):
+    """Capacity-overflow accounting from a gating result.
+
+    Returns f32 scalars {routed, kept, overflow_tokens, dropped_frac}:
+    `routed` = token→expert assignments the router made, `kept` = assignments
+    that fit under capacity, the rest overflowed (token masked to zero output
+    for top-1; weight renormalized away for top-2). These feed the `moe/*`
+    telemetry gauges.
+    """
+    routed = jnp.sum(exp_counts).astype(jnp.float32)
+    kept = jnp.sum(dispatch.astype(jnp.float32))
+    overflow = routed - kept
+    return {
+        "routed": routed,
+        "kept": kept,
+        "overflow_tokens": overflow,
+        "dropped_frac": overflow / jnp.maximum(routed, 1.0),
+    }
+
+
+# ----------------------------------------------------------------------
+# facade-routed expert dispatch (shard_map over the expert mesh axis)
+# ----------------------------------------------------------------------
+
+
+def _expert_token_axes(mesh):
+    """Mesh axes the flattened token dim shards over in the facade path."""
+    names = tuple(BATCH_AXES) + (EXPERT_AXIS,)
+    return tuple(a for a in names if a in mesh.shape)
+
+
+def can_use_expert_shard_map(mesh, num_experts, num_tokens):
+    """True iff `expert_parallel_moe` covers this (mesh, problem) combo:
+    expert axis > 1, experts and tokens divide evenly, and no tensor/
+    sequence/pipe sharding (those compositions stay on the einsum path)."""
+    if mesh is None:
+        return False
+    shape = dict(mesh.shape)
+    if shape.get(EXPERT_AXIS, 1) <= 1:
+        return False
+    if num_experts % shape[EXPERT_AXIS] != 0:
+        return False
+    token_axes = _expert_token_axes(mesh)
+    for name, size in shape.items():
+        if name not in token_axes and size != 1:
+            return False
+    n_shards = int(np.prod([shape[a] for a in token_axes]))
+    return num_tokens % n_shards == 0
+
+
+def expert_parallel_moe(flat, gate_w, expert_params, ffn_fn, mesh, *,
+                        num_experts, capacity_factor, min_capacity=4, k=1,
+                        noisy_gate_policy=None, rng=None,
+                        dispatch_wire="none",
+                        wire_group_size=coll.DEFAULT_GROUP_SIZE):
+    """Expert dispatch through the comm facade's instrumented all_to_all.
+
+    flat: [N, D] tokens (N sharded over data×zero×expert jointly); gate_w:
+    [D, E] (replicated); expert_params: pytree whose every leaf has leading
+    dim E (sharded over the `expert` axis inside the body); ffn_fn(xe,
+    local_params) maps [E_local, T, D] → [E_local, T, D] and must not issue
+    sharding constraints (it runs under manual sharding).
+
+    Per shard: local gating (capacity from the *local* token count) → dispatch
+    einsum [E, C, D] → facade all_to_all (split experts, concat capacity) →
+    local expert FFN → reverse all_to_all → combine. ``dispatch_wire="int8"``
+    quantizes both exchanges groupwise (ZeRO++ qgZ on activations).
+
+    Returns (out [N, D], l_aux, exp_counts [E], stats dict) — l_aux is the
+    shard-mean aux loss, counts/stats are summed over shards, all replicated.
+    """
+    N, D = flat.shape
+    E = num_experts
+    shape = dict(mesh.shape)
+    ep = shape.get(EXPERT_AXIS, 1)
+    if E % ep != 0:
+        raise ValueError(
+            f"expert_parallel_moe: num_experts={E} not divisible by expert "
+            f"axis size {ep}")
+    token_axes = _expert_token_axes(mesh)
+    n_shards = int(np.prod([shape[a] for a in token_axes]))
+    if N % n_shards != 0:
+        raise ValueError(
+            f"expert_parallel_moe: {N} tokens not divisible by the "
+            f"{n_shards}-way token sharding over mesh axes {token_axes}")
+    for name, size in shape.items():
+        if name not in token_axes and size != 1:
+            raise ValueError(
+                f"expert_parallel_moe: mesh axis {name!r} has size {size}; "
+                "tensor/sequence/pipe sharding composes via the einsum "
+                "fallback path, not the shard_map dispatch")
+
+    def local(flat_l, gate_w_l, eparams_l):
+        r = rng
+        if r is not None:
+            for a in token_axes:
+                r = jax.random.fold_in(r, jax.lax.axis_index(a))
+        logits = flat_l.astype(jnp.float32) @ gate_w_l.astype(jnp.float32)
+        if k == 1:
+            l_aux, dispatch, combine, counts = top1_gating(
+                logits, capacity_factor, min_capacity, noisy_gate_policy, r)
+        else:
+            l_aux, dispatch, combine, counts = top2_gating(
+                logits, capacity_factor, min_capacity, r)
+        drop = gating_drop_stats(dispatch, counts)
+
+        # [n_loc, E, C] x [n_loc, D] → [E, C, D] expert slots, then the wire:
+        # split the expert dim across the axis, concat peers' slots — each
+        # expert shard now holds its E/ep experts' tokens from every peer.
+        xe = jnp.einsum("nec,nd->ecd", dispatch.astype(flat_l.dtype), flat_l)
+        xe = coll.transform_all_to_all(
+            xe, EXPERT_AXIS, split_axis=0, concat_axis=1,
+            transform=dispatch_wire, group_size=wire_group_size,
+            out_dtype=flat_l.dtype)                    # [E/ep, ep*C, D]
+        ye = ffn_fn(xe, eparams_l)
+        ye = coll.transform_all_to_all(
+            ye, EXPERT_AXIS, split_axis=1, concat_axis=0,
+            transform=dispatch_wire, group_size=wire_group_size,
+            out_dtype=flat_l.dtype)                    # [E, C, D]
+        out = jnp.einsum("nec,ecd->nd", combine.astype(flat_l.dtype), ye)
+
+        l_aux = coll.pmean(l_aux, token_axes)
+        counts = coll.psum(counts, token_axes)
+        routed = coll.psum(drop["routed"], token_axes)
+        kept = coll.psum(drop["kept"], token_axes)
+        return out, l_aux, counts, routed, kept
+
+    ep_specs = jax.tree_util.tree_map(
+        lambda a: P(EXPERT_AXIS, *([None] * (a.ndim - 1))), expert_params)
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(token_axes, None), P(None, None), ep_specs),
+        out_specs=(P(token_axes, None), P(), P(), P(), P()),
+        check_vma=False)
+    out, l_aux, exp_counts, routed, kept = fn(flat, gate_w, expert_params)
+    stats = {
+        "routed": routed,
+        "kept": kept,
+        "overflow_tokens": routed - kept,
+        "dropped_frac": (routed - kept) / jnp.maximum(routed, 1.0),
+    }
+    return out, l_aux, exp_counts, stats
+
+
+# ----------------------------------------------------------------------
+# dropless variant (Pallas token sort)
+# ----------------------------------------------------------------------
+
+
+def dropless_moe(flat, gate_w, ffn_fn, num_experts, *, interpret=None):
+    """Capacity-free top-1 MoE: no token is ever dropped.
+
+    The Pallas token sort kernel ranks each token within its expert's queue
+    (stable counting sort); tokens scatter into an [E, N, D] buffer — N is
+    the only static capacity bound that can never overflow — and gather back
+    after the expert FFN. Memory is E·N·D, so this is for moderate N (the
+    capacity path is the at-scale default).
+
+    Returns (out [N, D], l_aux, exp_counts [E]).
+    """
+    from deepspeed_tpu.ops.pallas.token_sort import token_sort
+
+    N, D = flat.shape
+    E = num_experts
+    logits = flat.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)
+    gate_val = jnp.max(gates, axis=-1).astype(flat.dtype)
+
+    mask1 = _one_hot(expert_idx, E)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+    exp_counts = jnp.sum(mask1, axis=0)
+
+    pos, _counts = token_sort(expert_idx, E, interpret=interpret)
+    xe = jnp.zeros((E, N, D), flat.dtype).at[expert_idx, pos].set(flat)
+    xe = shard_constraint(xe, EXPERT_AXIS, None, None)
+    ye = ffn_fn(xe)
+    out = ye[expert_idx, pos] * gate_val[:, None]
+    return out, l_aux, exp_counts
+
+
 @dataclasses.dataclass
 class MoELayer:
     """Functional expert-parallel FFN layer.
@@ -115,7 +334,10 @@ class MoELayer:
     Params layout (stacked over experts, expert dim sharded on the `expert` axis):
       {"gate_w": [D, E], "wi": [E, D, F], "wo": [E, F, D]}  (+ optional biases)
 
-    Call: (params, x[B,S,D], rng) -> (y[B,S,D], l_aux, exp_counts)
+    Call: (params, x[B,S,D], rng) -> (y[B,S,D], l_aux, exp_counts). Pass
+    ``mesh=`` to route dispatch through the comm facade's all_to_all inside
+    shard_map (when `can_use_expert_shard_map` holds); otherwise the einsum
+    fallback runs. ``dropless=True`` switches to the token-sort path.
     """
     num_experts: int
     k: int = 1
@@ -125,6 +347,8 @@ class MoELayer:
     noisy_gate_policy: Optional[str] = None
     activation: Callable = jax.nn.gelu
     use_residual: bool = False     # residual MoE (DS-MoE paper)
+    dropless: bool = False         # token-sort scatter, no capacity drops
+    dispatch_wire: str = "none"    # WireTransform for the facade a2a pair
 
     def init_params(self, d_model, d_ff, seed=0, dtype=jnp.float32):
         rng = np.random.default_rng(seed)
@@ -143,7 +367,6 @@ class MoELayer:
         return p
 
     def param_specs(self):
-        from jax.sharding import PartitionSpec as P
         e, t = EXPERT_AXIS, TENSOR_AXIS
         specs = {
             "gate_w": P(None, None),
@@ -158,35 +381,51 @@ class MoELayer:
             specs["res_coef"] = P(None, None)
         return specs
 
-    def __call__(self, params, x, rng=None, training=True):
+    def _ffn(self, xe, p, constrain=True):
+        h = jnp.einsum("ecd,edf->ecf", xe, p["wi"]) + p["wi_b"][:, None, :]
+        h = self.activation(h)
+        if constrain:
+            h = shard_constraint(h, EXPERT_AXIS, None, TENSOR_AXIS)
+        return jnp.einsum("ecf,efd->ecd", h, p["wo"]) + p["wo_b"][:, None, :]
+
+    def __call__(self, params, x, rng=None, training=True, mesh=None):
         B, S, D = x.shape
         E = self.num_experts
         N = B * S
         flat = x.reshape(N, D)
-
-        logits = flat.astype(jnp.float32) @ params["gate_w"]
         cf = self.capacity_factor if training else self.eval_capacity_factor
-        if self.k == 1:
-            l_aux, dispatch, combine, exp_counts = top1_gating(
-                logits, cf, self.min_capacity, self.noisy_gate_policy, rng)
+        eparams = {k: params[k] for k in ("wi", "wi_b", "wo", "wo_b")}
+
+        if self.dropless:
+            y, l_aux, exp_counts = dropless_moe(
+                flat, params["gate_w"], lambda xe: self._ffn(xe, eparams), E)
+        elif can_use_expert_shard_map(mesh, E, N):
+            y, l_aux, exp_counts, _stats = expert_parallel_moe(
+                flat, params["gate_w"], eparams,
+                lambda xe, p: self._ffn(xe, p, constrain=False), mesh,
+                num_experts=E, capacity_factor=cf,
+                min_capacity=self.min_capacity, k=self.k,
+                noisy_gate_policy=self.noisy_gate_policy if training else None,
+                rng=rng if training else None,
+                dispatch_wire=self.dispatch_wire)
         else:
-            l_aux, dispatch, combine, exp_counts = top2_gating(
-                logits, cf, self.min_capacity, rng)
+            logits = flat.astype(jnp.float32) @ params["gate_w"]
+            if self.k == 1:
+                l_aux, dispatch, combine, exp_counts = top1_gating(
+                    logits, cf, self.min_capacity, self.noisy_gate_policy, rng)
+            else:
+                l_aux, dispatch, combine, exp_counts = top2_gating(
+                    logits, cf, self.min_capacity, rng)
 
-        # dispatch: [N,E,C] → expert inputs [E,C,D]; constraint puts E on the
-        # expert mesh axis (XLA all-to-all = reference _AllToAll, sharded_moe.py:95)
-        exp_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), flat)
-        exp_in = shard_constraint(exp_in, EXPERT_AXIS, None, None)
+            # dispatch: [N,E,C] → expert inputs [E,C,D]; constraint puts E on the
+            # expert mesh axis (XLA all-to-all = reference _AllToAll, sharded_moe.py:95)
+            exp_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), flat)
+            exp_in = shard_constraint(exp_in, EXPERT_AXIS, None, None)
+            out = self._ffn(exp_in, eparams)
+            out = shard_constraint(out, EXPERT_AXIS, None, None)
+            y = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), out)
 
-        h = jnp.einsum("ecd,edf->ecf", exp_in, params["wi"]) + params["wi_b"][:, None, :]
-        h = self.activation(h)
-        h = shard_constraint(h, EXPERT_AXIS, None, TENSOR_AXIS)
-        out = jnp.einsum("ecf,efd->ecd", h, params["wo"]) + params["wo_b"][:, None, :]
-        out = shard_constraint(out, EXPERT_AXIS, None, None)
-
-        y = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), out)
         y = y.reshape(B, S, D)
-
         if self.use_residual:
             mlp = self.activation(x @ params["res_wi"]) @ params["res_wo"]
             coef = jax.nn.softmax(x.astype(jnp.float32) @ params["res_coef"], axis=-1)
@@ -201,7 +440,6 @@ class MoE:
                  capacity_factor=1.0, eval_capacity_factor=1.0, min_capacity=4,
                  use_residual=False, noisy_gate_policy=None, drop_tokens=True,
                  use_rts=True, use_tutel=False, enable_expert_tensor_parallelism=False):
-        assert drop_tokens, "dropless MoE arrives with the pallas sort kernels"
         self.hidden_size = hidden_size
         self.num_experts = num_experts
         self.ep_size = ep_size
@@ -210,7 +448,8 @@ class MoE:
                               eval_capacity_factor=eval_capacity_factor,
                               min_capacity=min_capacity,
                               noisy_gate_policy=noisy_gate_policy,
-                              use_residual=use_residual)
+                              use_residual=use_residual,
+                              dropless=not drop_tokens)
 
     def init_params(self, d_ff, seed=0, dtype=jnp.float32):
         return self.layer.init_params(self.hidden_size, d_ff, seed=seed, dtype=dtype)
@@ -218,6 +457,6 @@ class MoE:
     def param_specs(self):
         return self.layer.param_specs()
 
-    def __call__(self, params, hidden_states, rng=None, used_token=None):
-        y, l_aux, exp_counts = self.layer(params, hidden_states, rng)
+    def __call__(self, params, hidden_states, rng=None, used_token=None, mesh=None):
+        y, l_aux, exp_counts = self.layer(params, hidden_states, rng, mesh=mesh)
         return y, l_aux, exp_counts

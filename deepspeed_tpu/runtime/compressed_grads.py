@@ -35,10 +35,20 @@ class ErrorFeedbackState(NamedTuple):
 
 
 def _sign_compress(x):
-    """1-bit quantization: sign(x) scaled so the L1 norm is preserved
-    (reference `compressed_allreduce` uses mean-|x| scaling per chunk)."""
-    scale = jnp.mean(jnp.abs(x))
-    return jnp.sign(x) * scale
+    """1-bit quantization: sign scaled so the L1 norm is preserved
+    (reference `compressed_allreduce` uses mean-|x| scaling per chunk).
+
+    Runs through the comm facade's onebit wire — a full
+    `onebit_encode`/`onebit_decode` roundtrip (`comm/collectives.py`), the
+    SAME code the compressed all-reduce sends over the slow axis — so the
+    error-feedback quantization rule lives in exactly one place. The wire
+    maps sign(0) → +1 (every value packs to one bit) where the old inline
+    `jnp.sign(x)*mean|x|` mapped it to 0; momenta are never exactly zero,
+    and the EF residual absorbs the difference when they are."""
+    from deepspeed_tpu.comm.collectives import onebit_decode, onebit_encode
+    flat = x.astype(jnp.float32).ravel()
+    packed, scale = onebit_encode(flat)
+    return onebit_decode(packed, scale, flat.shape[0]).reshape(x.shape)
 
 
 def error_feedback_compress(warmup_steps: int = 100):

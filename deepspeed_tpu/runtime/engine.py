@@ -701,7 +701,12 @@ class Engine:
         master_like = self.master_shardings
         return self.zero_policy.grad_shardings(None, self.param_shardings, master_like)
 
-    def _micro_grad_fn(self):
+    def _micro_grad_fn(self, with_extras=False):
+        """Per-micro-batch grad compute. With `with_extras` the standard
+        branch also surfaces slash-namespaced f32 scalars from the loss's aux
+        dict (e.g. `moe/aux_loss`, `moe/dropped_frac`) so the fused step can
+        merge them into the metrics/telemetry stream; the custom-backward
+        (pipeline) branch has no aux channel and returns `{}`."""
         loss_fn = self._loss_fn
         scaler = self.scaler
         custom_grad = getattr(self.model_spec, "grad_fn", None)
@@ -715,6 +720,8 @@ class Engine:
                                           scale_state)
                 grads = jax.tree_util.tree_map(
                     lambda g: g * scale.astype(g.dtype), grads)
+                if with_extras:
+                    return grads, loss, {}
                 return grads, loss
 
             return compute
@@ -724,7 +731,14 @@ class Engine:
                 loss, aux = loss_fn(p, micro_batch, rng)
                 return scaler.scale_loss(loss, scale_state), (loss, aux)
 
-            grads, (loss, _aux) = jax.grad(scaled, has_aux=True)(params)
+            grads, (loss, aux) = jax.grad(scaled, has_aux=True)(params)
+            if with_extras:
+                extras = {}
+                if isinstance(aux, dict):
+                    extras = {k: jnp.asarray(v, jnp.float32)
+                              for k, v in aux.items()
+                              if "/" in k and jnp.ndim(v) == 0}
+                return grads, loss, extras
             return grads, loss
 
         return compute
@@ -1026,11 +1040,14 @@ class Engine:
         assert name in table, f"unknown grad_accum_dtype {name!r}"
         return table[name]
 
-    def _make_grads_fn(self):
+    def _make_grads_fn(self, with_extras=False):
         """(params, batch, rng, scaler) -> (grads, loss): the gas-scan grad
         accumulation exactly as the fused step computes it (accumulator dtype,
         predivide, quantized-collective micro path). Shared by the fused
-        train step and the offload tier's split grads program."""
+        train step and the offload tier's split grads program. With
+        `with_extras` the return grows a third element: slash-namespaced f32
+        scalar metrics from the loss aux (mean over micro-batches at gas>1;
+        `{}` on the quantized micro path, which spans a shard_map)."""
         gas = self.gradient_accumulation_steps_value
         zcfg = self.config.zero_optimization
         wire = getattr(self, "_explicit_wire", None)
@@ -1052,18 +1069,26 @@ class Engine:
                         "explicit_grad_reduce: single-device data domain — "
                         "compressed wire disabled")
                 else:
-                    return self._explicit_grads_fn(wire, fast, slow)
+                    fn = self._explicit_grads_fn(wire, fast, slow)
+                    if with_extras and wire != "onebit":
+                        # explicit-collective path spans a shard_map: no
+                        # aux-metrics channel; keep the 3-tuple contract
+                        return lambda *a: fn(*a) + ({},)
+                    return fn
         wants_quantized = zcfg.zero_quantized_gradients or (
             zcfg.zero_quantized_weights and self.zero_stage == 3)
         if wants_quantized and getattr(self.model_spec, "grad_fn", None) is None:
-            micro_grad = self._quantized_micro_grad_fn()
+            qmicro = self._quantized_micro_grad_fn()
+
+            def micro_grad(*a):
+                return qmicro(*a) + ({},)
         else:
             if wants_quantized:
                 logger.warning(
                     "zero_quantized_gradients/weights ignored: model supplies "
                     "a custom grad_fn (pipeline 1F1B) which computes its own "
                     "backward pass")
-            micro_grad = self._micro_grad_fn()
+            micro_grad = self._micro_grad_fn(with_extras=True)
         grad_shardings = self._grad_shardings()
         predivide = self.config.gradient_predivide_factor or 1.0
 
@@ -1073,21 +1098,24 @@ class Engine:
 
                 def body(carry, micro_batch):
                     g_acc, loss_acc, i = carry
-                    g, l = micro_grad(params, micro_batch, jax.random.fold_in(rng, i),
-                                      scaler_state)
+                    g, l, e = micro_grad(params, micro_batch,
+                                         jax.random.fold_in(rng, i),
+                                         scaler_state)
                     g_acc = jax.tree_util.tree_map(
                         lambda a, b: a + (b.astype(acc_dtype)
                                           / jnp.asarray(predivide, acc_dtype)),
                         g_acc, g)
-                    return (g_acc, loss_acc + l.astype(jnp.float32), i + 1), None
+                    return (g_acc, loss_acc + l.astype(jnp.float32), i + 1), e
 
                 zeros = jax.tree_util.tree_map(
                     lambda p: jnp.zeros(p.shape, acc_dtype), params)
                 zeros = jax.lax.with_sharding_constraint(zeros, grad_shardings)
-                (grads, loss_sum, _), _ = jax.lax.scan(
+                (grads, loss_sum, _), extras = jax.lax.scan(
                     body, (zeros, jnp.asarray(0.0, jnp.float32), 0), batch)
                 grads = jax.tree_util.tree_map(lambda g: g * (predivide / gas), grads)
                 loss = loss_sum / gas
+                # scan stacks per-micro extras along the leading axis
+                extras = {k: jnp.mean(v) for k, v in extras.items()}
             else:
                 # grads stay in compute dtype: they were already rounded to it
                 # by the backward pass, and bf16→f32 promotion inside the fused
@@ -1095,13 +1123,18 @@ class Engine:
                 # materialize an extra fp32 grad tree (1.4G at 350M, 3G at
                 # 760m; fp32 accumulation matters only ACROSS micro-batches,
                 # the gas>1 branch above)
-                grads, loss = micro_grad(params, batch, rng, scaler_state)
+                grads, loss, extras = micro_grad(params, batch, rng, scaler_state)
+            if with_extras:
+                return grads, loss, extras
             return grads, loss
 
         return grads_fn
 
     def _build_train_step(self):
-        grads_fn = self._make_grads_fn()
+        # the EF wire path returns the explicit-collective grads_fn (5-arg,
+        # no extras channel); the standard path threads slash-keyed loss-aux
+        # metrics (moe/* counters) through to the metrics dict
+        grads_fn = self._make_grads_fn(with_extras=self._comm_err is None)
         apply_grads = self._apply_grads_fn()
 
         if self._comm_err is not None:
@@ -1121,8 +1154,10 @@ class Engine:
 
         def train_step(state, batch):
             rng = jax.random.fold_in(state.rng, state.step)
-            grads, loss = grads_fn(state.params, batch, rng, state.scaler)
-            return apply_grads(state, grads, loss)
+            grads, loss, extras = grads_fn(state.params, batch, rng, state.scaler)
+            new_state, metrics = apply_grads(state, grads, loss)
+            metrics.update(extras)
+            return new_state, metrics
 
         return jax.jit(train_step,
                        donate_argnums=(0,),
@@ -1507,6 +1542,14 @@ class Engine:
         if count_micro:
             self.micro_steps += self.gradient_accumulation_steps_value
         self._last_metrics = metrics
+        if self.telemetry.enabled:
+            # slash-namespaced metrics (moe/aux_loss, moe/overflow_tokens, …)
+            # are model-emitted gauges; the fixed train/* set is handled by
+            # _record_step_telemetry
+            reg = self.telemetry.registry
+            for k, v in metrics.items():
+                if "/" in k:
+                    reg.gauge(k).set(float(v))
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         # overflow can only occur under fp16; avoid a host sync otherwise

@@ -72,6 +72,7 @@ LEDGER_GAUGES = (
     "draft_params_bytes", "draft_pool_bytes",
     "master_bytes", "opt_state_bytes",
     "offload_staged_bytes", "offload_host_bytes",
+    "moe_expert_params_bytes",
     "program_temp_bytes", "bytes_in_use", "peak_bytes", "capacity_bytes",
     "attributed_bytes", "unattributed_bytes", "headroom_frac",
 )
@@ -305,6 +306,7 @@ def plan_training(n_params, *, zero_stage=0, dp=1, tp=1, dtype="bfloat16",
                   grad_accum_dtype=None, offload_optimizer=False,
                   offload_param=False, offload_param_bytes=None,
                   offload_staging_layers=0, offload_layer_bytes=0,
+                  num_experts=0, ep_size=1, n_expert_params=0,
                   temp_bytes=0, capacity_bytes=0) -> MemoryPlan:
     """Model-state memory prediction per device — the ZeRO estimator.
 
@@ -331,10 +333,21 @@ def plan_training(n_params, *, zero_stage=0, dp=1, tp=1, dtype="bfloat16",
     estimate — and `offload_staging_layers` × `offload_layer_bytes` prices
     the device-side async staging window (lookahead+1 layers of weights in
     rotation) that the offloaded params still occupy.
+
+    MoE pricing: `n_expert_params` (of the `n_params` total, summed over
+    all `num_experts` experts) shards over the EXPERT axis — per-chip
+    expert bytes are `n_expert_params/ep_size` on top of whatever the
+    ZeRO/TP denominators already divide (expert leading dims carry
+    `P(expert, …)` specs — `models/moe_gpt.py` `moe_gpt_param_specs`).
+    The expert slice is listed as its own `moe_expert_params` device
+    category so the plan shows the sparse-capacity headroom directly.
     """
     n = int(n_params)
+    n_exp = min(int(n_expert_params), n)
+    n -= n_exp                        # dense remainder below
     dp = max(1, int(dp))
     tp = max(1, int(tp))
+    ep = max(1, int(ep_size))
     p_b = dtype_bytes(dtype)
     p_shard = tp * (dp if zero_stage >= 3 else 1)
     g_shard = tp * (dp if zero_stage >= 2 else 1)
@@ -365,6 +378,20 @@ def plan_training(n_params, *, zero_stage=0, dp=1, tp=1, dtype="bfloat16",
 
     master = n * 4 // o_shard if (master_weights and p_b < 4) else 0
     optim = n * 4 * max(0, int(optimizer_moments)) // o_shard
+
+    if n_exp:
+        # expert leaves shard their leading dim over the expert axis, on
+        # top of the ZeRO/TP denominators (specs: P(expert, …))
+        dev["moe_expert_params"] = n_exp * p_b // (p_shard * ep)
+        dev["grads"] += n_exp * g_b // (g_shard * ep)
+        if master_weights and p_b < 4:
+            master += n_exp * 4 // (o_shard * ep)
+        optim += n_exp * 4 * max(0, int(optimizer_moments)) // (o_shard * ep)
+        notes.append(
+            f"moe: {int(num_experts) or '?'} experts, "
+            f"{fmt_bytes(n_exp * p_b)} of expert weights shard /ep_size="
+            f"{ep} on the expert axis — per-chip expert params = "
+            f"{fmt_bytes(n_exp * p_b // (p_shard * ep))}")
     if offload_optimizer:
         if master:
             host["master"] = master
@@ -409,6 +436,31 @@ def estimate_zero3_model_states_mem_needs(total_params, num_devices=1,
     return plan
 
 
+def _expert_param_count(params, shardings) -> int:
+    """Parameters (elements, not bytes) whose sharding spec names the
+    `expert` axis — the slice `plan_training` prices per `ep_size`."""
+    import jax
+    import numpy as np
+    try:
+        leaves = jax.tree_util.tree_leaves(params)
+        shards = jax.tree_util.tree_leaves(shardings)
+        if len(leaves) != len(shards):
+            return 0
+    except Exception:
+        return 0
+
+    def mentions_expert(sh):
+        spec = getattr(sh, "spec", None) or ()
+        for entry in spec:
+            names = entry if isinstance(entry, tuple) else (entry,)
+            if "expert" in names:
+                return True
+        return False
+
+    return sum(int(np.prod(p.shape)) for p, s in zip(leaves, shards)
+               if mentions_expert(s))
+
+
 def plan_training_from_engine(engine, capacity_bytes=0,
                               temp_bytes=0) -> MemoryPlan:
     """Build the training plan from a live engine's config + mesh — the
@@ -421,6 +473,8 @@ def plan_training_from_engine(engine, capacity_bytes=0,
     axes = dict(zip(engine.mesh.axis_names, engine.mesh.devices.shape))
     dp = int(axes.get("data", 1)) * int(axes.get("zero", 1))
     tp = int(axes.get("tensor", 1))
+    ep = int(axes.get("expert", 1))
+    n_exp = _expert_param_count(engine.state.params, engine.param_shardings)
     z = cfg.zero_optimization
     off_o = z.offload_optimizer is not None and \
         z.offload_optimizer.device in ("cpu", "nvme")
@@ -432,6 +486,7 @@ def plan_training_from_engine(engine, capacity_bytes=0,
         master_weights=engine.state.master is not None,
         grad_accum_dtype=cfg.data_types.grad_accum_dtype,
         offload_optimizer=off_o, offload_param=off_p,
+        ep_size=ep, n_expert_params=n_exp,
         temp_bytes=temp_bytes, capacity_bytes=capacity_bytes)
 
 
@@ -1067,9 +1122,14 @@ class TrainMemScope(_MemScopeBase):
 
     def _categories(self):
         st = self.engine.state
+        info = {}
+        if isinstance(st.params, dict) and "moe" in st.params:
+            # a VIEW of params_bytes (the expert-weights slice the planner
+            # prices per ep_size), never added to the attribution sum
+            info["moe_expert_params_bytes"] = tree_bytes(st.params["moe"])
         return ({"params_bytes": tree_bytes(st.params),
                  "master_bytes": tree_bytes(st.master),
-                 "opt_state_bytes": tree_bytes(st.opt_state)}, {})
+                 "opt_state_bytes": tree_bytes(st.opt_state)}, info)
 
     def _program_args(self):
         if self._batch_example is None or \
@@ -1172,6 +1232,16 @@ def main(argv=None) -> int:
     ap.add_argument("--layer-bytes", type=float, default=0,
                     help="bit16 bytes of ONE layer's weights (with "
                          "--staging-layers: the staging window's unit)")
+    ap.add_argument("--num-experts", type=int, default=0,
+                    help="MoE: total expert count (informational in the "
+                         "plan notes; pair with --expert-params/--ep-size)")
+    ap.add_argument("--ep-size", type=int, default=1,
+                    help="MoE: expert-parallel axis size — expert weights "
+                         "shard /ep_size per chip on top of the ZeRO/TP "
+                         "denominators")
+    ap.add_argument("--expert-params", type=float, default=0,
+                    help="MoE: parameter count of ALL expert weights "
+                         "(a slice of --params; e.g. 8 experts x 50e6)")
     # serving planner
     ap.add_argument("--layers", type=int, default=0)
     ap.add_argument("--kv-heads", type=int, default=0)
@@ -1210,6 +1280,9 @@ def main(argv=None) -> int:
                                                   or None),
                              offload_staging_layers=args.staging_layers,
                              offload_layer_bytes=int(args.layer_bytes),
+                             num_experts=args.num_experts,
+                             ep_size=args.ep_size,
+                             n_expert_params=int(args.expert_params),
                              capacity_bytes=capacity)
         print(json.dumps(plan.to_dict()) if args.json else plan.render())
         return 0 if plan.fits is not False else 2

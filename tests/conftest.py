@@ -129,6 +129,16 @@ def pytest_configure(config):
                    "testing/chaos.py) — fast and CPU-harness-safe, rides "
                    "in tier-1; run it alone with pytest -m chaos)")
     config.addinivalue_line(
+        "markers", "moe: mixture-of-experts suite (tests/test_moe.py — "
+                   "top-1/top-2 gating + capacity math, facade-routed "
+                   "expert dispatch over the expert mesh axis vs the "
+                   "einsum oracle, Pallas token-sort kernel parity, "
+                   "dropless routing, MoE-GPT training telemetry, paged "
+                   "MoE serving, expert streaming/quant targets, memscope "
+                   "expert-placement planner parity) — fast and "
+                   "CPU-harness-safe, rides in tier-1; run it alone with "
+                   "pytest -m moe)")
+    config.addinivalue_line(
         "markers", "fabric: multi-process serving fabric suite "
                    "(tests/test_fabric.py — wire codec round-trips, "
                    "retry/backoff budgets, heartbeat-miss liveness with "

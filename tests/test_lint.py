@@ -403,6 +403,66 @@ def test_dt004_per_op_registration_loop_is_clean(tmp_path):
     assert "'run_schedule'" in report.findings[0].message
 
 
+def test_dt001_expert_dispatch_body_near_miss(tmp_path):
+    """The expert-dispatch shard_map body (`parallel/moe.py`, now in DT001
+    scope) does host-side capacity math on mesh-shape dicts (`int(np.ceil(
+    ...))` / `np.prod` over python lists) and trace-time wire accounting —
+    none of it syncs. A hot caller that pulls the dispatched output back
+    with `np.asarray` IS the stall and must be the only finding."""
+    report = lint_tree(tmp_path, {"deepspeed_tpu/parallel/moe.py": """
+        import jax
+        import numpy as np
+
+        _dispatch = jax.jit(lambda flat: flat)
+
+        def expert_parallel_moe(flat, mesh, token_axes, capacity_factor):
+            shape = dict(mesh.shape)
+            n_shards = int(np.prod([shape[a] for a in token_axes]))
+            cap = int(np.ceil(flat.shape[0] / n_shards * capacity_factor))
+            stats.record("all_to_all", cap * flat.dtype.itemsize, calls=2)
+
+            def local(flat_l):
+                r = jax.lax.axis_index(token_axes[0])   # traced, no sync
+                return flat_l * r
+
+            return shard_map(local, mesh=mesh)(flat)
+
+        def hot_combine(flat):
+            out = _dispatch(flat)
+            return np.asarray(out)        # sync on the dispatched output
+        """}, rules=["DT001"])
+    assert rules_of(report) == ["DT001"]
+    assert "'out'" in report.findings[0].message
+
+
+def test_dt004_dispatch_program_per_microbatch_vs_registered(tmp_path):
+    """The fixture pair for expert dispatch construction: a jitted
+    dispatch program re-built inside the micro-batch loop (collective-in-
+    loop) recompiles every pass and fires; the registered-program idiom —
+    built once at ctor/registration — is the sanctioned site and stays
+    silent."""
+    report = lint_tree(tmp_path, {"deepspeed_tpu/parallel/moe_disp.py": """
+        import jax
+
+        class MoEDispatch:
+            def __init__(self, local_fn, mesh):
+                self._program = jax.jit(             # once per process
+                    shard_map(local_fn, mesh=mesh))
+
+            def bad_train_pass(self, local_fn, mesh, micros):
+                outs = []
+                for mb in micros:
+                    fn = jax.jit(shard_map(local_fn, mesh=mesh))  # loop body
+                    outs.append(fn(mb))
+                return outs
+
+            def good_train_pass(self, micros):
+                return [self._program(mb) for mb in micros]
+        """}, rules=["DT004"])
+    assert rules_of(report) == ["DT004"]
+    assert "loop body" in report.findings[0].message
+
+
 def test_dt004_unhashable_static_default(tmp_path):
     report = lint_tree(tmp_path, {"deepspeed_tpu/models/s.py": """
         import jax

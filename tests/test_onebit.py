@@ -162,3 +162,26 @@ class TestEngineIntegration:
              "y": rng.normal(0, 1, (16, 16)).astype(np.float32)}
         losses = [float(eng.train_batch(b)) for _ in range(8)]
         assert losses[-1] < losses[0]
+
+
+class TestFacadeWireParity:
+    def test_sign_compress_is_the_facade_onebit_wire(self):
+        """_sign_compress now runs onebit_encode/decode (comm facade) — on
+        nonzero inputs it must be bit-identical to the inline sign*mean|x|
+        formula it replaced (the old 1-bit Adam compression rule)."""
+        from deepspeed_tpu.runtime.compressed_grads import _sign_compress
+        for seed, shape in ((0, (257,)), (1, (33, 7)), (2, (128,))):
+            x = jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+            old = jnp.sign(x) * jnp.mean(jnp.abs(x))
+            new = _sign_compress(x)
+            assert new.shape == x.shape and new.dtype == jnp.float32
+            np.testing.assert_array_equal(np.asarray(new), np.asarray(old))
+
+    def test_sign_compress_zero_maps_to_plus_scale(self):
+        """The wire packs sign(0) as +1 (one bit per value); the EF residual
+        carries the difference — pin the convention so a silent flip of the
+        pack rule shows up here and not as a convergence regression."""
+        from deepspeed_tpu.runtime.compressed_grads import _sign_compress
+        x = jnp.asarray([0.0, -2.0, 2.0, 0.0], jnp.float32)
+        out = np.asarray(_sign_compress(x))
+        np.testing.assert_array_equal(out, [1.0, -1.0, 1.0, 1.0])
