@@ -1573,8 +1573,6 @@ def run_scaling_lane():
     fp→int8 grad-reduce wire-byte ratio — both arms run the SAME 2-hop
     reduce-scatter/all-gather structure, so the ratio isolates the wire
     encoding (analytic 4/(1+4/group) ≈ 3.94x at group 256; gate ≥ 3.5x)."""
-    import subprocess
-
     import jax
 
     ns = [int(s) for s in
@@ -1586,33 +1584,26 @@ def run_scaling_lane():
     nmax = max(ns)
 
     def arm(n, wire):
-        env = {k: v for k, v in os.environ.items()
-               if not k.startswith("BENCH_")}
+        from deepspeed_tpu.utils.subproc import run_self_child
+        overrides = {"BENCH_SCALING_ARM_CHILD": "1",
+                     "BENCH_SCALING_N": str(n),
+                     "BENCH_SCALING_WIRE": wire,
+                     "BENCH_SCALING_STEPS":
+                         os.environ.get("BENCH_SCALING_STEPS", "3"),
+                     "BENCH_SCALING_SEQ":
+                         os.environ.get("BENCH_SCALING_SEQ", "256"),
+                     "BENCH_SCALING_MBS":
+                         os.environ.get("BENCH_SCALING_MBS", "2")}
         if on_cpu:
-            env["XLA_FLAGS"] = _with_exact_device_count(
-                env.get("XLA_FLAGS", "").replace("\n", " "), n)
-            env.setdefault("JAX_PLATFORMS", "cpu")
-        env.update({"BENCH_SCALING_ARM_CHILD": "1",
-                    "BENCH_SCALING_N": str(n),
-                    "BENCH_SCALING_WIRE": wire,
-                    "BENCH_SCALING_STEPS":
-                        os.environ.get("BENCH_SCALING_STEPS", "3"),
-                    "BENCH_SCALING_SEQ":
-                        os.environ.get("BENCH_SCALING_SEQ", "256"),
-                    "BENCH_SCALING_MBS":
-                        os.environ.get("BENCH_SCALING_MBS", "2")})
-        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                              env=env, capture_output=True, text=True)
-        for line in reversed(proc.stdout.strip().splitlines()):
-            try:
-                cand = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if isinstance(cand, dict) and "metric" in cand:
-                return cand
-        sys.stderr.write(f"scaling arm dp{n}/{wire} failed:\n"
-                         + proc.stderr[-2000:])
-        return None
+            overrides["XLA_FLAGS"] = _with_exact_device_count(
+                os.environ.get("XLA_FLAGS", "").replace("\n", " "), n)
+            overrides.setdefault("JAX_PLATFORMS",
+                                 os.environ.get("JAX_PLATFORMS", "cpu"))
+        rec, proc = run_self_child(overrides, script=__file__, key="metric")
+        if rec is None:
+            sys.stderr.write(f"scaling arm dp{n}/{wire} failed:\n"
+                             + proc.stderr[-2000:])
+        return rec
 
     arms = {}
     for n in ns:
@@ -1846,47 +1837,35 @@ def run_bert_lane(steps=6, warmup=2):
     return result
 
 
+# child-lane dispatch: BENCH_<NAME>_CHILD=1 runs exactly one lane in this
+# process and exits — the parent half of the one-subprocess recipe
+# (deepspeed_tpu/utils/subproc.py) every sub-lane spawn goes through. A
+# new lane is one row here, not another copy-pasted branch.
+_CHILD_LANES = (
+    ("BENCH_BERT_CHILD",
+     lambda env: run_bert_lane(steps=int(env("BENCH_STEPS", "6")))),
+    ("BENCH_DECODE_CHILD",
+     lambda env: run_decode_lane(steps=int(env("BENCH_STEPS", "4")))),
+    ("BENCH_SERVING_CHILD", lambda env: run_serving_lane()),
+    ("BENCH_QUANT_CHILD", lambda env: run_quant_serving_lane()),
+    ("BENCH_PREFIX_CHILD", lambda env: run_prefix_cache_lane()),
+    ("BENCH_SPEC_CHILD", lambda env: run_spec_decode_lane()),
+    ("BENCH_ROUTER_CHILD", lambda env: run_router_lane()),
+    ("BENCH_ROBUST_CHILD", lambda env: run_robustness_lane()),
+    ("BENCH_FABRIC_CHILD", lambda env: run_fabric_lane()),
+    ("BENCH_OFFLOAD_CHILD", lambda env: run_offload_lane()),
+    ("BENCH_SCALING_ARM_CHILD", lambda env: run_scaling_arm()),
+    ("BENCH_SCALING_CHILD", lambda env: run_scaling_lane()),
+    ("BENCH_MOE_CHILD", lambda env: run_moe_lane()),
+)
+
+
 def main():
     env = os.environ.get
-    if env("BENCH_BERT_CHILD") == "1":   # bert sub-lane child process
-        run_bert_lane(steps=int(env("BENCH_STEPS", "6")))
-        return
-    if env("BENCH_DECODE_CHILD") == "1":  # decode sub-lane child process
-        run_decode_lane(steps=int(env("BENCH_STEPS", "4")))
-        return
-    if env("BENCH_SERVING_CHILD") == "1":  # serving sub-lane child process
-        run_serving_lane()
-        return
-    if env("BENCH_QUANT_CHILD") == "1":   # quantized-serving sub-lane child
-        run_quant_serving_lane()
-        return
-    if env("BENCH_PREFIX_CHILD") == "1":  # prefix-cache sub-lane child
-        run_prefix_cache_lane()
-        return
-    if env("BENCH_SPEC_CHILD") == "1":    # spec-decode sub-lane child
-        run_spec_decode_lane()
-        return
-    if env("BENCH_ROUTER_CHILD") == "1":  # serving-router sub-lane child
-        run_router_lane()
-        return
-    if env("BENCH_ROBUST_CHILD") == "1":  # robustness sub-lane child
-        run_robustness_lane()
-        return
-    if env("BENCH_FABRIC_CHILD") == "1":  # multi-process fabric child
-        run_fabric_lane()
-        return
-    if env("BENCH_OFFLOAD_CHILD") == "1":  # offload (Infinity tier) child
-        run_offload_lane()
-        return
-    if env("BENCH_SCALING_ARM_CHILD") == "1":  # one weak-scaling arm
-        run_scaling_arm()
-        return
-    if env("BENCH_SCALING_CHILD") == "1":  # scaling-efficiency sub-lane
-        run_scaling_lane()
-        return
-    if env("BENCH_MOE_CHILD") == "1":     # MoE vs iso-FLOPs dense sub-lane
-        run_moe_lane()
-        return
+    for flag, lane in _CHILD_LANES:
+        if env(flag) == "1":
+            lane(env)
+            return
     model_name = env("BENCH_MODEL", "gpt2-760m")
     import jax.numpy as jnp
     sm = {"fp32": jnp.float32, "bf16": jnp.bfloat16}[env("BENCH_SOFTMAX", "bf16")]
@@ -1908,21 +1887,12 @@ def main():
         # own the chip at a time. Pin EVERY lane knob (not just the overridden
         # ones): stray BENCH_* overrides meant for the headline must not
         # silently reshape a fixed lane config.
-        import subprocess
-        child_env = {k: v for k, v in os.environ.items()
-                     if not k.startswith("BENCH_")}
-        child_env.update({"BENCH_NORTH_STAR": "0", **overrides})
-        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                              env=child_env, capture_output=True, text=True)
-        for line in reversed(proc.stdout.strip().splitlines()):
-            try:
-                cand = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if isinstance(cand, dict) and "metric" in cand:
-                return cand
-        sys.stderr.write(f"{name} lane failed:\n" + proc.stderr[-2000:])
-        return None
+        from deepspeed_tpu.utils.subproc import run_self_child
+        rec, proc = run_self_child({"BENCH_NORTH_STAR": "0", **overrides},
+                                   script=__file__, key="metric")
+        if rec is None:
+            sys.stderr.write(f"{name} lane failed:\n" + proc.stderr[-2000:])
+        return rec
 
     north = None
     if env("BENCH_NORTH_STAR", "1") == "1" and "BENCH_MODEL" not in os.environ:

@@ -55,11 +55,15 @@ _STATIC_DYNAMIC_NAMES = (
 
 def _dynamic_names() -> set:
     """Runtime-composed metric names (imports the package, lazily)."""
+    from deepspeed_tpu.autotuning.session import TUNE_COUNTERS
     from deepspeed_tpu.comm import collectives as coll_mod
     from deepspeed_tpu.serving import Autoscaler, ServingRouter
     from deepspeed_tpu.telemetry import memscope as memscope_mod
     dynamic = {f"router/{k}"
                for k in ServingRouter(replicas=[]).counters}
+    # tune-session counters ride one f-string (`tune/{name}`); the live
+    # tuple is the enumeration, so growing it grows this check
+    dynamic |= {f"tune/{k}" for k in TUNE_COUNTERS}
     # autoscaler decisions ride one f-string (`fabric/{name}`); enumerate
     # the live counter set so the catalog cannot drift from it
     dynamic |= {f"fabric/{k}"

@@ -1,4 +1,25 @@
 from deepspeed_tpu.autotuning.autotuner import Autotuner
+from deepspeed_tpu.autotuning.measure import (VirtualClock, measure_serving,
+                                              measure_training, ragged_trace,
+                                              run_trial_child)
+from deepspeed_tpu.autotuning.objectives import (Objective,
+                                                 ServingSLOObjective,
+                                                 ServingThroughputObjective,
+                                                 TrainMFUObjective,
+                                                 TrainThroughputObjective,
+                                                 make_objective)
+from deepspeed_tpu.autotuning.planner import (PruneEntry, ledger_counts,
+                                              plan_candidate, prune)
+from deepspeed_tpu.autotuning.session import (TUNE_COUNTERS, TuneSession,
+                                              artifact_json,
+                                              environment_fingerprint,
+                                              load_tuned_config,
+                                              write_artifact)
+from deepspeed_tpu.autotuning.space import (Knob, ModelProfile, SearchSpace,
+                                            apply_overrides,
+                                            check_constraints,
+                                            default_serving_space,
+                                            default_training_space)
 from deepspeed_tpu.autotuning.tuner import (BaseTuner, CostModel,
                                             GridSearchTuner, ModelBasedTuner,
                                             RandomTuner, make_tuner)

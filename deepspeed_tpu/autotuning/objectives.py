@@ -1,0 +1,132 @@
+"""Tuning objectives — how a measured trial becomes one number.
+
+Every objective scores a measurement record (the JSON dict a trial run
+returns, `autotuning/measure.py`) into a single higher-is-better float —
+the tuner protocol's currency (`tuner.py` `run_fn`). Throughput objectives
+are the plain rates; the SLO objective is the serving one that matters in
+deployments: meet the declared TTFT/TPOT p99 targets (read from the PR 5
+latency histograms over a replayed trace), THEN maximize throughput. An
+SLO violation scores strictly below every SLO-meeting config — a fast
+config that blows its tail latency can never win.
+"""
+
+from typing import Any, Dict, Optional
+
+
+class Objective:
+    """Base: `score(measurement) -> float` (higher is better; the caller
+    maps a failed/absent measurement to infeasible before scoring)."""
+
+    name = "objective"
+
+    def score(self, measurement: Dict[str, Any]) -> float:
+        raise NotImplementedError
+
+    def describe(self) -> Dict[str, Any]:
+        return {"name": self.name}
+
+
+class ServingThroughputObjective(Objective):
+    """Generated tokens per unit of engine time on the replayed trace."""
+
+    name = "serving_throughput"
+
+    def score(self, measurement):
+        return float(measurement.get("tokens_per_time", 0.0))
+
+
+class ServingSLOObjective(Objective):
+    """SLO-gated throughput: TTFT/TPOT p99 targets first, tokens/s second.
+
+    Both targets are in the measurement clock's milliseconds (the virtual
+    clock counts scheduler syncs, so a target of N means "p99 within N
+    syncs"; the wall clock makes them real milliseconds). A config meeting
+    every declared target scores its throughput; a violating config scores
+    the NEGATED worst violation ratio — ordering violators by how badly
+    they miss, strictly below all compliant configs.
+    """
+
+    name = "serving_slo"
+
+    def __init__(self, ttft_p99_ms: Optional[float] = None,
+                 tpot_p99_ms: Optional[float] = None):
+        self.ttft_p99_ms = ttft_p99_ms
+        self.tpot_p99_ms = tpot_p99_ms
+
+    def _violation(self, measurement) -> float:
+        lat = measurement.get("latency", {}) or {}
+        worst = 0.0
+        for target, key in ((self.ttft_p99_ms, "ttft_ms"),
+                            (self.tpot_p99_ms, "tpot_ms")):
+            if not target:
+                continue
+            hist = lat.get(key) or {}
+            p99 = hist.get("p99")
+            if p99 is None:
+                # no histogram = no evidence the SLO is met; a compliant
+                # config must prove it
+                worst = max(worst, 1.0)
+                continue
+            worst = max(worst, max(0.0, float(p99) / float(target) - 1.0))
+        return worst
+
+    def score(self, measurement):
+        v = self._violation(measurement)
+        if v > 0.0:
+            return -v
+        return float(measurement.get("tokens_per_time", 0.0))
+
+    def describe(self):
+        return {"name": self.name, "ttft_p99_ms": self.ttft_p99_ms,
+                "tpot_p99_ms": self.tpot_p99_ms}
+
+
+class TrainThroughputObjective(Objective):
+    """Training samples (or tokens) per second, as the trial measured it."""
+
+    name = "train_throughput"
+
+    def score(self, measurement):
+        return float(measurement.get("samples_per_sec", 0.0))
+
+
+class TrainMFUObjective(Objective):
+    """Model-flops utilization when the trial exports it, falling back to
+    throughput (an MFU comparison needs the telemetry MFU gauge; trials
+    without it still rank consistently by rate)."""
+
+    name = "train_mfu"
+
+    def score(self, measurement):
+        mfu = measurement.get("mfu")
+        if mfu is not None:
+            return float(mfu)
+        return float(measurement.get("samples_per_sec", 0.0))
+
+
+OBJECTIVES = {
+    "throughput": ServingThroughputObjective,
+    "slo": ServingSLOObjective,
+    "train_throughput": TrainThroughputObjective,
+    "mfu": TrainMFUObjective,
+    # canonical `Objective.name` spellings, so an artifact's `objective`
+    # block (written by describe()) round-trips through make_objective
+    "serving_throughput": ServingThroughputObjective,
+    "serving_slo": ServingSLOObjective,
+    "train_mfu": TrainMFUObjective,
+}
+
+
+def make_objective(spec) -> Objective:
+    """Build from a name or a {"name": ..., **kwargs} dict (the artifact's
+    `objective` block round-trips through this)."""
+    if isinstance(spec, Objective):
+        return spec
+    if isinstance(spec, str):
+        spec = {"name": spec}
+    spec = dict(spec or {})
+    name = spec.pop("name", "throughput")
+    if name not in OBJECTIVES:
+        raise ValueError(f"unknown objective '{name}' "
+                         f"(have {sorted(OBJECTIVES)})")
+    return OBJECTIVES[name](**spec)

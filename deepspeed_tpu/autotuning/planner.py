@@ -1,0 +1,215 @@
+"""Planner pruning — refuse candidates analytically, before anything exists.
+
+The reference autotuner discovers infeasible configs by RUNNING them (build
+the engine, catch the OOM). memscope's pre-flight planners (PR 10,
+`telemetry/memscope.py`) make that backwards for this stack: `plan_training`
+/ `plan_serving` price every candidate's resident bytes — including the
+int8-scale and expert-placement terms — with pure arithmetic, so predicted-
+OOM and low-headroom configs are refused before any allocation or compile.
+What survives goes to the measured stage; what doesn't is a ledger row with
+the reason, which is part of the tuned-config artifact, not a log line.
+"""
+
+import copy
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.autotuning.space import (ModelProfile, SearchSpace,
+                                            apply_overrides,
+                                            check_constraints)
+from deepspeed_tpu.telemetry.memscope import (MemoryPlan, dtype_bytes,
+                                              plan_serving, plan_training)
+
+
+@dataclasses.dataclass
+class PruneEntry:
+    """One ledger row: a candidate and what the planner decided about it."""
+    overrides: Dict[str, Any]
+    verdict: str                       # "kept" | "refused"
+    reason: str = ""                   # refusal reason ("" when kept)
+    stage: str = ""                    # "constraint" | "planner" | ""
+    predicted_peak_bytes: Optional[int] = None
+    headroom_frac: Optional[float] = None
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+def _merged(base_config: Dict[str, Any], overrides: Dict[str, Any]):
+    return apply_overrides(copy.deepcopy(dict(base_config or {})), overrides)
+
+
+def _dig(d: Dict[str, Any], path: str, default=None):
+    node = d
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return default
+        node = node[part]
+    return node
+
+
+def _weight_bytes(n_params: int, weights: str, group: int,
+                  param_dtype: str) -> int:
+    """Resident weight bytes under serving weight-only quantization —
+    mirrors `inference/quantization.py` pricing: int8 stores 1 byte per
+    element, int4 packs two per byte, both plus one f32 scale per
+    `group` elements (4/g bytes each)."""
+    w = str(weights or "off")
+    if w == "off":
+        return int(n_params) * dtype_bytes(param_dtype)
+    g = max(1, int(group) or 64)
+    per_elem = (1.0 if w == "int8" else 0.5) + 4.0 / g
+    return int(n_params * per_elem)
+
+
+def train_temp_margin(profile: ModelProfile, micro_batch: int,
+                      seq_len: int, dtype: str = "bfloat16") -> int:
+    """Activation-workspace margin for the training plan: one boundary
+    activation per layer (plus the embedding output) at the step dtype —
+    the remat floor. Deliberately a FLOOR, not a peak model: the planner
+    refuses on resident states + this margin; anything tighter goes to
+    the measured stage."""
+    seq = int(seq_len) or 1024
+    return int((profile.n_layer + 1) * max(1, int(micro_batch)) * seq
+               * profile.d_model * dtype_bytes(dtype))
+
+
+def plan_candidate(kind: str, profile: ModelProfile,
+                   base_config: Dict[str, Any], overrides: Dict[str, Any],
+                   capacity_bytes: int = 0, n_devices: int = 1,
+                   temp_bytes: Optional[int] = None) -> MemoryPlan:
+    """Price one candidate with the memscope planner. Pure arithmetic —
+    no jax import, no allocation, no compile."""
+    cfg = _merged(base_config, overrides)
+    if kind == "serving":
+        return _plan_serving_candidate(profile, cfg, capacity_bytes,
+                                       temp_bytes)
+    return _plan_training_candidate(profile, cfg, capacity_bytes,
+                                    n_devices, temp_bytes)
+
+
+def _plan_serving_candidate(profile, cfg, capacity_bytes, temp_bytes):
+    block = int(_dig(cfg, "kv_block_size", 512) or 512)
+    serving = _dig(cfg, "serving", {}) or {}
+    max_slots = int(serving.get("max_slots", 8) or 8)
+    max_context = int(serving.get("max_context", 0) or
+                      _dig(cfg, "max_out_tokens", 1024) or 1024)
+    nb = max(1, math.ceil(max_context / block))
+    num_blocks = int(serving.get("num_kv_blocks", 0) or
+                     (max_slots * nb + 1))
+    quant = serving.get("quantization", {}) or {}
+    kv_dtype = str(quant.get("kv_cache_dtype", "") or
+                   _dig(cfg, "kv_cache_dtype", "bfloat16") or "bfloat16")
+    kv_group = int(quant.get("kv_group_size", 0) or 0)
+    param_dtype = str(_dig(cfg, "dtype", "bfloat16") or "bfloat16")
+    params_bytes = _weight_bytes(profile.n_params,
+                                 quant.get("weights", "off"),
+                                 quant.get("weight_group_size", 64),
+                                 param_dtype)
+    tp = int(_dig(cfg, "tensor_parallel.tp_size", 1) or 1)
+    sp = int(_dig(cfg, "mesh.sequence", 1) or 1)
+    draft = None
+    drafter = str(_dig(cfg, "serving.spec_decode.drafter", "off") or "off")
+    if drafter == "model" and profile.draft:
+        draft = dict(profile.draft)
+    return plan_serving(
+        n_layer=profile.n_layer, n_kv_head=profile.n_kv_head,
+        head_dim=profile.head_dim, kv_block_size=block,
+        num_kv_blocks=num_blocks, kv_cache_dtype=kv_dtype,
+        kv_group_size=kv_group, params_bytes=params_bytes, tp=tp,
+        sequence_parallel=sp, draft=draft,
+        temp_bytes=int(temp_bytes or 0), capacity_bytes=int(capacity_bytes))
+
+
+def _plan_training_candidate(profile, cfg, capacity_bytes, n_devices,
+                             temp_bytes):
+    zero = _dig(cfg, "zero_optimization", {}) or {}
+    stage = int(zero.get("stage", 0) or 0)
+    tp = int(_dig(cfg, "mesh.tensor", 1) or 1)
+    sp = int(_dig(cfg, "mesh.sequence", 1) or 1)
+    pp = int(_dig(cfg, "mesh.pipe", 1) or 1)
+    ep = int(_dig(cfg, "mesh.expert", 1) or 1)
+    dp = int(_dig(cfg, "mesh.data", 0) or 0)
+    if dp <= 0:
+        dp = max(1, int(n_devices) // max(1, tp * sp * pp))
+    dtype = "bfloat16" if _dig(cfg, "bf16.enabled") else (
+        "float16" if _dig(cfg, "fp16.enabled") else
+        str(_dig(cfg, "data_types.param_dtype", "") or "float32"))
+    off_opt = str(_dig(cfg, "zero_optimization.offload_optimizer.device",
+                       "none") or "none") not in ("none", "")
+    off_param = str(_dig(cfg, "zero_optimization.offload_param.device",
+                         "none") or "none") not in ("none", "")
+    mbs = int(_dig(cfg, "train_micro_batch_size_per_gpu", 1) or 1)
+    if temp_bytes is None:
+        temp_bytes = train_temp_margin(profile, mbs, profile.max_seq_len,
+                                       dtype)
+    return plan_training(
+        profile.n_params, zero_stage=stage, dp=dp, tp=tp, dtype=dtype,
+        grad_accum_dtype=_dig(cfg, "data_types.grad_accum_dtype"),
+        offload_optimizer=off_opt, offload_param=off_param,
+        num_experts=profile.num_experts, ep_size=ep,
+        n_expert_params=profile.n_expert_params,
+        temp_bytes=int(temp_bytes), capacity_bytes=int(capacity_bytes))
+
+
+def prune(space: SearchSpace, profile: ModelProfile,
+          base_config: Optional[Dict[str, Any]] = None,
+          capacity_bytes: int = 0, min_headroom_frac: float = 0.0,
+          n_devices: int = 1, temp_bytes: Optional[int] = None,
+          ) -> Tuple[List[Dict[str, Any]], List[PruneEntry]]:
+    """Score every candidate; return (survivor overrides, full ledger).
+
+    Two refusal stages, both symbolic: constraint rules (the stack's loud
+    refusals, `space.py`) first, then the memory plan — predicted OOM, or
+    headroom under `min_headroom_frac` of capacity. With no known
+    capacity (the CPU harness) the planner stage keeps everything and the
+    ledger still records each candidate's predicted peak."""
+    base = dict(base_config or {})
+    survivors: List[Dict[str, Any]] = []
+    ledger: List[PruneEntry] = []
+    for cand in space.candidates():
+        reason = check_constraints(space.kind, cand, profile=profile,
+                                   base=base, n_devices=n_devices)
+        if reason:
+            ledger.append(PruneEntry(cand, "refused", reason,
+                                     stage="constraint"))
+            continue
+        plan = plan_candidate(space.kind, profile, base, cand,
+                              capacity_bytes=capacity_bytes,
+                              n_devices=n_devices, temp_bytes=temp_bytes)
+        hf = plan.headroom_frac
+        if plan.fits is False:
+            ledger.append(PruneEntry(
+                cand, "refused",
+                f"predicted OOM: peak {plan.predicted_peak_bytes} > "
+                f"capacity {plan.capacity_bytes}", stage="planner",
+                predicted_peak_bytes=plan.predicted_peak_bytes,
+                headroom_frac=hf))
+            continue
+        if hf is not None and hf < float(min_headroom_frac):
+            ledger.append(PruneEntry(
+                cand, "refused",
+                f"headroom {hf:.1%} under the {min_headroom_frac:.1%} "
+                f"floor", stage="planner",
+                predicted_peak_bytes=plan.predicted_peak_bytes,
+                headroom_frac=hf))
+            continue
+        ledger.append(PruneEntry(cand, "kept",
+                                 predicted_peak_bytes=plan.predicted_peak_bytes,
+                                 headroom_frac=hf))
+        survivors.append(cand)
+    return survivors, ledger
+
+
+def ledger_counts(ledger: List[PruneEntry]) -> Dict[str, int]:
+    out = {"candidates": len(ledger), "kept": 0,
+           "constraint_refused": 0, "planner_refused": 0}
+    for e in ledger:
+        if e.verdict == "kept":
+            out["kept"] += 1
+        elif e.stage == "constraint":
+            out["constraint_refused"] += 1
+        else:
+            out["planner_refused"] += 1
+    return out

@@ -1,0 +1,253 @@
+"""TuneSession — the whole-stack tuning pipeline, and its artifact.
+
+One session runs the three stages in order over a `SearchSpace`:
+constraint refusals (the stack's loud ValueErrors, evaluated symbolically),
+planner pruning (memscope's analytic memory plans — predicted OOM and
+low-headroom candidates never construct anything), and the measured stage
+(the seed GridSearch/Random/ModelBased tuners re-targeted: survivors are
+their experiment list, a short trace replay is their `run_fn`). A baseline
+measurement of the UNMODIFIED base config on the same trace anchors the
+winner's claim — "beats the stack defaults" is in the artifact, not in a
+README sentence.
+
+The artifact is the deliverable: one sorted-keys JSON document holding the
+search space, the full prune ledger, every trial's measurement, the
+baseline, the winner (overrides + the full merged config `initialize()` /
+`init_inference()` consume directly — `load_tuned_config` / the config
+loaders unwrap it), and an environment fingerprint. No timestamps, no
+floats from wall clocks (virtual-clock trials): two runs with the same
+seed and trace serialize byte-identically.
+"""
+
+import copy
+import hashlib
+import json
+import pathlib
+import sys
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu.autotuning.planner import ledger_counts, prune
+from deepspeed_tpu.autotuning.space import (ModelProfile, SearchSpace,
+                                            apply_overrides)
+from deepspeed_tpu.autotuning.objectives import Objective, make_objective
+from deepspeed_tpu.autotuning.tuner import make_tuner
+from deepspeed_tpu.utils.logging import logger
+
+ARTIFACT_MARKER = "dstpu_tune"       # top-level key marking a tuned artifact
+ARTIFACT_VERSION = 1
+
+# the tune/* counters the session emits through the registry; recorded via
+# one f-string loop, so analysis/rules_catalog.py enumerates THIS tuple —
+# growing it grows the docs/profiling.md catalog check automatically
+TUNE_COUNTERS = ("candidates", "constraint_refused", "planner_refused",
+                 "planner_kept", "trials", "trial_failures")
+
+# measurement keys that vary run-to-run even under the virtual clock
+# (host timing); stripped from artifact records so reproducibility is
+# byte-exact, kept in the records handed back to callers
+_VOLATILE_KEYS = ("wall_s",)
+
+
+def environment_fingerprint() -> Dict[str, Any]:
+    """Where the measurements came from — enough to refuse (or warn on)
+    replaying a tuned artifact somewhere it wasn't tuned. Deliberately
+    time-free: the fingerprint identifies the environment, not the run."""
+    import jax
+    import deepspeed_tpu
+    fp = {"platform": jax.default_backend(),
+          "device_count": jax.device_count(),
+          "device_kind": (jax.devices()[0].device_kind
+                          if jax.devices() else "?"),
+          "jax": jax.__version__,
+          "deepspeed_tpu": getattr(deepspeed_tpu, "__version__", "0"),
+          "python": "%d.%d" % sys.version_info[:2]}
+    blob = json.dumps(fp, sort_keys=True)
+    fp["sha256"] = hashlib.sha256(blob.encode()).hexdigest()[:16]
+    return fp
+
+
+def artifact_json(artifact: Dict[str, Any]) -> str:
+    """THE serialization: sorted keys, fixed indent, trailing newline.
+    Byte-identical artifacts are an acceptance criterion, so there is
+    exactly one way to write one."""
+    return json.dumps(artifact, sort_keys=True, indent=2,
+                      default=str) + "\n"
+
+
+def load_tuned_config(artifact, check_env: bool = False) -> Dict[str, Any]:
+    """The winner's full config dict out of an artifact (path, JSON text,
+    or dict). `check_env=True` refuses an artifact fingerprinted on a
+    different platform/device-count — measured knobs don't transfer."""
+    if isinstance(artifact, (str, pathlib.Path)):
+        p = pathlib.Path(artifact)
+        text = p.read_text() if p.exists() else str(artifact)
+        artifact = json.loads(text)
+    if not isinstance(artifact, dict) or ARTIFACT_MARKER not in artifact:
+        raise ValueError("not a dstpu_tune artifact (no "
+                         f"'{ARTIFACT_MARKER}' marker)")
+    if check_env:
+        import jax
+        env = artifact.get("environment", {})
+        here = (jax.default_backend(), jax.device_count())
+        there = (env.get("platform"), env.get("device_count"))
+        if there != (None, None) and here != there:
+            raise ValueError(
+                f"tuned artifact was measured on platform="
+                f"{there[0]} x{there[1]}, this is {here[0]} x{here[1]} — "
+                f"re-tune (or load with check_env=False)")
+    return copy.deepcopy(artifact["winner"]["config"])
+
+
+class TuneSession:
+    """One tuning run: space -> constraints -> planner -> measurements ->
+    artifact. `measure_fn(overrides) -> record` is the only harness
+    dependency (bind a trace + model factory with `functools.partial` or
+    use the CLI's built-ins), so train and serving tune identically."""
+
+    def __init__(self, space: SearchSpace, objective,
+                 measure_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
+                 profile: ModelProfile,
+                 base_config: Optional[Dict[str, Any]] = None,
+                 capacity_bytes: int = 0, min_headroom_frac: float = 0.0,
+                 n_devices: int = 1, tuner_type: str = "gridsearch",
+                 seed: int = 0, max_trials: Optional[int] = None,
+                 early_stopping: Optional[int] = None,
+                 trace: Optional[Dict[str, Any]] = None,
+                 telemetry=None):
+        self.space = space
+        self.objective: Objective = make_objective(objective)
+        self.measure_fn = measure_fn
+        self.profile = profile
+        self.base_config = copy.deepcopy(dict(base_config or {}))
+        self.capacity_bytes = int(capacity_bytes)
+        self.min_headroom_frac = float(min_headroom_frac)
+        self.n_devices = int(n_devices)
+        self.tuner_type = tuner_type
+        self.seed = int(seed)
+        self.max_trials = max_trials
+        self.early_stopping = early_stopping
+        self.trace = trace
+        self.telemetry = telemetry
+        self.trials: List[Dict[str, Any]] = []
+        self._baseline: Optional[Dict[str, Any]] = None
+
+    # ---- stages ------------------------------------------------------
+
+    def _score(self, record: Dict[str, Any]) -> Optional[float]:
+        if not record or not record.get("ok"):
+            return None
+        return float(self.objective.score(record))
+
+    def _run_trial(self, overrides: Dict[str, Any]) -> Optional[float]:
+        record = self.measure_fn(dict(overrides))
+        score = self._score(record)
+        self.trials.append({"overrides": dict(overrides),
+                            "measurement": record,
+                            "objective": score})
+        return score
+
+    def run(self, dry_run: bool = False) -> Dict[str, Any]:
+        """The pipeline. `dry_run=True` stops after the planner stage —
+        the ledger (and its counts) is the artifact's payload, with no
+        winner; nothing is allocated or compiled at all."""
+        self.trials = []
+        self._baseline = None
+        survivors, ledger = prune(
+            self.space, self.profile, self.base_config,
+            capacity_bytes=self.capacity_bytes,
+            min_headroom_frac=self.min_headroom_frac,
+            n_devices=self.n_devices)
+        counts = ledger_counts(ledger)
+        logger.info(
+            f"dstpu_tune: {counts['candidates']} candidates -> "
+            f"{counts['kept']} survive "
+            f"({counts['constraint_refused']} constraint-refused, "
+            f"{counts['planner_refused']} planner-refused) with zero "
+            f"allocations/compiles")
+
+        best_exp = best_val = baseline = None
+        if not dry_run and survivors:
+            tuner_kw = {}
+            if self.tuner_type in ("random", "model_based"):
+                tuner_kw["seed"] = self.seed
+            tuner = make_tuner(self.tuner_type, survivors, self._run_trial,
+                               **tuner_kw)
+            best_exp, best_val = tuner.tune(
+                n_trials=self.max_trials,
+                early_stopping=self.early_stopping)
+            # the stack-defaults anchor, on the same trace: an artifact
+            # that cannot show its winner beating {} is not a win
+            baseline_rec = self.measure_fn({})
+            baseline = {"overrides": {},
+                        "measurement": self._strip(baseline_rec),
+                        "objective": self._score(baseline_rec)}
+            self._baseline = baseline
+        return self._artifact(ledger, counts, best_exp, best_val, baseline,
+                              dry_run)
+
+    # ---- artifact ----------------------------------------------------
+
+    @staticmethod
+    def _strip(record):
+        if not isinstance(record, dict):
+            return record
+        return {k: v for k, v in record.items()
+                if k not in _VOLATILE_KEYS}
+
+    def _artifact(self, ledger, counts, best_exp, best_val, baseline,
+                  dry_run) -> Dict[str, Any]:
+        winner = None
+        if best_exp is not None:
+            winner = {"overrides": dict(best_exp),
+                      "objective": best_val,
+                      "config": apply_overrides(
+                          copy.deepcopy(self.base_config), best_exp)}
+        art = {
+            ARTIFACT_MARKER: ARTIFACT_VERSION,
+            "kind": self.space.kind,
+            "space": self.space.to_dict(),
+            "objective": self.objective.describe(),
+            "base_config": self.base_config,
+            "profile": self.profile.to_dict(),
+            "capacity_bytes": self.capacity_bytes,
+            "min_headroom_frac": self.min_headroom_frac,
+            "seed": self.seed,
+            "tuner_type": self.tuner_type,
+            "trace": self.trace,
+            "prune_ledger": {"counts": counts,
+                             "entries": [e.to_dict() for e in ledger]},
+            "trials": [{**t, "measurement": self._strip(t["measurement"])}
+                       for t in self.trials],
+            "baseline": baseline,
+            "winner": winner,
+            "dry_run": bool(dry_run),
+            "environment": environment_fingerprint(),
+        }
+        self._export_telemetry(counts)
+        return art
+
+    def _export_telemetry(self, counts):
+        tele = self.telemetry
+        if tele is None or not getattr(tele, "enabled", False):
+            return
+        measured = self.trials + ([self._baseline] if self._baseline else [])
+        trial_failures = sum(1 for t in measured if t["objective"] is None)
+        values = {"candidates": counts["candidates"],
+                  "constraint_refused": counts["constraint_refused"],
+                  "planner_refused": counts["planner_refused"],
+                  "planner_kept": counts["kept"],
+                  "trials": len(measured),
+                  "trial_failures": trial_failures}
+        for name in TUNE_COUNTERS:
+            tele.inc(f"tune/{name}", values[name])
+        best = max((t["objective"] for t in self.trials
+                    if t["objective"] is not None), default=None)
+        if best is not None:
+            tele.set_gauge("tune/best_objective", best)
+
+
+def write_artifact(artifact: Dict[str, Any], path) -> pathlib.Path:
+    p = pathlib.Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(artifact_json(artifact))
+    return p

@@ -1,0 +1,365 @@
+"""Declarative search spaces over the stack's config knobs.
+
+A `SearchSpace` is a list of `Knob`s — each a dotted config path plus its
+candidate values — whose deterministic cartesian product yields override
+dicts (`zero_stage` / `micro_batch` keep the seed Autotuner's special
+spelling; everything else is a dotted `TpuTrainConfig` /
+`TpuInferenceConfig` path like ``serving.quantization.kv_cache_dtype``).
+
+Constraint rules come FROM the stack, not next to it: every rule here
+mirrors a loud refusal some subsystem already raises (the ValueErrors
+pinned by `tests/test_tune.py::TestRefusalContracts`) so a candidate the
+stack would reject at build time is refused symbolically — same verdict,
+zero construction. Rules return a human-readable reason string (kept in
+the prune ledger) or None for "admissible".
+"""
+
+import dataclasses
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One axis of a search space: a dotted config path and its values."""
+    name: str
+    values: tuple
+
+    def __init__(self, name: str, values: Sequence[Any]):
+        object.__setattr__(self, "name", str(name))
+        object.__setattr__(self, "values", tuple(values))
+        if not self.values:
+            raise ValueError(f"knob '{name}' has no values")
+
+
+@dataclasses.dataclass
+class ModelProfile:
+    """The model facts the analytic planner needs — gathered once (the
+    reference autotuner's "model info profile run", here a pure read of
+    the model config: no forward pass, no allocation)."""
+    n_params: int
+    n_layer: int
+    n_head: int
+    n_kv_head: int
+    head_dim: int
+    d_model: int
+    vocab_size: int = 0
+    max_seq_len: int = 0
+    n_expert_params: int = 0
+    num_experts: int = 0
+    draft: Optional[Dict[str, Any]] = None   # drafter-model facts for
+                                             # spec_decode drafter="model"
+
+    @classmethod
+    def from_gpt_config(cls, cfg, n_params=None, draft=None):
+        """Profile a `models.gpt.GPTConfig` (or anything shaped like one).
+        `n_params` overrides the analytic dense-GPT estimate."""
+        n_kv = getattr(cfg, "n_kv_head", None) or cfg.n_head
+        hd = cfg.d_model // cfg.n_head
+        if n_params is None:
+            d_ff = getattr(cfg, "d_ff", None) or 4 * cfg.d_model
+            per_layer = (4 * cfg.d_model * cfg.d_model          # qkv+proj (MHA)
+                         + 2 * cfg.d_model * d_ff)              # mlp in/out
+            n_params = (cfg.vocab_size * cfg.d_model            # embedding
+                        + cfg.n_layer * per_layer)
+        return cls(n_params=int(n_params), n_layer=cfg.n_layer,
+                   n_head=cfg.n_head, n_kv_head=int(n_kv), head_dim=hd,
+                   d_model=cfg.d_model,
+                   vocab_size=getattr(cfg, "vocab_size", 0),
+                   max_seq_len=getattr(cfg, "max_seq_len", 0),
+                   draft=draft)
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+def apply_overrides(config: Dict[str, Any], overrides: Dict[str, Any]) -> Dict[str, Any]:
+    """Write an override dict into a config dict (in place, returned).
+
+    Same grammar as the seed `Autotuner._apply_exp`: `zero_stage` /
+    `micro_batch` are the special spellings, every other key is a dotted
+    path whose intermediate nodes are created as dicts."""
+    for k, v in overrides.items():
+        if k == "micro_batch":
+            config["train_micro_batch_size_per_gpu"] = v
+            continue
+        if k == "zero_stage":
+            config.setdefault("zero_optimization", {})["stage"] = v
+            continue
+        node = config
+        *parents, leaf = k.split(".")
+        for p in parents:
+            nxt = node.get(p)
+            if not isinstance(nxt, dict):
+                nxt = {}
+                node[p] = nxt
+            node = nxt
+        node[leaf] = v
+    return config
+
+
+class SearchSpace:
+    """A named cartesian product of knobs. `kind` is "train" or
+    "serving" — it selects the planner and the measurement harness."""
+
+    def __init__(self, kind: str, knobs: Sequence[Knob]):
+        if kind not in ("train", "serving"):
+            raise ValueError(f"search-space kind must be 'train' or "
+                             f"'serving', got {kind!r}")
+        names = [k.name for k in knobs]
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise ValueError(f"duplicate knobs in search space: {sorted(dupes)}")
+        self.kind = kind
+        self.knobs = list(knobs)
+
+    def __len__(self):
+        n = 1
+        for k in self.knobs:
+            n *= len(k.values)
+        return n
+
+    def candidates(self) -> List[Dict[str, Any]]:
+        """The full candidate list, in a deterministic order (knob order ×
+        value order — `itertools.product` with the declared sequences), so
+        grid search and the reproducibility contract are stable across
+        runs."""
+        names = [k.name for k in self.knobs]
+        return [dict(zip(names, combo))
+                for combo in itertools.product(*(k.values for k in self.knobs))]
+
+    def to_dict(self):
+        return {"kind": self.kind,
+                "knobs": [{"name": k.name, "values": list(k.values)}
+                          for k in self.knobs],
+                "size": len(self)}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["kind"], [Knob(k["name"], k["values"])
+                               for k in d.get("knobs", [])])
+
+
+# ----------------------------------------------------------------------
+# Constraint rules — one per loud refusal in the stack
+# ----------------------------------------------------------------------
+
+def _get(overrides: Dict[str, Any], base: Dict[str, Any], path: str,
+         default=None):
+    """Resolve a dotted path: overrides win, then the base config dict."""
+    if path in overrides:
+        return overrides[path]
+    node = base or {}
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return default
+        node = node[part]
+    return node
+
+
+def _is_streamed(overrides, base):
+    # ZeRO-Inference offloaded weights => the streamed serving mode
+    dev = _get(overrides, base, "zero.offload_param.device")
+    return bool(dev)
+
+
+def rule_streamed_spec_decode(kind, overrides, profile, base):
+    """scheduler.py: streamed serving has no verify contract."""
+    if kind != "serving" or not _is_streamed(overrides, base):
+        return None
+    drafter = str(_get(overrides, base, "serving.spec_decode.drafter",
+                       "off") or "off")
+    if drafter != "off":
+        return ("speculative decoding is a resident-engine feature — the "
+                "streamed (offloaded-weights) mode has no verify contract")
+    return None
+
+
+def rule_streamed_decode_window(kind, overrides, profile, base):
+    """scheduler.py: the K-step jitted window needs a resident stack."""
+    if kind != "serving" or not _is_streamed(overrides, base):
+        return None
+    window = int(_get(overrides, base, "serving.decode_steps_per_sync", 1)
+                 or 1)
+    if window != 1:
+        return (f"decode_steps_per_sync={window} needs the whole stack "
+                f"resident inside one jitted scan; the streamed mode "
+                f"streams layers per token")
+    return None
+
+
+def rule_onebit_dispatch_wire(kind, overrides, profile, base):
+    """collectives.py transform_all_to_all: the 1-bit wire is an
+    error-feedback gradient codec, not an activation codec."""
+    wire = _get(overrides, base, "moe.dispatch_wire")
+    if wire is None:
+        wire = _get(overrides, base, "moe.expert_parallel.dispatch_wire")
+    if str(wire or "none") == "onebit":
+        return ("moe dispatch_wire='onebit' — the 1-bit wire is an "
+                "error-feedback gradient codec, not an activation codec")
+    return None
+
+
+def rule_heads_divisible(kind, overrides, profile, base):
+    """ulysses.py: the head all-to-all scatters whole heads per rank —
+    heads must divide by tp*sp."""
+    if profile is None:
+        return None
+    tp = int(_get(overrides, base, "mesh.tensor", 1) or 1)
+    sp = int(_get(overrides, base, "mesh.sequence", 1) or 1)
+    if kind == "serving" and "mesh.tensor" not in overrides:
+        tp = int(_get(overrides, base, "tensor_parallel.tp_size", tp) or tp)
+    if tp * sp > 1 and profile.n_head % (tp * sp) != 0:
+        return (f"{profile.n_head} heads do not divide by tp*sp="
+                f"{tp * sp} — the sequence all-to-all scatters whole "
+                f"heads per rank")
+    kv = profile.n_kv_head or profile.n_head
+    if tp > 1 and kv % tp != 0:
+        return f"{kv} kv heads do not divide by tp={tp}"
+    return None
+
+
+def rule_int8_kv_needs_paged(kind, overrides, profile, base):
+    """engine.py _get_cache: the contiguous generate() cache has no scale
+    storage — int8 KV is a paged-pool serving feature. In a serving space
+    the quantization block is the right spelling; the engine-level
+    kv_cache_dtype knob set to int8 would refuse at the first
+    generate()."""
+    eng_dt = str(_get(overrides, base, "kv_cache_dtype", "") or "")
+    if kind == "train" and eng_dt == "int8":
+        return "kv_cache_dtype='int8' has no training meaning"
+    if eng_dt == "int8" and "kv_cache_dtype" in overrides:
+        return ("kv_cache_dtype='int8' on the engine quantizes the "
+                "contiguous generate() cache, which has no scale storage "
+                "— use serving.quantization.kv_cache_dtype")
+    return None
+
+
+def rule_kv_group_divides_head_dim(kind, overrides, profile, base):
+    """quantization.py: K/V scale groups tile head_dim exactly."""
+    if profile is None:
+        return None
+    g = int(_get(overrides, base, "serving.quantization.kv_group_size", 0)
+            or 0)
+    if g and profile.head_dim % g != 0:
+        return (f"kv_group_size={g} does not divide head_dim="
+                f"{profile.head_dim}")
+    return None
+
+
+def rule_model_drafter_needs_profile(kind, overrides, profile, base):
+    """spec_decode drafter='model' serves a second DecodeModelSpec — the
+    planner cannot price (and the harness cannot build) the draft mirror
+    without its profile."""
+    drafter = str(_get(overrides, base, "serving.spec_decode.drafter",
+                       "off") or "off")
+    if drafter == "model" and (profile is None or profile.draft is None):
+        return ("spec_decode drafter='model' needs a draft model profile "
+                "(none was provided)")
+    return None
+
+
+def rule_draft_k_without_drafter(kind, overrides, profile, base):
+    """Degenerate-duplicate pruning: draft_k has no effect with the
+    drafter off — keeping the variants would measure the same config
+    len(draft_k values) times."""
+    drafter = str(_get(overrides, base, "serving.spec_decode.drafter",
+                       "off") or "off")
+    if drafter != "off" or "serving.spec_decode.draft_k" not in overrides:
+        return None
+    k = int(overrides["serving.spec_decode.draft_k"])
+    default_k = 4
+    if k != default_k:
+        return (f"draft_k={k} is inert with the drafter off — duplicate "
+                f"of the default candidate")
+    return None
+
+
+def rule_mesh_matches_devices(kind, overrides, profile, base,
+                              n_devices=None):
+    """mesh.py init_mesh: the axis product must equal the device count
+    (one absorbing -1 axis excepted)."""
+    axes = {a: _get(overrides, base, f"mesh.{a}")
+            for a in ("data", "tensor", "sequence", "pipe", "expert")}
+    if all(v is None for v in axes.values()) or n_devices is None:
+        return None
+    vals = [int(v) for v in axes.values() if v is not None]
+    if any(v == -1 for v in vals):
+        fixed = 1
+        for v in vals:
+            if v != -1:
+                fixed *= v
+        if fixed == 0 or n_devices % fixed != 0:
+            return (f"mesh axes {axes} do not factor the "
+                    f"{n_devices}-device slice")
+        return None
+    prod = 1
+    for v in vals:
+        prod *= v
+    if prod != n_devices:
+        return (f"mesh axes product {prod} != device count {n_devices}")
+    return None
+
+
+DEFAULT_RULES = (
+    rule_streamed_spec_decode,
+    rule_streamed_decode_window,
+    rule_onebit_dispatch_wire,
+    rule_heads_divisible,
+    rule_int8_kv_needs_paged,
+    rule_kv_group_divides_head_dim,
+    rule_model_drafter_needs_profile,
+    rule_draft_k_without_drafter,
+)
+
+
+def check_constraints(kind, overrides, profile=None, base=None,
+                      rules=DEFAULT_RULES, n_devices=None) -> Optional[str]:
+    """First refusal reason among the rules, or None when admissible."""
+    base = base or {}
+    for rule in rules:
+        reason = rule(kind, overrides, profile, base)
+        if reason:
+            return f"{rule.__name__}: {reason}"
+    reason = rule_mesh_matches_devices(kind, overrides, profile, base,
+                                       n_devices=n_devices)
+    if reason:
+        return f"rule_mesh_matches_devices: {reason}"
+    return None
+
+
+# ----------------------------------------------------------------------
+# Default space builders
+# ----------------------------------------------------------------------
+
+def default_serving_space(num_kv_blocks=(0, 64, 128, 256),
+                          kv_block_size=(16, 32),
+                          kv_dtypes=("", "int8"),
+                          drafters=("off", "ngram"),
+                          prefix_caching=(False, True),
+                          windows=(1, 4)) -> SearchSpace:
+    """The serving knobs every PR since 4 added, as one space. The
+    defaults deliberately include candidates the planner/constraints must
+    refuse (oversized pools, inert draft_k variants) — the prune ledger
+    is the point, not an embarrassment."""
+    return SearchSpace("serving", [
+        Knob("serving.num_kv_blocks", num_kv_blocks),
+        Knob("kv_block_size", kv_block_size),
+        Knob("serving.quantization.kv_cache_dtype", kv_dtypes),
+        Knob("serving.spec_decode.drafter", drafters),
+        Knob("serving.enable_prefix_caching", prefix_caching),
+        Knob("serving.decode_steps_per_sync", windows),
+    ])
+
+
+def default_training_space(stages=(0, 1, 2, 3),
+                           micro_batches=(1, 2, 4, 8),
+                           grad_accum=(1, 2),
+                           offload_optimizer=(False, True)) -> SearchSpace:
+    return SearchSpace("train", [
+        Knob("zero_stage", stages),
+        Knob("micro_batch", micro_batches),
+        Knob("gradient_accumulation_steps", grad_accum),
+        Knob("zero_optimization.offload_optimizer.device",
+             tuple("cpu" if o else "none" for o in offload_optimizer)),
+    ])

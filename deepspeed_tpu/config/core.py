@@ -83,6 +83,23 @@ def issubclass_safe(t, parent):
         return False
 
 
+def maybe_unwrap_tuned(d):
+    """A dstpu_tune artifact (autotuning/session.py) handed where a config
+    dict is expected unwraps to its winner's full merged config — so
+    `initialize(config="tuned_config.json")` / `init_inference(config=...)`
+    consume the tuner's output directly. Anything else passes through."""
+    if isinstance(d, dict) and "dstpu_tune" in d:
+        winner = d.get("winner") or {}
+        cfg = winner.get("config")
+        if not isinstance(cfg, dict):
+            raise ValueError(
+                "dstpu_tune artifact has no winner config to load (a "
+                "--dry-run artifact holds only the prune ledger) — run the "
+                "measured stage, or extract a config by hand")
+        return copy.deepcopy(cfg)
+    return d
+
+
 # --------------------------------------------------------------------------------------
 # Feature blocks
 # --------------------------------------------------------------------------------------
@@ -634,7 +651,7 @@ class TpuTrainConfig(ConfigModel):
             with open(config) as f:
                 config = json.load(f)
         assert isinstance(config, dict), f"config must be dict/path/TpuTrainConfig, got {type(config)}"
-        config = copy.deepcopy(config)
+        config = copy.deepcopy(maybe_unwrap_tuned(config))
         return cls.from_dict(config)
 
     def dump(self):

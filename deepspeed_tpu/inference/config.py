@@ -263,7 +263,8 @@ class TpuInferenceConfig(ConfigModel):
         validators): `mp_size` is the deprecated tensor_parallel degree —
         silently ignoring it would serve tp=1 — plus torch-style dtype
         spellings and the retired `replace_method` knob."""
-        d = dict(d or {})
+        from deepspeed_tpu.config.core import maybe_unwrap_tuned
+        d = dict(maybe_unwrap_tuned(d or {}))
         if "mp_size" in d:
             tp = d.pop("mp_size")
             tpc = d.setdefault("tensor_parallel", {})

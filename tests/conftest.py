@@ -146,6 +146,15 @@ def pytest_configure(config):
                    "real kill -9 multi-process soak, autoscaler scale-up/"
                    "drain/reap, pool CLI units) — rides in tier-1; run it "
                    "alone with pytest -m fabric)")
+    config.addinivalue_line(
+        "markers", "tune: whole-stack autotuner suite (tests/test_tune.py "
+                   "— search-space determinism, constraint rules vs the "
+                   "stack's loud refusals, memscope planner pruning with "
+                   "ledger counts, SLO/throughput objectives, virtual-"
+                   "clock measured trials, reproducible tuned-config "
+                   "artifacts, the dstpu_tune CLI) — fast and CPU-harness-"
+                   "safe, rides in tier-1; run it alone with pytest -m "
+                   "tune)")
 
 
 # The slow tier, by measured duration (r5 full-suite run with --durations,

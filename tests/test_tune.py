@@ -1,0 +1,492 @@
+"""dstpu_tune — the planner-pruned whole-stack autotuner (autotuning/).
+
+Covers the three pipeline stages (constraint rules, memscope planner
+pruning, measured trials), the reproducible tuned-config artifact, the
+seed Autotuner's analytic preflight, the one-subprocess recipe
+(utils/subproc.py), and the loud-refusal contracts the constraint rules
+mirror (`TestRefusalContracts` — the stack ValueErrors and the symbolic
+rules must keep agreeing).
+"""
+
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning.measure import (VirtualClock, measure_serving,
+                                              ragged_trace, run_trial_child,
+                                              trace_requests)
+from deepspeed_tpu.autotuning.objectives import (ServingSLOObjective,
+                                                 make_objective)
+from deepspeed_tpu.autotuning.planner import (ledger_counts, plan_candidate,
+                                              prune)
+from deepspeed_tpu.autotuning.session import (ARTIFACT_MARKER, TuneSession,
+                                              artifact_json,
+                                              load_tuned_config)
+from deepspeed_tpu.autotuning.space import (Knob, ModelProfile, SearchSpace,
+                                            apply_overrides,
+                                            check_constraints,
+                                            default_serving_space,
+                                            default_training_space)
+from deepspeed_tpu.comm import mesh as mesh_mod
+from deepspeed_tpu.config.core import MeshConfig, TpuTrainConfig
+from deepspeed_tpu.inference.config import TpuInferenceConfig
+from deepspeed_tpu.inference.engine import init_inference
+from deepspeed_tpu.models.gpt import (GPTConfig, init_gpt_params,
+                                      make_gpt_decode_model,
+                                      make_gpt_layered_model)
+from deepspeed_tpu.utils.subproc import child_env, last_json_line
+
+pytestmark = pytest.mark.tune
+
+TINY = GPTConfig(n_layer=2, n_head=4, d_model=64, max_seq_len=256,
+                 vocab_size=256, dtype=jnp.float32, remat=False)
+PROFILE = ModelProfile.from_gpt_config(TINY)
+BASE = {"dtype": "float32", "kv_cache_dtype": "float32", "greedy": True,
+        "kv_block_size": 16, "max_out_tokens": 64,
+        "serving": {"max_slots": 4}}
+MiB = 1 << 20
+
+
+def _mk_mesh(**axes):
+    mesh_mod._CURRENT_MESH = None
+    mesh_mod._CURRENT_SPEC = None
+    return mesh_mod.init_mesh(MeshConfig(**{**dict(data=1, tensor=1,
+                                                   sequence=1, expert=1,
+                                                   pipe=1), **axes}))
+
+
+def _spec_factory():
+    return make_gpt_decode_model(cfg=TINY, name="tune-tiny")
+
+
+def _tiny_trace(**kw):
+    return ragged_trace(**{**dict(seed=3, n_requests=4, min_len=2,
+                                  max_len=12, max_new=4, vocab=256), **kw})
+
+
+# an oversized pool candidate next to the default-sized one: the planner
+# must refuse the former at 4 MiB capacity and keep the latter
+def _small_space():
+    return SearchSpace("serving", [
+        Knob("serving.num_kv_blocks", (0, 4096)),
+        Knob("serving.decode_steps_per_sync", (1, 4)),
+    ])
+
+
+# ----------------------------------------------------------------------
+# search spaces
+# ----------------------------------------------------------------------
+
+class TestSearchSpace:
+    def test_candidates_deterministic_and_complete(self):
+        s1, s2 = default_serving_space(), default_serving_space()
+        assert len(s1) == 128
+        c1, c2 = s1.candidates(), s2.candidates()
+        assert c1 == c2
+        assert len(c1) == len(s1)
+        # no duplicate candidates in the product
+        assert len({json.dumps(c, sort_keys=True) for c in c1}) == len(c1)
+
+    def test_roundtrip_through_dict(self):
+        s = default_training_space()
+        s2 = SearchSpace.from_dict(s.to_dict())
+        assert s2.kind == "train"
+        assert s2.candidates() == s.candidates()
+
+    def test_invalid_spaces_refused(self):
+        with pytest.raises(ValueError, match="no values"):
+            Knob("a", ())
+        with pytest.raises(ValueError, match="duplicate"):
+            SearchSpace("train", [Knob("a", (1,)), Knob("a", (2,))])
+        with pytest.raises(ValueError, match="kind"):
+            SearchSpace("inference", [Knob("a", (1,))])
+
+    def test_apply_overrides_seed_grammar(self):
+        cfg = {"zero_optimization": {"overlap_comm": True}, "a": 5}
+        apply_overrides(cfg, {"micro_batch": 4, "zero_stage": 2,
+                              "a.b": 1, "x.y.z": "cpu"})
+        assert cfg["train_micro_batch_size_per_gpu"] == 4
+        assert cfg["zero_optimization"] == {"overlap_comm": True, "stage": 2}
+        assert cfg["a"] == {"b": 1}          # non-dict intermediate replaced
+        assert cfg["x"] == {"y": {"z": "cpu"}}
+
+
+# ----------------------------------------------------------------------
+# constraint rules <-> the stack's loud refusals
+# ----------------------------------------------------------------------
+
+class TestRefusalContracts:
+    """Each constraint rule mirrors a ValueError some subsystem raises at
+    build/run time. Pin both sides: the stack refusal (exact behavior)
+    and the symbolic rule (same verdict, zero construction)."""
+
+    def test_onebit_dispatch_wire(self):
+        from deepspeed_tpu.comm.collectives import transform_all_to_all
+        with pytest.raises(ValueError, match="not an activation codec"):
+            transform_all_to_all(jnp.zeros((4, 4), jnp.float32), "expert",
+                                 split_axis=0, concat_axis=0,
+                                 transform="onebit")
+        reason = check_constraints("train", {"moe.dispatch_wire": "onebit"})
+        assert reason and "activation codec" in reason
+
+    def test_int8_kv_contiguous_generate(self):
+        _mk_mesh()
+        engine = init_inference(model=_spec_factory(),
+                                config={**BASE, "kv_cache_dtype": "int8"})
+        with pytest.raises(ValueError, match="paged-pool serving feature"):
+            engine.generate(np.asarray([[1, 2, 3]], np.int32),
+                            max_new_tokens=2)
+        reason = check_constraints("serving", {"kv_cache_dtype": "int8"})
+        assert reason and "serving.quantization" in reason
+        # the paged-pool spelling is admissible
+        assert check_constraints(
+            "serving",
+            {"serving.quantization.kv_cache_dtype": "int8"},
+            profile=PROFILE) is None
+
+    def test_streamed_resident_only_features(self):
+        _mk_mesh()
+        params = init_gpt_params(TINY, seed=0)
+        spec = make_gpt_layered_model(cfg=TINY, name="tune-spill",
+                                      params=params)
+        eng = init_inference(model=spec, config={
+            "dtype": "float32", "kv_cache_dtype": "float32", "greedy": True,
+            "zero": {"offload_param": {"device": "cpu"}}})
+        with pytest.raises(ValueError, match="[Ss]peculative"):
+            eng.serving(max_slots=2, max_context=64,
+                        spec_decode={"drafter": "ngram"})
+        with pytest.raises(ValueError, match="decode_steps_per_sync"):
+            eng.serving(max_slots=2, max_context=64, decode_steps_per_sync=4)
+        eng.release()
+        streamed = {"zero": {"offload_param": {"device": "cpu"}}}
+        r = check_constraints("serving",
+                              {"serving.spec_decode.drafter": "ngram"},
+                              base=streamed)
+        assert r and "resident" in r
+        r = check_constraints("serving",
+                              {"serving.decode_steps_per_sync": 4},
+                              base=streamed)
+        assert r and "resident" in r
+        # same overrides without the streamed base are admissible
+        assert check_constraints(
+            "serving", {"serving.decode_steps_per_sync": 4},
+            profile=PROFILE) is None
+
+    def test_ulysses_heads_divisibility(self):
+        if jax.device_count() < 2:
+            pytest.skip("needs >= 2 devices for a sequence axis")
+        from deepspeed_tpu.parallel.ulysses import ulysses_shard_map_attention
+        mesh = _mk_mesh(sequence=2, data=jax.device_count() // 2)
+        fn = ulysses_shard_map_attention(lambda q, k, v: q, mesh=mesh)
+        q = jnp.zeros((1, 4, 3, 8), jnp.float32)     # 3 heads, sp=2
+        with pytest.raises(ValueError, match=r"divisible by tp\*sp"):
+            fn(q, q, q)
+        _mk_mesh()
+        odd = ModelProfile(n_params=1, n_layer=1, n_head=3, n_kv_head=3,
+                           head_dim=8, d_model=24)
+        reason = check_constraints("serving", {"mesh.sequence": 2},
+                                   profile=odd)
+        assert reason and "whole heads" in reason
+        assert check_constraints("serving", {"mesh.sequence": 2},
+                                 profile=PROFILE) is None
+
+    def test_mesh_device_count_rule(self):
+        assert check_constraints("train", {"mesh.data": 3},
+                                 n_devices=8) is not None
+        assert check_constraints("train", {"mesh.data": -1, "mesh.tensor": 2},
+                                 n_devices=8) is None
+
+
+# ----------------------------------------------------------------------
+# planner pruning
+# ----------------------------------------------------------------------
+
+class TestPlannerPrune:
+    def test_oversized_space_majority_refused_with_ledger(self):
+        space = SearchSpace("serving", [
+            Knob("serving.num_kv_blocks", (0, 2048, 4096, 8192)),
+            Knob("serving.decode_steps_per_sync", (1, 4)),
+        ])
+        survivors, ledger = prune(space, PROFILE, BASE,
+                                  capacity_bytes=4 * MiB)
+        counts = ledger_counts(ledger)
+        assert counts["candidates"] == len(space) == 8
+        assert counts["kept"] + counts["constraint_refused"] \
+            + counts["planner_refused"] == counts["candidates"]
+        # the acceptance bar: the deliberately oversized pools are the
+        # majority and every one is refused analytically
+        assert counts["planner_refused"] >= counts["candidates"] / 2
+        assert all(c["serving.num_kv_blocks"] == 0 for c in survivors)
+        for e in ledger:
+            if e.verdict == "kept":
+                assert e.predicted_peak_bytes and e.predicted_peak_bytes > 0
+            else:
+                assert e.stage == "planner" and "predicted OOM" in e.reason
+                assert e.predicted_peak_bytes > 4 * MiB
+
+    def test_min_headroom_floor(self):
+        space = SearchSpace("serving",
+                            [Knob("serving.num_kv_blocks", (0,))])
+        fits_cap = 2 * MiB
+        survivors, _ = prune(space, PROFILE, BASE, capacity_bytes=fits_cap)
+        assert survivors                       # fits with small headroom...
+        survivors, ledger = prune(space, PROFILE, BASE,
+                                  capacity_bytes=fits_cap,
+                                  min_headroom_frac=0.9)
+        assert not survivors                   # ...but not with a 90% floor
+        assert "headroom" in ledger[0].reason
+
+    def test_unknown_capacity_keeps_all_but_prices_them(self):
+        space = _small_space()
+        survivors, ledger = prune(space, PROFILE, BASE, capacity_bytes=0)
+        assert len(survivors) == len(space)
+        assert all(e.predicted_peak_bytes > 0 for e in ledger)
+
+    def test_constraint_stage_runs_before_planner(self):
+        space = SearchSpace("serving",
+                            [Knob("kv_cache_dtype", ("int8",))])
+        survivors, ledger = prune(space, PROFILE, BASE,
+                                  capacity_bytes=4 * MiB)
+        assert not survivors
+        assert ledger[0].stage == "constraint"
+        assert ledger[0].predicted_peak_bytes is None   # never priced
+
+    def test_int8_kv_pool_priced_below_float32(self):
+        f32 = plan_candidate("serving", PROFILE, BASE, {})
+        int8 = plan_candidate(
+            "serving", PROFILE, BASE,
+            {"serving.quantization.kv_cache_dtype": "int8"})
+        assert int8.predicted_peak_bytes < f32.predicted_peak_bytes
+
+
+# ----------------------------------------------------------------------
+# seed Autotuner: analytic preflight (satellite)
+# ----------------------------------------------------------------------
+
+class TestAutotunerPreflight:
+    def test_planner_refuses_before_any_build(self):
+        from deepspeed_tpu.autotuning import Autotuner
+        from tests.simple_model import make_simple_model, random_batches
+        calls = {"n": 0}
+
+        def model_factory():
+            calls["n"] += 1
+            return make_simple_model()
+
+        tuner = Autotuner(
+            model_factory=model_factory,
+            base_config={"optimizer": {"type": "Adam",
+                                       "params": {"lr": 1e-3}},
+                         "mesh": {"data": jax.device_count()},
+                         "steps_per_print": 10**9},
+            batch_factory=lambda n: random_batches(1, n)[0],
+            stages=(0, 1), max_micro_batch=4, steps=1, warmup=0,
+            capacity_bytes=1024)             # nothing fits in 1 KiB
+        with pytest.raises(RuntimeError, match="no feasible"):
+            tuner.tune()
+        assert tuner.planner_refusals > 0
+        assert all(r["status"] == "planner_refused" for r in tuner.results)
+        assert all("planner predicted OOM" in r["error"]
+                   for r in tuner.results)
+        # exactly ONE factory call: the param-count profile; no experiment
+        # ever constructed a model or an engine
+        assert calls["n"] == 1
+
+    def test_unknown_capacity_falls_back_to_measured_probe(self):
+        from deepspeed_tpu.autotuning import Autotuner
+        tuner = Autotuner(model_factory=lambda: None, base_config={},
+                          batch_factory=lambda n: None, capacity_bytes=0)
+        assert tuner._planner_verdict(0, 1, None) is None
+
+
+# ----------------------------------------------------------------------
+# objectives
+# ----------------------------------------------------------------------
+
+class TestObjectives:
+    REC = {"ok": True, "tokens_per_time": 100.0,
+           "latency": {"ttft_ms": {"p99": 8.0}, "tpot_ms": {"p99": 2.0}}}
+
+    def test_slo_compliant_scores_throughput(self):
+        obj = ServingSLOObjective(ttft_p99_ms=10.0, tpot_p99_ms=4.0)
+        assert obj.score(self.REC) == 100.0
+
+    def test_slo_violation_is_negative_and_ordered(self):
+        obj = ServingSLOObjective(ttft_p99_ms=4.0)
+        assert obj.score(self.REC) == pytest.approx(-1.0)   # 8/4 - 1
+        worse = dict(self.REC, latency={"ttft_ms": {"p99": 16.0}})
+        assert obj.score(worse) < obj.score(self.REC) < 0
+
+    def test_slo_missing_histogram_counts_as_violation(self):
+        obj = ServingSLOObjective(tpot_p99_ms=4.0)
+        assert obj.score({"tokens_per_time": 1e9, "latency": {}}) == -1.0
+
+    def test_make_objective_round_trips_describe(self):
+        obj = make_objective({"name": "slo", "ttft_p99_ms": 7.0})
+        again = make_objective(obj.describe())
+        assert isinstance(again, ServingSLOObjective)
+        assert again.ttft_p99_ms == 7.0
+        with pytest.raises(ValueError, match="unknown objective"):
+            make_objective("latency")
+
+
+# ----------------------------------------------------------------------
+# measured stage
+# ----------------------------------------------------------------------
+
+class TestMeasure:
+    def test_ragged_trace_deterministic(self):
+        t1, t2 = _tiny_trace(), _tiny_trace()
+        assert t1 == t2
+        reqs = trace_requests(t1)
+        assert [len(r.tokens) for r in reqs] == t1["lens"]
+        assert all(not r.stop_on_eos for r in reqs)
+
+    def test_virtual_clock_measurement_is_repeatable(self):
+        _mk_mesh()
+        trace = _tiny_trace()
+        over = {"serving.decode_steps_per_sync": 4}
+        r1 = measure_serving(_spec_factory, BASE, over, trace)
+        r2 = measure_serving(_spec_factory, BASE, over, trace)
+        assert r1["ok"], r1.get("error")
+        assert r1["generated_tokens"] == \
+            trace["n_requests"] * trace["max_new"]
+        for r in (r1, r2):
+            r.pop("wall_s")
+        assert r1 == r2          # histograms included: syncs, not seconds
+
+    def test_config_shaped_failure_is_a_record_not_a_raise(self):
+        _mk_mesh()
+        rec = measure_serving(_spec_factory, BASE,
+                              {"serving.spec_decode.drafter": "model"},
+                              _tiny_trace())
+        assert rec["ok"] is False and rec["error"]
+
+
+# ----------------------------------------------------------------------
+# TuneSession end to end + artifact
+# ----------------------------------------------------------------------
+
+def _session(telemetry=None):
+    _mk_mesh()
+    trace = _tiny_trace()
+    measured = []
+    fn = functools.partial(measure_serving, _spec_factory, BASE,
+                           trace=trace)
+
+    def spy(overrides):
+        measured.append(dict(overrides))
+        return fn(overrides)
+
+    s = TuneSession(_small_space(), "throughput", spy, PROFILE,
+                    base_config=BASE, capacity_bytes=4 * MiB,
+                    trace=trace, telemetry=telemetry)
+    return s, measured
+
+
+class TestTuneSession:
+    def test_end_to_end_artifact_reproducible_and_winner_beats_baseline(self):
+        s1, measured = _session()
+        art1 = s1.run()
+        counts = art1["prune_ledger"]["counts"]
+        assert counts == {"candidates": 4, "kept": 2,
+                          "constraint_refused": 0, "planner_refused": 2}
+        # refused candidates were never measured: survivors + the baseline
+        assert len(measured) == counts["kept"] + 1
+        assert all(o.get("serving.num_kv_blocks") != 4096
+                   for o in measured)
+        # the winner beats the stack defaults on the same trace
+        assert art1["winner"]["objective"] > art1["baseline"]["objective"]
+        assert art1["winner"]["overrides"]["serving.decode_steps_per_sync"] == 4
+        assert art1["winner"]["config"]["serving"]["decode_steps_per_sync"] == 4
+        assert art1[ARTIFACT_MARKER] == 1
+        # reproducibility is byte-exact: a second fresh session serializes
+        # to the identical artifact
+        s2, _ = _session()
+        assert artifact_json(s2.run()) == artifact_json(art1)
+        # and the artifact is directly consumable by the config loaders
+        icfg = TpuInferenceConfig.from_dict(json.loads(artifact_json(art1)))
+        assert icfg.serving.decode_steps_per_sync == 4
+        assert load_tuned_config(art1) == art1["winner"]["config"]
+
+    def test_dry_run_prunes_without_measuring(self):
+        s, measured = _session()
+        art = s.run(dry_run=True)
+        assert not measured
+        assert art["winner"] is None and art["dry_run"]
+        assert art["prune_ledger"]["counts"]["planner_refused"] == 2
+        with pytest.raises(ValueError, match="no winner"):
+            TpuInferenceConfig.from_dict(art)
+
+    def test_telemetry_counters(self, tmp_path):
+        from deepspeed_tpu.config.core import TelemetryConfig
+        from deepspeed_tpu.telemetry import Telemetry
+        tele = Telemetry(TelemetryConfig(enabled=True, prometheus=False,
+                                         jsonl=False, monitor_bridge=False,
+                                         output_path=str(tmp_path)))
+        s, _ = _session(telemetry=tele)
+        s.run()
+        snap = tele.registry.snapshot()
+        assert snap["tune/candidates"]["value"] == 4
+        assert snap["tune/planner_refused"]["value"] == 2
+        assert snap["tune/planner_kept"]["value"] == 2
+        assert snap["tune/trials"]["value"] == 3       # 2 survivors + baseline
+        assert snap["tune/trial_failures"]["value"] == 0
+        assert snap["tune/best_objective"]["value"] > 0
+
+    def test_train_artifact_feeds_initialize_config(self):
+        art = {ARTIFACT_MARKER: 1,
+               "winner": {"config": {
+                   "train_micro_batch_size_per_gpu": 2,
+                   "zero_optimization": {"stage": 1}}}}
+        cfg = TpuTrainConfig.load(art)
+        assert cfg.train_micro_batch_size_per_gpu == 2
+        assert cfg.zero_optimization.stage == 1
+        with pytest.raises(ValueError, match="marker"):
+            load_tuned_config({"not": "an artifact"})
+
+
+# ----------------------------------------------------------------------
+# subprocess recipe + child trial
+# ----------------------------------------------------------------------
+
+class TestSubproc:
+    def test_last_json_line_skips_chatter_and_requires_key(self):
+        out = ('warming up\n{"metric": 1}\nnoise {not json}\n'
+               '{"other": 2}\n{"metric": 3, "extra": true}\ndone')
+        assert last_json_line(out, key="metric") == {"metric": 3,
+                                                     "extra": True}
+        assert last_json_line(out, key="missing") is None
+        assert last_json_line("", key="x") is None
+
+    def test_child_env_strips_prefixes_and_applies_overrides(self):
+        base = {"BENCH_MOE": "1", "DSTPU_TUNE_TRIAL": "{}",
+                "PATH": "/bin", "HOME": "/root"}
+        env = child_env({"BENCH_STEPS": 5}, clear_prefixes=("BENCH_",
+                                                            "DSTPU_TUNE_"),
+                        base=base)
+        assert "BENCH_MOE" not in env and "DSTPU_TUNE_TRIAL" not in env
+        assert env["BENCH_STEPS"] == "5"      # overrides survive (strified)
+        assert env["PATH"] == "/bin"
+
+    def test_trial_child_process_round_trip(self):
+        cfg = dict(n_layer=1, n_head=2, d_model=32, max_seq_len=64,
+                   vocab_size=64, dtype="float32", remat=False)
+        trace = ragged_trace(seed=1, n_requests=2, min_len=2, max_len=8,
+                             max_new=3, vocab=64)
+        rec = run_trial_child({
+            "kind": "serving",
+            "model": {"kind": "tiny_gpt", "cfg": cfg},
+            "base_config": {"dtype": "float32",
+                            "kv_cache_dtype": "float32", "greedy": True,
+                            "kv_block_size": 16, "max_out_tokens": 16,
+                            "serving": {"max_slots": 2}},
+            "overrides": {}, "trace": trace, "clock": "virtual",
+        }, timeout=240)
+        assert rec["ok"], rec.get("error")
+        assert rec["generated_tokens"] == 2 * 3
+        assert rec["latency"]["ttft_ms"]["count"] == 2
