@@ -1322,7 +1322,12 @@ def run_fabric_lane():
         timeout. Reports that detection latency separately;
       * degraded arm — the same kill against a 1-replica pool with restart
         budget 0: no failover path, so in-flight work is lost. The honest
-        baseline for what the fabric buys.
+        baseline for what the fabric buys;
+      * observability-overhead arm (BENCH_FABRIC_OBS_ROUNDS) — the same
+        seeded trace with the pod observability plane off then on
+        (per-process tracing/flight recorder spooled home over the
+        idempotent wire pulls): tokens/s delta (<3% budget) and pull
+        bytes per router step.
 
     value is the failover-arm completion rate; vs_baseline is completion
     leverage over the degraded arm (failover rate / degraded rate, floored
@@ -1353,9 +1358,10 @@ def run_fabric_lane():
                         max_new_tokens=6, stop_on_eos=False)
                 for i in range(n_req)]
 
-    def spawn_pool(n):
+    def spawn_pool(n, factory_kwargs=None):
         procs = [ReplicaProcess(factory=factory, heartbeat_interval_s=hb,
-                                replica_id=f"r{i}").spawn()
+                                replica_id=f"r{i}",
+                                factory_kwargs=factory_kwargs or {}).spawn()
                  for i in range(n)]
         handles = []
         for i, p in enumerate(procs):
@@ -1455,6 +1461,72 @@ def run_fabric_lane():
     for h in handles1:
         h.close()
 
+    # ---- observability-overhead arm: the pod plane (per-process tracing
+    # + flight recorder spooled home over idempotent wire pulls on the
+    # export cadence) must ride along for <3% tokens/s. Same seeded trace
+    # against two fresh 2-process pools, plane off then on; reports the
+    # delta and the wire cost (pull bytes per router step). -------------
+    obs_rounds = int(os.environ.get("BENCH_FABRIC_OBS_ROUNDS", "2"))
+    obs = None
+    if obs_rounds > 0:
+        import shutil
+        import tempfile
+
+        from deepspeed_tpu.config.core import TelemetryConfig
+
+        def obs_arm(tag, factory_kwargs, router_tel):
+            handles = spawn_pool(2, factory_kwargs=factory_kwargs)
+            r = ServingRouter(replicas=handles, telemetry_config=router_tel)
+            rng2 = np.random.default_rng(7)
+            reqs = [Request(uid=f"{tag}-{i}",
+                            tokens=rng2.integers(
+                                0, 200, (int(rng2.integers(4, 24)),))
+                            .astype(np.int32),
+                            max_new_tokens=6, stop_on_eos=False)
+                    for i in range(n_req * obs_rounds)]
+            r.run(reqs[:1])                 # warmup pays the compiles
+            t0 = time.perf_counter()
+            done = r.run(reqs[1:])
+            wall = time.perf_counter() - t0
+            toks = sum(len(d.tokens) for d in done.values())
+            if r.telemetry.enabled:
+                r.observability_snapshot(refresh=True)   # final drain
+            snap = r.telemetry.registry.snapshot() \
+                if r.telemetry.enabled else {}
+            steps = max(1, r.steps)
+            r.telemetry.close()
+            for h in handles:
+                h.close()
+            return toks / max(wall, 1e-9), snap, steps
+
+        out_dir = tempfile.mkdtemp(prefix="dstpu_bench_obs_")
+        try:
+            tps_off, _, _ = obs_arm("off", {}, None)
+            tps_on, snap, steps = obs_arm(
+                "on",
+                {"telemetry": {"enabled": True, "tracing": True,
+                               "flight_recorder": True, "prometheus": False,
+                               "jsonl": False,
+                               "output_path": os.path.join(out_dir, "rep")}},
+                TelemetryConfig(enabled=True, prometheus=False, jsonl=False,
+                                tracing=True, flight_recorder=True,
+                                output_path=os.path.join(out_dir, "router")))
+        finally:
+            shutil.rmtree(out_dir, ignore_errors=True)
+
+        def _ctr(name):
+            return float(snap.get(name, {}).get("value", 0.0))
+
+        overhead = 1.0 - tps_on / max(tps_off, 1e-9)
+        obs = {"tokens_s_plane_off": round(tps_off, 1),
+               "tokens_s_plane_on": round(tps_on, 1),
+               "overhead_frac": round(overhead, 4),
+               "within_3pct": bool(overhead < 0.03),
+               "pulls": int(_ctr("obs/pulls")),
+               "pulled_spans": int(_ctr("obs/pull_spans")),
+               "pull_bytes_per_step": round(_ctr("obs/pull_bytes") / steps,
+                                            1)}
+
     rate = completed / submitted
     ds = sorted(detect)
     result = {
@@ -1482,6 +1554,7 @@ def run_fabric_lane():
             "degraded": {"completion_rate": round(deg_rate, 4),
                          "lost": sorted(set(f"deg-{i}" for i in range(n_req))
                                         - set(deg_done))},
+            "observability": obs,
         },
     }
     print(json.dumps(result))
@@ -2109,7 +2182,8 @@ def main():
         fabric = sub_lane(
             "fabric", BENCH_FABRIC_CHILD="1",
             BENCH_FABRIC_REQUESTS=env("BENCH_FABRIC_REQUESTS", "8"),
-            BENCH_FABRIC_KILLS=env("BENCH_FABRIC_KILLS", "3"))
+            BENCH_FABRIC_KILLS=env("BENCH_FABRIC_KILLS", "3"),
+            BENCH_FABRIC_OBS_ROUNDS=env("BENCH_FABRIC_OBS_ROUNDS", "2"))
         if fabric is not None:
             print(json.dumps(fabric))
 

@@ -168,6 +168,10 @@ class Autoscaler:
                 self.router.remove_replica(rid)
                 self._draining_rid = None
                 self._count("reaps")
+                if self.router.flightrec.enabled:
+                    self.router.flightrec.record(
+                        "reap", replica=rid,
+                        pool=len(self.router.replicas))
                 self._gauge_pool(tel)
                 return "reap"
             else:
@@ -211,6 +215,10 @@ class Autoscaler:
             # recipe is a config bug; count it, close the orphan, carry on
             self._count("join_refused")
             logger.error(f"autoscaler: join refused for spawn #{idx}: {e}")
+            if self.router.flightrec.enabled:
+                self.router.flightrec.record(
+                    "join_refused", replica=getattr(handle, "replica_id", "?"),
+                    reason=str(e), pool=len(self.router.replicas))
             try:
                 handle.close()
             except Exception:

@@ -303,10 +303,13 @@ class ReplicaProcess:
 # ----------------------------------------------------------------------
 
 # verbs safe to re-ask after a lost reply: pure reads, no server-side state
+# (observability_pull qualifies because a pull never consumes spool items —
+# the same cursor always answers with the same data, so a retried pull is
+# byte-identical and can never double-count)
 _IDEMPOTENT = frozenset({
     "ping", "signals", "affinity", "hash_chain", "check_admissible",
     "has_output", "audit_state", "memory_snapshot", "stats",
-    "compile_stats", "compat", "progress"})
+    "compile_stats", "compat", "progress", "observability_pull"})
 
 
 class RemoteReplica(ReplicaHandle):
@@ -435,9 +438,10 @@ class RemoteReplica(ReplicaHandle):
 
     def submit(self, request, prefill_only=False, hashes=None, trace=None,
                deadline_at=None):
-        # trace is dropped at the boundary: span context is in-process by
-        # design (ReplicaHandle.attach_observability docs) — the remote
-        # engine records its own side
+        # trace is dropped at the boundary: a span context cannot cross a
+        # process boundary. The remote engine records its own side; the
+        # router pulls those spans home over `observability_pull` and
+        # re-parents them under its trace id (attach_observability below)
         deadline_in_s = None
         if deadline_at is not None:
             # absolute (router clock) -> remaining budget -> the server
@@ -530,6 +534,43 @@ class RemoteReplica(ReplicaHandle):
             "cross-process KV handoff is not supported yet")
 
     # -- observability ----------------------------------------------------
+
+    def attach_observability(self, tracer=None, flightrec=None, tid=None):
+        """The wire version of tracer sharing: the objects stay router-side
+        (a tracer cannot cross a process boundary) — instead this probes
+        the replica server's observability plane (`observability_pull` at
+        cursor 0) and caches its spool path + pid so the router can pull
+        spans/flight events home on its sync cadence and drain the on-disk
+        spool post-mortem. Warns loudly — once per handle — when the
+        router wants traces but the remote process recorded none (its
+        engine config must enable telemetry tracing/flight_recorder too),
+        so a silently dark replica is never mistaken for a healthy one."""
+        self.obs_spool_path: Optional[str] = None
+        self.obs_pid: Optional[int] = None
+        self._obs_enabled = False
+        if tracer is None and flightrec is None:
+            return
+        try:
+            probe = self.observability_pull(cursor=0)
+        except (ReplicaUnavailableError, RemoteCallError):
+            probe = None
+        if not (probe or {}).get("enabled"):
+            if not getattr(self, "_obs_warned", False):
+                self._obs_warned = True
+                logger.warning(
+                    f"replica {self.replica_id}: router observability is on "
+                    f"but the remote process ships nothing back — its spans "
+                    f"and flight events will NOT appear in the pool trace. "
+                    f"Enable telemetry tracing/flight_recorder in the remote "
+                    f"engine's config (the replica server spools them for "
+                    f"the router automatically).")
+            return
+        self._obs_enabled = True
+        self.obs_spool_path = probe.get("spool_path")
+        self.obs_pid = probe.get("pid")
+
+    def observability_pull(self, cursor=0):
+        return self._call("observability_pull", {"cursor": int(cursor)})
 
     def set_clock(self, clock):
         # LOCAL swap only (deadline translation); never forwarded — see

@@ -145,6 +145,17 @@ class ReplicaHandle:
         audits on its own side at its scheduled interval)."""
         return None
 
+    def observability_pull(self, cursor: int = 0) -> Optional[Dict[str, Any]]:
+        """Pull this replica's observability state for pool aggregation:
+        `{"enabled", "cursor", "items", "dropped", "metrics", ...}` —
+        spooled spans/flight events after `cursor` plus the current
+        registry snapshot (see serving/observability.py for the cursor
+        contract). None means "no plane here" (the default): the router
+        skips this replica when merging. An in-process replica has no
+        spool (its spans already land in the router's own tracer) but
+        does expose its registry for merged pool percentiles."""
+        return None
+
     def audit_state(self) -> Optional[Dict[str, Any]]:
         """Portable JSON snapshot of the pool bookkeeping (what
         `bin/dstpu_audit` consumes), or None for a remote backend."""
@@ -221,6 +232,17 @@ class InProcessReplica(ReplicaHandle):
     def memory_snapshot(self):
         ms = getattr(self.engine, "memscope", None)
         return ms.snapshot() if ms is not None else None
+
+    def observability_pull(self, cursor=0):
+        # no spool: an in-process engine's spans/flight events already land
+        # in the router's attached tracer/recorder. What pool aggregation
+        # needs from here is the registry (per-engine TTFT/TPOT histograms
+        # for the exact bucket-wise merge).
+        tel = getattr(self.engine, "telemetry", None)
+        if tel is None or not getattr(tel, "enabled", False):
+            return None
+        return {"enabled": True, "cursor": int(cursor), "items": [],
+                "dropped": 0, "metrics": tel.registry.snapshot()}
 
     def cancel(self, uid, queued_only=False):
         return self.engine.cancel(uid, queued_only=queued_only)

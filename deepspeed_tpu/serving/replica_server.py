@@ -47,13 +47,59 @@ class ReplicaServerApp:
     """The verb table + lifecycle around one engine and one RpcServer."""
 
     def __init__(self, engine, host="127.0.0.1", port=0,
-                 heartbeat_interval_s=0.5, clock=None):
+                 heartbeat_interval_s=0.5, clock=None, spool_capacity=1024):
         from deepspeed_tpu.serving.replica import InProcessReplica
         from deepspeed_tpu.serving.transport import RpcServer
         self.handle = InProcessReplica(engine=engine, replica_id="remote")
         self._clock = clock if clock is not None else time.monotonic
+        self.telemetry = getattr(engine, "telemetry", None)
+        self.spool = self._build_spool(spool_capacity)
         self.server = RpcServer(self.verb_table(), host=host, port=port,
                                 heartbeat_interval_s=heartbeat_interval_s)
+
+    def _build_spool(self, capacity):
+        """Tap this process's tracer/flight-recorder into a bounded spool
+        the router can pull over the wire (`observability_pull`). Only when
+        a diagnostic is actually enabled — the observability-off default
+        stays zero-overhead and writes no spool file."""
+        tel = self.telemetry
+        if tel is None or not getattr(tel, "enabled", False):
+            return None
+        tracer = getattr(tel, "tracer", None)
+        flightrec = getattr(tel, "flightrec", None)
+        traced = bool(getattr(tracer, "enabled", False))
+        flight = bool(getattr(flightrec, "enabled", False))
+        if not (traced or flight):
+            return None
+        import pathlib
+
+        from deepspeed_tpu.serving.observability import ObservabilitySpool
+        out = pathlib.Path(getattr(tel.config, "output_path", None)
+                           or "telemetry")
+        spool = ObservabilitySpool(
+            path=out / f"{tel.subsystem}.obs.spool.jsonl",
+            capacity=capacity, telemetry=tel)
+        if traced:
+            tracer.on_record = spool.span_hook
+        if flight:
+            flightrec.on_record = spool.flight_hook
+        return spool
+
+    def _observability_pull(self, p):
+        """Idempotent, cursor-based pull of spooled spans/flight events plus
+        the current registry snapshot. Items are never consumed by a pull
+        (only by ring overflow), so a retried pull at the same cursor
+        returns identical data and can never double-count."""
+        if self.spool is None:
+            return {"enabled": False}
+        out = self.spool.pull(p.get("cursor", 0))
+        return {"enabled": True,
+                "cursor": out["cursor"],
+                "items": out["items"],
+                "dropped": out["dropped"],
+                "spool_path": self.spool.path,
+                "pid": os.getpid(),
+                "metrics": self.telemetry.registry.snapshot()}
 
     def verb_table(self):
         h = self.handle
@@ -87,6 +133,7 @@ class ReplicaServerApp:
             "stats": lambda p: h.stats(),
             "compile_stats": lambda p: h.compile_stats(),
             "compat": lambda p: h.compat_descriptor(),
+            "observability_pull": self._observability_pull,
             "shutdown": lambda p: True,   # RpcServer stops after the reply
         }
 
@@ -133,12 +180,16 @@ def main(argv=None) -> int:
     ap.add_argument("--heartbeat-interval", type=float, default=0.5)
     ap.add_argument("--ready-file", default=None,
                     help="write 'host port' here once serving")
+    ap.add_argument("--spool-capacity", type=int, default=1024,
+                    help="observability spool ring size (spans + flight "
+                         "events retained for the router to pull)")
     args = ap.parse_args(argv)
 
     factory = load_factory(args.factory)
     engine = factory(**json.loads(args.kwargs))
     app = ReplicaServerApp(engine, host=args.host, port=args.port,
-                           heartbeat_interval_s=args.heartbeat_interval)
+                           heartbeat_interval_s=args.heartbeat_interval,
+                           spool_capacity=args.spool_capacity)
     print(f"dstpu_replica: serving on {app.server.host}:{app.server.port} "
           f"(pid {os.getpid()})", file=sys.stderr, flush=True)
     app.serve(ready_file=args.ready_file)

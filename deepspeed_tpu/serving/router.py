@@ -68,6 +68,7 @@ Self-healing (this PR) extends health beyond "step() threw":
 
 import collections
 import dataclasses
+import json
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -79,7 +80,7 @@ from deepspeed_tpu.inference.scheduler import (CompletedRequest,
                                                Request, ServingEngine)
 from deepspeed_tpu.serving.replica import (InProcessReplica, ReplicaHandle,
                                            ReplicaUnavailableError)
-from deepspeed_tpu.telemetry import Telemetry
+from deepspeed_tpu.telemetry import Telemetry, TraceContext, merge_snapshots
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -242,6 +243,23 @@ class ServingRouter:
         if self.tracer.enabled:
             self.tracer.name_process("dstpu serving pool")
             self.tracer.name_track(0, "router")
+        # the pod observability plane (pull side): per-replica spool
+        # cursors (advanced only after a successful ingest, so a retried
+        # pull can never double-count), the latest registry snapshot per
+        # replica (REPLACED on every pull, never accumulated — same
+        # reason), remote->local span-id remaps for re-parenting, and the
+        # wire facts (spool path, pid) the post-mortem drain needs once
+        # the process is gone
+        self._obs_cursors: Dict[str, int] = {}
+        self._obs_metrics: Dict[str, Dict[str, Any]] = {}
+        self._obs_remap: Dict[str, Dict[int, int]] = {}
+        self._obs_info: Dict[str, Dict[str, Any]] = {}
+        # uid -> TraceContext, kept PAST completion (bounded LRU): remote
+        # spans arrive on the pull cadence, possibly after _complete
+        # already closed the root — re-parenting must still find the
+        # router's trace id for them
+        self._trace_index: collections.OrderedDict = collections.OrderedDict()
+        self._trace_index_cap = 4096
 
         for r in replicas:
             self.add_replica(r)
@@ -296,13 +314,19 @@ class ServingRouter:
 
     def _attach_observability(self, rid):
         """Inject the pool's tracer/flight recorder into one replica (also
-        re-run after a restart — the rebuilt engine starts detached)."""
+        re-run after a restart — the rebuilt engine starts detached). An
+        in-process replica takes the objects directly; a RemoteReplica
+        instead probes its server's spool so the router can pull spans
+        home over the wire. Either way the pull cursor resets: a fresh
+        engine/process starts a fresh spool cursor space."""
         if not (self.tracer.enabled or self.flightrec.enabled):
             return
         self.replicas[rid].attach_observability(
             tracer=self.tracer if self.tracer.enabled else None,
             flightrec=self.flightrec if self.flightrec.enabled else None,
             tid=self._tids[rid])
+        self._obs_cursors[rid] = 0
+        self._obs_remap[rid] = {}
         if self.tracer.enabled:
             self.tracer.name_track(self._tids[rid], f"replica {rid}")
 
@@ -451,7 +475,8 @@ class ServingRouter:
                 f"replica {rid} still owns work — drain it first")
         rep = self.replicas.pop(rid)
         for store in (self._budgets, self._ttft, self._anticipated,
-                      self._strikes, self._quarantined):
+                      self._strikes, self._quarantined, self._obs_cursors,
+                      self._obs_metrics, self._obs_remap, self._obs_info):
             store.pop(rid, None)
         self._draining.discard(rid)
         self._dead.discard(rid)
@@ -527,6 +552,12 @@ class ServingRouter:
             # the router owns the trace: root span = submit -> completion,
             # closed in _complete (a failover in between stays inside it)
             trace = self.tracer.start(request.uid, t0=now, owner="router")
+            # indexed past completion: remote replica spans arrive on the
+            # pull cadence and must re-parent under this trace id even
+            # after the root closed
+            self._trace_index[request.uid] = trace
+            while len(self._trace_index) > self._trace_index_cap:
+                self._trace_index.popitem(last=False)
         self._pending[request.uid] = _Pending(
             request=request, prompt_len=prompt_len, hashes=hashes,
             t_submit=now, deadline=(now + ttl) if ttl is not None else None,
@@ -869,6 +900,11 @@ class ServingRouter:
             self._dead.add(rid)
             logger.error(f"router: replica {rid} is out of restart budget; "
                          f"pool shrinks to {len(self._healthy())}")
+        # drain the dying replica's last observability spool BEFORE the
+        # dump so its final spans/flight events make it into the black box
+        # (over the wire if the server still answers; from its on-disk
+        # spool file when the process is already gone)
+        postmortem = self._postmortem_drain(rid)
         if self.flightrec.enabled:
             # the black-box moment this whole subsystem exists for: the
             # quarantine event joins the ring, then the ring + a full
@@ -877,8 +913,11 @@ class ServingRouter:
                                   reason=str(reason)[:200],
                                   requeued=len(requeue),
                                   dead=rid in self._dead)
+            state = self._failure_snapshot()
+            if postmortem is not None and isinstance(state, dict):
+                state["postmortem"] = postmortem
             self.flightrec.dump(f"replica {rid} failed: {reason}",
-                                state=self._failure_snapshot())
+                                state=state)
 
     def _failure_snapshot(self):
         """stats() guarded for the dump path — a half-dead pool must still
@@ -1127,6 +1166,13 @@ class ServingRouter:
                 if mem.get("headroom_frac") is not None:
                     self.telemetry.set_gauge("mem/pool_headroom_frac",
                                              mem["headroom_frac"])
+            # observability pulls piggyback on the export cadence: one
+            # pull per replica per export_interval steps, so the wire
+            # cost scales with the export rate the operator already chose
+            interval = max(1, int(getattr(self.telemetry.config,
+                                          "export_interval", 1)))
+            if self.steps % interval == 0:
+                self._observability_pull_all()
             self.telemetry.maybe_export(self.steps)
         return finished
 
@@ -1216,6 +1262,235 @@ class ServingRouter:
         self.counters[name] += n
         self.telemetry.inc(f"router/{name}", n)
 
+    # ---- the pod observability plane (pull side) ----------------------
+
+    def _observability_pull_all(self):
+        """Pull every live replica's observability state (piggybacks on
+        the telemetry export cadence in `_step_inner`; `observability_
+        snapshot(refresh=True)` calls it on demand)."""
+        for rid, rep in list(self.replicas.items()):
+            if rid in self._dead or rid in self._quarantined:
+                continue
+            self._observability_pull_one(rid, rep)
+
+    def _observability_pull_one(self, rid, rep):
+        cursor = self._obs_cursors.get(rid, 0)
+        try:
+            reply = rep.observability_pull(cursor=cursor)
+        except ReplicaUnavailableError:
+            return      # liveness owns the death; the post-mortem drain
+                        # recovers the spool tail at quarantine time
+        except Exception as e:
+            logger.warning(f"router: observability pull from {rid} "
+                           f"failed: {e}")
+            return
+        if not reply or not reply.get("enabled"):
+            return
+        spans, events = self._ingest_items(rid, reply.get("items") or ())
+        # cursor advances ONLY here, after a successful ingest — a pull
+        # lost on the wire (and transparently retried: the verb is
+        # idempotent) or one that raised above re-asks from the same
+        # cursor and the spool answers with identical items
+        self._obs_cursors[rid] = int(reply.get("cursor", cursor))
+        metrics = reply.get("metrics")
+        if metrics is not None:
+            # REPLACE, never accumulate: the reply carries the replica's
+            # full registry snapshot, so re-pulls cannot double-count
+            self._obs_metrics[rid] = metrics
+        info = self._obs_info.setdefault(rid, {})
+        for key in ("spool_path", "pid"):
+            if reply.get(key) is not None:
+                info[key] = reply[key]
+        info["dropped"] = int(reply.get("dropped", 0))
+        if self.telemetry.enabled:
+            self.telemetry.inc("obs/pulls")
+            if spans:
+                self.telemetry.inc("obs/pull_spans", spans)
+            if events:
+                self.telemetry.inc("obs/pull_events", events)
+            if "pid" in reply:      # a wire pull (in-process pulls are free)
+                self.telemetry.inc("obs/pull_bytes",
+                                   len(json.dumps(reply, default=str)))
+
+    def _ingest_items(self, rid, items):
+        spans = events = 0
+        for it in items:
+            kind = it.get("kind")
+            rec = it.get("rec") or {}
+            if kind == "span":
+                self._import_span(rid, rec)
+                spans += 1
+            elif kind == "flight":
+                self._import_flight(rid, rec)
+                events += 1
+        return spans, events
+
+    def _import_span(self, rid, rec):
+        """Re-parent one remote span into the pool trace: the replica's
+        span/parent ids map onto fresh router-tracer ids (consistent
+        across pulls), its engine-owned root re-parents under the router's
+        root for the same uid, and the span lands on the replica's named
+        Perfetto track. Timestamps cross untranslated — every process on
+        the host reads the same CLOCK_MONOTONIC (the tracer's documented
+        clock domain)."""
+        if not self.tracer.enabled:
+            return
+        tracer = self.tracer
+        tid = self._tids.get(rid, 0)
+        ctx = self._trace_index.get(rec.get("uid"))
+        remap = self._obs_remap.setdefault(rid, {})
+
+        def local_id(remote_id):
+            sid = remap.get(remote_id)
+            if sid is None:
+                sid = next(tracer._ids)
+                remap[remote_id] = sid
+            return sid
+
+        sid = local_id(rec.get("span"))
+        remote_parent = rec.get("parent", 0)
+        if remote_parent == 0:
+            # the remote engine's root span ("request" on its side)
+            # becomes a child of the router's root — ONE trace id from
+            # dispatch to completion
+            parent = ctx.root_id if ctx is not None else 0
+        else:
+            parent = local_id(remote_parent)
+        if ctx is not None and ctx.flow_id is not None:
+            # the dispatch arrow the router opened was never consumed
+            # in-process (the replica is remote): close it at the first
+            # span arriving on the replica's track
+            tracer.flow_end(ctx, rec.get("ts", 0.0), tid=tid)
+        shim = ctx if ctx is not None else TraceContext(
+            trace_id=f"{rid}:{rec.get('trace')}", root_id=sid,
+            uid=rec.get("uid"))
+        tracer.record(shim, rec.get("name", "?"), rec.get("ts", 0.0),
+                      rec.get("dur", 0.0), tid=tid,
+                      attrs=dict(rec.get("attrs") or {}, src=rid),
+                      parent=parent, span_id=sid)
+
+    def _import_flight(self, rid, ev):
+        """Land one remote flight event in the pool ring, wrapped (kind
+        "remote", original event nested) so remote and router field names
+        can never collide."""
+        if self.flightrec.enabled:
+            self.flightrec.record("remote", src=rid, event=dict(ev))
+
+    def _postmortem_drain(self, rid) -> Optional[Dict[str, Any]]:
+        """Recover a dying replica's final spool for the quarantine dump:
+        a last wire pull while the server still answers, else a direct
+        read of its on-disk spool file (the `kill -9` path — the file
+        survives the process). Recovered spans join the pool trace;
+        recovered flight events ride in the returned summary, which the
+        dump embeds as `state["postmortem"]`."""
+        if not (self.tracer.enabled or self.flightrec.enabled):
+            return None
+        rep = self.replicas.get(rid)
+        if rep is None:
+            return None
+        cursor = self._obs_cursors.get(rid, 0)
+        items, source = None, None
+        try:
+            reply = rep.observability_pull(cursor=cursor)
+            if reply and reply.get("enabled"):
+                items = reply.get("items") or []
+                source = "wire"
+                if reply.get("metrics") is not None:
+                    self._obs_metrics[rid] = reply["metrics"]
+        except Exception:
+            items = None
+        if items is None:
+            info = self._obs_info.get(rid, {})
+            path = info.get("spool_path") \
+                or getattr(rep, "obs_spool_path", None)
+            if path:
+                from deepspeed_tpu.serving.observability import \
+                    read_spool_file
+                items = read_spool_file(path, after_cursor=cursor)
+                source = "spool_file"
+        if not items:
+            return None
+        spans, events = self._ingest_items(rid, items)
+        self._obs_cursors[rid] = max(
+            [cursor] + [int(it.get("cursor", 0)) for it in items])
+        if self.telemetry.enabled:
+            self.telemetry.inc("obs/postmortem_recovered", len(items))
+        return {"replica": rid, "source": source,
+                "spans": spans,
+                "flight_events": [it.get("rec") for it in items
+                                  if it.get("kind") == "flight"]}
+
+    def pool_latency(self, merged=None) -> Dict[str, Dict[str, float]]:
+        """Pool-level latency percentiles from MERGED per-replica
+        histograms — exact (bucket-wise merge over identical log-scale
+        buckets), unlike any aggregation of per-replica percentiles.
+        This is the pool-level latency source; `replica_ttft` stays
+        per-replica."""
+        if merged is None:
+            merged = self.pool_metrics()
+        out = {}
+        for name in ("serving/ttft_ms", "serving/tpot_ms",
+                     "serving/queue_wait_ms", "serving/e2e_ms"):
+            snap = merged.get(name)
+            if snap and snap.get("type") == "histogram":
+                out[name] = {k: snap[k] for k in
+                             ("count", "mean", "p50", "p90", "p99")}
+        return out
+
+    def pool_metrics(self) -> Dict[str, Any]:
+        """The merged pool snapshot over the most recently pulled
+        per-replica registries (counters summed, gauges per-source,
+        histograms bucket-merged)."""
+        per = {rid: snap for rid, snap in self._obs_metrics.items()
+               if rid in self.replicas}
+        return merge_snapshots(per) if per else {}
+
+    def observability_snapshot(self, refresh: bool = True) -> Dict[str, Any]:
+        """The one pool-level view `bin/dstpu_top` renders: merged metric
+        snapshot + pool latency percentiles, per-replica health/load/
+        degradation/headroom, router counters, and the recent flight
+        events. `refresh=False` serves the cached state from the last
+        pull cadence instead of issuing fresh pulls."""
+        if refresh:
+            self._observability_pull_all()
+        merged = self.pool_metrics()
+        replicas: Dict[str, Any] = {}
+        for rid, rep in self.replicas.items():
+            health = ("dead" if rid in self._dead else
+                      "quarantined" if rid in self._quarantined else
+                      "draining" if rid in self._draining else "up")
+            entry: Dict[str, Any] = {"role": rep.role, "health": health,
+                                     "restarts": self._budgets[rid].restarts}
+            if health in ("up", "draining"):
+                try:
+                    entry.update(queue=rep.queue_depth,
+                                 active=rep.num_active,
+                                 available_blocks=rep.available_blocks,
+                                 has_free_slot=rep.has_free_slot)
+                except ReplicaUnavailableError as e:
+                    entry["health"] = "unreachable"
+                    entry["error"] = str(e)[:200]
+            snap = self._obs_metrics.get(rid) or {}
+            for label, metric in (("degradation_level",
+                                   "serving/degradation_level"),
+                                  ("headroom_frac", "mem/headroom_frac")):
+                g = snap.get(metric)
+                if g is not None:
+                    entry[label] = g.get("value")
+            if rid in self._obs_info:
+                entry["obs"] = dict(self._obs_info[rid])
+            replicas[rid] = entry
+        return {"steps": self.steps,
+                "queue_depth": len(self.queue),
+                "in_flight": len(self._pending),
+                "live_replicas": len(self._healthy()),
+                "counters": dict(self.counters),
+                "pool_latency": self.pool_latency(merged),
+                "pool_metrics": merged,
+                "replicas": replicas,
+                "flight_events": self.flightrec.events()[-32:]
+                if self.flightrec.enabled else []}
+
     @staticmethod
     def _percentile(values, q):
         if not values:
@@ -1224,10 +1499,15 @@ class ServingRouter:
         return float(v[min(len(v) - 1, int(q * len(v)))])
 
     def replica_ttft(self, rid) -> Dict[str, float]:
-        """Router-level TTFT percentiles for one replica (ms), over the
+        """Router-level TTFT percentiles for ONE replica (ms), over the
         last `_ttft_window` completions. Populated only when the replicas
-        run with telemetry enabled (the engine stamps first-token
-        times)."""
+        run with telemetry enabled (the engine stamps first-token times).
+
+        .. deprecated:: as a pool-level latency source. A single
+           replica's p99 is not the pool's p99 — and no combination of
+           per-replica percentiles is. Read `stats()["pool_latency"]`
+           (or `pool_latency()`) instead: exact percentiles over the
+           bucket-wise-merged pool histograms."""
         v = list(self._ttft.get(rid, ()))
         return {"count": len(v),
                 "p50": self._percentile(v, 0.50),
@@ -1300,6 +1580,12 @@ class ServingRouter:
                "counters": dict(self.counters),
                "disaggregated": self.disaggregated,
                "replicas": reps}
+        # pool-level latency from MERGED histograms (cached pulls — no
+        # wire traffic here: stats() runs inside failure paths); {} until
+        # the first pull cadence fires or when replicas run telemetry-off
+        pool = self.pool_latency()
+        if pool:
+            out["pool_latency"] = pool
         mem = self.memory_snapshot()
         if mem:
             out["memory"] = mem

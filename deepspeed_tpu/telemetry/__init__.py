@@ -37,7 +37,8 @@ import contextlib
 import pathlib
 
 from deepspeed_tpu.telemetry.registry import (Counter, Gauge, Histogram,
-                                              MetricsRegistry)
+                                              MetricsRegistry,
+                                              merge_snapshots)
 from deepspeed_tpu.telemetry.exporters import (JsonlExporter, MonitorBridge,
                                                PrometheusFileExporter,
                                                prometheus_text)
@@ -56,6 +57,7 @@ from deepspeed_tpu.telemetry.memscope import (MemoryPlan, PredictedOOMError,
                                               tree_bytes)
 
 __all__ = ["Telemetry", "MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "merge_snapshots",
            "PrometheusFileExporter", "JsonlExporter", "MonitorBridge",
            "prometheus_text", "ChromeTraceSink", "Span", "Tracer",
            "TraceContext", "FlightRecorder", "CompileWatchdog",

@@ -47,6 +47,34 @@ def load_latest(path):
     return record
 
 
+def load_pool(path):
+    """`--pool`: the latest record from EVERY `*.jsonl` metrics log under
+    the directory, merged into ONE pool snapshot (`merge_snapshots`:
+    counters sum, gauges keep a per-source map, histograms merge
+    bucket-wise exactly) keyed by file stem. Non-metrics JSONL files in
+    the same dir (trace/spool logs) are skipped — their records carry no
+    `metrics` field."""
+    p = pathlib.Path(path)
+    if p.is_file():
+        logs = [p]
+    elif p.is_dir():
+        logs = sorted(p.glob("*.jsonl"))
+    else:
+        logs = []
+    per, step, when = {}, 0, 0
+    for log in logs:
+        rec = load_latest(log)
+        if rec and rec.get("metrics"):
+            per[log.stem] = rec["metrics"]
+            step = max(step, int(rec.get("step", 0) or 0))
+            when = max(when, rec.get("time", 0) or 0)
+    if not per:
+        return None
+    from deepspeed_tpu.telemetry.registry import merge_snapshots
+    return {"step": step, "time": when, "sources": sorted(per),
+            "metrics": merge_snapshots(per)}
+
+
 def counter_rate(name, cur, prev):
     """Per-second rate of a counter between two snapshot records, or None
     when it cannot be computed (no previous record, metric absent/not a
@@ -113,13 +141,18 @@ def main(argv=None):
                          "(default: ./telemetry)")
     ap.add_argument("--json", action="store_true",
                     help="print the latest snapshot record as raw JSON")
+    ap.add_argument("--pool", action="store_true",
+                    help="merge the latest snapshot of EVERY *.jsonl in the "
+                         "dir into one pool view (counters sum, histograms "
+                         "merge bucket-wise — pool-exact percentiles)")
     ap.add_argument("--watch", action="store_true",
                     help="re-render every --interval seconds")
     ap.add_argument("--interval", type=float, default=2.0)
     args = ap.parse_args(argv)
 
     def emit(prev=None):
-        record = load_latest(args.path)
+        record = (load_pool(args.path) if args.pool
+                  else load_latest(args.path))
         if record is None:
             print(f"dstpu_metrics: no metrics log at {args.path!r}",
                   file=sys.stderr)

@@ -55,13 +55,20 @@ class FlightRecorder:
         self._ring = collections.deque(maxlen=max(1, int(capacity)))
         self._seq = 0
         self._dumps = 0
+        # event tap: the replica server's observability spool subscribes
+        # here so flight events can ship over the wire to the router. None
+        # (the default) costs one attribute read per record.
+        self.on_record = None
 
     def record(self, kind, **fields):
         if not self.enabled:
             return
         self._seq += 1
-        self._ring.append({"seq": self._seq, "t": self._clock(),
-                           "kind": kind, **fields})
+        ev = {"seq": self._seq, "t": self._clock(), "kind": kind, **fields}
+        self._ring.append(ev)
+        cb = self.on_record
+        if cb is not None:
+            cb(ev)
 
     def events(self):
         return list(self._ring)

@@ -22,7 +22,8 @@ import bisect
 import math
 import threading
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry"]
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "merge_snapshots"]
 
 
 class Counter:
@@ -139,7 +140,44 @@ class Histogram:
             "p50": self.quantile(0.50),
             "p90": self.quantile(0.90),
             "p99": self.quantile(0.99),
+            # full bucket state: a snapshot is MERGEABLE (bucket-wise, and
+            # exact because every process builds the same log-scale edges),
+            # so pool-level percentiles come from merged buckets instead of
+            # averaging per-replica p99s
+            "bounds": list(self.bounds),
+            "counts": list(self.counts),
         }
+
+    @classmethod
+    def from_state(cls, name, snap):
+        """Rebuild a histogram from a `snapshot()` dict (the wire/JSONL
+        form) so quantiles can be recomputed on the restored — or merged —
+        bucket state."""
+        h = cls(name, bounds=snap["bounds"])
+        h.counts = [int(c) for c in snap["counts"]]
+        h.count = int(snap["count"])
+        h.sum = float(snap["sum"])
+        if h.count:
+            h.min = float(snap["min"])
+            h.max = float(snap["max"])
+        return h
+
+    def merge(self, other):
+        """Accumulate another histogram bucket-wise (exact: identical
+        bounds required — the pool shares one bucket layout by
+        construction)."""
+        if self.bounds != other.bounds:
+            raise ValueError(
+                f"histogram {self.name!r}: cannot merge mismatched bucket "
+                f"bounds ({len(self.bounds)} vs {len(other.bounds)} edges)")
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.sum += other.sum
+        if other.min < self.min:
+            self.min = other.min
+        if other.max > self.max:
+            self.max = other.max
 
 
 class MetricsRegistry:
@@ -188,3 +226,61 @@ class MetricsRegistry:
     def clear(self):
         with self._lock:
             self._metrics = {}
+
+
+def merge_snapshots(per_source):
+    """Merge per-source registry snapshots into one pool-level snapshot.
+
+    `per_source` maps a source tag (replica id) to that source's
+    `MetricsRegistry.snapshot()` dict. Merge semantics, per metric type:
+
+      * **counters** sum across sources;
+      * **gauges** are NEVER summed blindly — the merged entry keeps a
+        per-source map (`"sources"`) as the authoritative record, with
+        `"value"` set to the across-source sum for the common pool-additive
+        gauges (queue depth, active slots); readers that need a different
+        aggregation (max degradation rung, min headroom) take it from
+        `"sources"`;
+      * **histograms** merge bucket-wise via the full bucket state the
+        snapshot carries — exact, because every process builds identical
+        log-scale edges — and percentiles are recomputed from the merged
+        buckets. The merged count equals the sum of per-source counts by
+        construction.
+
+    A type mismatch for one name across sources is a caller bug and raises;
+    the output dict is itself a valid snapshot (merged histograms carry
+    bounds/counts), so merges compose.
+    """
+    merged = {}
+    hists = {}
+    for src in sorted(per_source):
+        for name, snap in per_source[src].items():
+            kind = snap.get("type")
+            cur = merged.get(name)
+            if cur is not None and cur.get("type") != kind:
+                raise ValueError(
+                    f"metric {name!r}: type conflict across sources "
+                    f"({cur.get('type')} vs {kind} from {src!r})")
+            if kind == "counter":
+                if cur is None:
+                    merged[name] = {"type": "counter", "value": 0.0}
+                merged[name]["value"] += snap["value"]
+            elif kind == "gauge":
+                if cur is None:
+                    merged[name] = {"type": "gauge", "value": 0.0,
+                                    "sources": {}}
+                merged[name]["value"] += snap["value"]
+                merged[name]["sources"][src] = snap["value"]
+            elif kind == "histogram":
+                h = hists.get(name)
+                if h is None:
+                    hists[name] = Histogram.from_state(name, snap)
+                    merged[name] = {"type": "histogram"}  # placeholder
+                else:
+                    h.merge(Histogram.from_state(name, snap))
+            else:
+                raise ValueError(f"metric {name!r}: unknown snapshot type "
+                                 f"{kind!r} from {src!r}")
+    for name, h in hists.items():
+        merged[name] = h.snapshot()
+    return merged

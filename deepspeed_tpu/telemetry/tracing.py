@@ -82,6 +82,10 @@ class Tracer:
         self._t0 = None                    # chrome ts baseline (first stamp)
         self._lock = threading.Lock()
         self._named_tids = set()
+        # completed-span tap: the replica server's observability spool
+        # subscribes here so finished spans can ship over the wire to the
+        # router. None (the default) costs one attribute read per record.
+        self.on_record = None
 
     # ---- context lifecycle -------------------------------------------
 
@@ -209,6 +213,9 @@ class Tracer:
                 self._f = open(self.path, "a")
             self._f.write(json.dumps(rec) + "\n")
             self._f.flush()
+        cb = self.on_record
+        if cb is not None:
+            cb(rec)
 
     def close(self):
         with self._lock:

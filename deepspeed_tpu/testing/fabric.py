@@ -19,11 +19,16 @@ BS = 16   # kv_block_size == prefill_chunk, the test_router convention
 
 
 def tiny_serving_engine(seed: int = 0, max_slots: int = 2,
-                        max_context: int = 96, telemetry: bool = False,
+                        max_context: int = 96, telemetry=False,
                         **model_overrides):
     """A fresh `ServingEngine` over a tiny seeded fp32 GPT on a 1-chip
     mesh. Every kwarg is JSON-safe, so the whole recipe ships through
-    `dstpu_replica --kwargs`."""
+    `dstpu_replica --kwargs`.
+
+    `telemetry` is either a bool (True = bare enabled registry) or a full
+    telemetry config dict — the pod-observability tests pass
+    ``{"enabled": True, "tracing": True, "output_path": <per-replica dir>}``
+    so each subprocess replica records (and spools) into its OWN dir."""
     import jax.numpy as jnp
 
     from deepspeed_tpu.comm import mesh as mesh_mod
@@ -41,7 +46,9 @@ def tiny_serving_engine(seed: int = 0, max_slots: int = 2,
     inf_cfg: Dict[str, Any] = {
         "dtype": "float32", "kv_cache_dtype": "float32", "greedy": True,
         "kv_block_size": BS, "max_out_tokens": 64}
-    if telemetry:
+    if isinstance(telemetry, dict):
+        inf_cfg["telemetry"] = dict(telemetry)
+    elif telemetry:
         inf_cfg["telemetry"] = {"enabled": True}
     engine = init_inference(model=spec, config=inf_cfg)
     return engine.serving(max_slots=max_slots, max_context=max_context,

@@ -10,6 +10,10 @@ in-thread RPC tests share one module-scoped engine; only the kill -9 soak
 pays for real subprocesses.
 """
 
+import json
+import logging
+import os
+import pathlib
 import signal
 import socket
 import threading
@@ -19,7 +23,7 @@ import numpy as np
 import pytest
 
 from deepspeed_tpu.comm import mesh as mesh_mod
-from deepspeed_tpu.config.core import MeshConfig
+from deepspeed_tpu.config.core import MeshConfig, TelemetryConfig
 from deepspeed_tpu.inference.scheduler import (InadmissibleRequestError,
                                                CompletedRequest, Request)
 from deepspeed_tpu.serving import (Autoscaler, InProcessReplica,
@@ -35,9 +39,14 @@ from deepspeed_tpu.serving.transport import (FrameError, RemoteCallError,
                                              TransportTimeout,
                                              call_with_retry, decode_frame,
                                              encode_frame)
-from deepspeed_tpu.serving import pool_cli
+from deepspeed_tpu.serving import pool_cli, top_cli
+from deepspeed_tpu.serving.observability import (ObservabilitySpool,
+                                                 read_spool_file)
+from deepspeed_tpu.telemetry import Telemetry
+from deepspeed_tpu.telemetry.tracing import load_spans
 from deepspeed_tpu.testing.chaos import ChaosClock, kill_replica_process
 from deepspeed_tpu.testing import fabric as fabric_mod
+from deepspeed_tpu.utils.logging import logger as ds_logger
 
 pytestmark = pytest.mark.fabric
 
@@ -732,3 +741,334 @@ def test_router_quarantines_replica_dead_outside_step(inf_engine):
     assert router.stats()["replicas"]["ghost"]["health"] == "dead"
     # and stats() stayed serviceable throughout (no crash on unreachable)
     assert router.stats()["replicas"]["r0"]["health"] == "up"
+
+
+# ----------------------------------------------------------------------
+# pod observability plane: spool, merged percentiles, wire traces,
+# kill -9 post-mortem, dstpu_top
+# ----------------------------------------------------------------------
+
+
+def _chrome_events(path):
+    body = pathlib.Path(path).read_text()
+    assert body.startswith("[")
+    return [json.loads(ln.rstrip(",")) for ln in
+            body.strip().splitlines()[1:]]
+
+
+def test_obs_spool_cursor_idempotence_overflow_and_file(tmp_path):
+    """Satellite: bounded-spool overflow drops OLDEST-first and counts
+    `obs/spool_dropped`; a pull is a pure cursor read (retry-safe); the
+    on-disk mirror survives for the post-mortem reader, torn final line
+    and all."""
+    tel = Telemetry(TelemetryConfig(enabled=True, prometheus=False,
+                                    jsonl=False,
+                                    output_path=str(tmp_path)),
+                    subsystem="spooltest")
+    path = tmp_path / "spooltest.obs.spool.jsonl"
+    spool = ObservabilitySpool(path=path, capacity=4, telemetry=tel)
+    for i in range(10):
+        spool.append("span", {"span": i, "name": f"s{i}"})
+    out = spool.pull(0)
+    assert out["cursor"] == 10 and out["dropped"] == 6
+    # oldest-first drop: only the most recent `capacity` items remain
+    assert [it["cursor"] for it in out["items"]] == [7, 8, 9, 10]
+    # idempotent: the same cursor returns byte-identical data
+    assert spool.pull(0) == out
+    assert [it["cursor"] for it in spool.pull(8)["items"]] == [9, 10]
+    assert tel.registry.snapshot()["obs/spool_dropped"]["value"] == 6
+    # the disk mirror still holds EVERYTHING (no compaction yet): ring
+    # overflow must not erase what a post-mortem needs
+    assert [it["cursor"] for it in read_spool_file(path)] == \
+        list(range(1, 11))
+    assert read_spool_file(path, after_cursor=8)[0]["cursor"] == 9
+    # a torn final line — kill -9 landing mid-append — is skipped
+    with open(path, "a") as f:
+        f.write('{"cursor": 99, "kind": "span"')
+    assert [it["cursor"] for it in read_spool_file(path)][-1] == 10
+    # compaction keeps disk bounded once the file outgrows ~4x capacity
+    for i in range(10, 40):
+        spool.append("flight", {"seq": i})
+    disk = read_spool_file(path)
+    assert disk[-1]["cursor"] == 40
+    assert len(disk) <= 4 * spool.capacity + 1
+    tel.close()
+
+
+def test_attach_observability_warns_once_on_dark_remote(inf_engine,
+                                                        tmp_path):
+    """Satellite: router tracing on + remote engine telemetry off = the
+    replica's spans can never reach the pool trace. That must warn loudly
+    at attach — and exactly once per handle, including the re-attach after
+    a restart."""
+    app = ReplicaServerApp(_serving(inf_engine), heartbeat_interval_s=0.1)
+    app.server.serve_in_thread()
+    rep = RemoteReplica(host=app.server.host, port=app.server.port,
+                        replica_id="dark0",
+                        config=RemoteConfig(heartbeat_interval_s=0.1,
+                                            step_timeout_s=60.0))
+    messages = []
+    handler = logging.Handler()
+    handler.emit = lambda rec: messages.append(rec.getMessage())
+    ds_logger.addHandler(handler)
+    router = None
+    try:
+        router = ServingRouter(
+            replicas=[rep],
+            telemetry_config=TelemetryConfig(
+                enabled=True, output_path=str(tmp_path),
+                prometheus=False, jsonl=False, tracing=True))
+        warns = [m for m in messages if "ships nothing" in m]
+        assert len(warns) == 1 and "dark0" in warns[0]
+        assert rep.obs_spool_path is None
+        # the restart path re-attaches — still only ONE warning per handle
+        router._attach_observability("dark0")
+        assert len([m for m in messages if "ships nothing" in m]) == 1
+    finally:
+        ds_logger.removeHandler(handler)
+        if router is not None:
+            router.telemetry.close()
+        rep.close_transport()
+        app.server.shutdown()
+
+
+def test_pool_latency_merged_exact_from_inprocess(tmp_path):
+    """Satellite: `stats()["pool_latency"]` comes from bucket-wise MERGED
+    per-replica histograms — the merged count is EXACTLY the sum of the
+    per-replica counts (the acceptance equality), not an average of
+    percentiles."""
+    tel = {"enabled": True, "prometheus": False, "jsonl": False,
+           "output_path": str(tmp_path)}
+    srv0 = fabric_mod.tiny_serving_engine(telemetry=dict(tel))
+    srv1 = fabric_mod.tiny_serving_engine(telemetry=dict(tel))
+    router = ServingRouter(replicas=[srv0, srv1])
+    done = router.run([Request(uid=i, tokens=p, max_new_tokens=4,
+                               stop_on_eos=False)
+                       for i, p in enumerate(_prompts(6, seed=14))])
+    assert len(done) == 6
+    snap = router.observability_snapshot(refresh=True)
+    per_counts = {}
+    for rid, srv in (("r0", srv0), ("r1", srv1)):
+        h = srv.telemetry.registry.snapshot().get("serving/ttft_ms")
+        per_counts[rid] = int(h["count"]) if h else 0
+    assert min(per_counts.values()) >= 1        # both replicas served
+    merged = snap["pool_latency"]["serving/ttft_ms"]
+    assert merged["count"] == sum(per_counts.values()) == 6
+    for k in ("mean", "p50", "p90", "p99"):
+        assert merged[k] is not None
+    # the same merged view rides stats() — no wire refresh needed there
+    assert router.stats()["pool_latency"]["serving/ttft_ms"]["count"] == 6
+    # gauges merge tagged per-source, so one replica's degradation rung
+    # is never averaged away
+    lvl = snap["pool_metrics"].get("serving/degradation_level")
+    if lvl is not None:
+        assert set(lvl["sources"]) == {"r0", "r1"}
+
+
+def test_dstpu_top_renders_and_reads_snapshot_file(tmp_path, capsys):
+    snap = {"steps": 41, "queue_depth": 2, "in_flight": 3,
+            "live_replicas": 2,
+            "counters": {"completed": 9, "reroutes": 0},
+            "pool_latency": {"serving/ttft_ms": {
+                "count": 9, "mean": 12.5, "p50": 11.0, "p90": 30.0,
+                "p99": 44.0}},
+            "pool_metrics": {},
+            "replicas": {
+                "r0": {"role": "mixed", "health": "up", "restarts": 0,
+                       "queue": 1, "active": 2, "available_blocks": 7,
+                       "degradation_level": 1, "headroom_frac": 0.125,
+                       "obs": {"pid": 4242, "dropped": 3}},
+                "r1": {"role": "mixed", "health": "quarantined",
+                       "restarts": 1}},
+            "flight_events": [{"seq": 7, "t": 1.0, "kind": "scale_up",
+                               "replica": "auto0"}]}
+    text = top_cli.render_top(snap)
+    assert "steps=41" in text and "live=2/2" in text
+    assert "serving/ttft_ms" in text and "44.0" in text
+    lines = text.splitlines()
+    r0 = next(ln for ln in lines if ln.startswith("r0"))
+    assert "4242" in r0 and "0.125" in r0 and "up" in r0
+    r1 = next(ln for ln in lines if ln.startswith("r1"))
+    assert "quarantined" in r1
+    assert "completed=9" in text
+    assert "[7] scale_up replica=auto0" in text
+    # file mode + --json round-trip
+    p = tmp_path / "pool_snapshot.json"
+    p.write_text(json.dumps(snap))
+    assert top_cli.main([str(p)]) == 0
+    assert "serving/ttft_ms" in capsys.readouterr().out
+    assert top_cli.main([str(p), "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["steps"] == 41
+    assert top_cli.main([str(tmp_path / "nope.json")]) == 1
+    capsys.readouterr()
+
+
+def test_remote_pool_one_trace_and_kill9_postmortem(inf_engine, tmp_path):
+    """THE pod-observability acceptance gate, one soak: a 2-subprocess
+    pool with per-process telemetry lands every request in ONE router
+    trace file (one trace id per uid, per-process Perfetto tracks);
+    wire pulls are cursor-idempotent and never double-count; killing r0
+    with SIGKILL mid-trace recovers its final spans from the on-disk
+    spool into the flight dump, alongside the autoscaler's own flight
+    events."""
+    cfg = RemoteConfig(heartbeat_interval_s=0.2, heartbeat_miss_budget=4,
+                       step_timeout_s=300.0)
+    rtel = TelemetryConfig(enabled=True, output_path=str(tmp_path / "router"),
+                           prometheus=False, jsonl=False, tracing=True,
+                           flight_recorder=True,
+                           # park the live pull cadence out of reach: this
+                           # soak pulls explicitly, so the post-mortem is
+                           # guaranteed to find unpulled spool items
+                           export_interval=100_000)
+    procs = [ReplicaProcess(
+        factory=FACTORY,
+        factory_kwargs={"telemetry": {
+            "enabled": True, "tracing": True, "flight_recorder": True,
+            "prometheus": False, "jsonl": False,
+            "output_path": str(tmp_path / f"r{i}")}},
+        heartbeat_interval_s=0.2, replica_id=f"r{i}",
+        env={"JAX_PLATFORMS": "cpu"}).spawn() for i in range(2)]
+    handles = []
+    try:
+        for i, p in enumerate(procs):
+            p.wait_ready(180)
+            handles.append(RemoteReplica(process=p, replica_id=f"r{i}",
+                                         config=cfg))
+        router = ServingRouter(replicas=handles, max_replica_restarts=0,
+                               telemetry_config=rtel)
+        # the attach probe found a live plane on both ends: spool path +
+        # foreign pid cached for the post-mortem fallback
+        for h in handles:
+            assert h.obs_spool_path is not None
+            assert h.obs_pid != os.getpid()
+        # a mixed pool: the autoscaler joins an in-process replica under
+        # queue pressure, and its decision lands in the SAME flight ring
+        # the dump will snapshot
+        scaler = Autoscaler(router, spawn=_spawner(inf_engine, "obs"),
+                            min_replicas=2, max_replicas=3,
+                            scale_up_queue_per_replica=1.0, sustain_up=1,
+                            cooldown_ticks=0, warmup_prompts=0)
+        prompts = _prompts(8, seed=21)
+        for i, p in enumerate(prompts):
+            router.submit(Request(uid=i, tokens=p, max_new_tokens=5,
+                                  stop_on_eos=False))
+        assert scaler.tick() == "scale_up"
+        assert len(router.replicas) == 3
+
+        out, killed = {}, False
+        t0 = time.monotonic()
+        while router.in_flight or router._finished_buf:
+            assert time.monotonic() - t0 < 240, "soak wedged"
+            for d in router.step():
+                out[d.uid] = d
+            if not killed and any(rec.replica == "r0"
+                                  for rec in router._pending.values()):
+                kill_replica_process(handles[0], signal.SIGKILL)
+                killed = True
+        assert killed, "r0 never owned work — kill never fired"
+        assert sorted(out) == list(range(8))     # exactly-once completion
+        assert "r0" in router._dead              # restart budget was 0
+
+        # -- post-mortem: the victim's final spool came off DISK ---------
+        dumps = sorted((tmp_path / "router").glob("router.flightrec.*.json"))
+        assert dumps, "quarantine wrote no black box"
+        dump = json.loads(dumps[0].read_text())
+        pm = dump["state"]["postmortem"]
+        assert pm["replica"] == "r0"
+        assert pm["source"] == "spool_file"      # the wire was already dead
+        assert pm["spans"] >= 1
+        assert isinstance(pm["flight_events"], list)
+        kinds = [e["kind"] for e in dump["events"]]
+        assert "scale_up" in kinds               # autoscaler flight event
+        assert "quarantine" in kinds
+        reg = router.telemetry.registry.snapshot()
+        assert reg["obs/postmortem_recovered"]["value"] >= pm["spans"]
+
+        # -- wire pulls: idempotent, cursor-advancing, never double ------
+        p1 = handles[1].observability_pull(cursor=0)
+        p2 = handles[1].observability_pull(cursor=0)
+        assert p1["enabled"] and p1["items"] == p2["items"]
+        assert p1["cursor"] == p2["cursor"]
+        mid = p1["items"][len(p1["items"]) // 2]["cursor"]
+        tail = handles[1].observability_pull(cursor=mid)["items"]
+        assert tail == [it for it in p1["items"] if it["cursor"] > mid]
+
+        snap = router.observability_snapshot(refresh=True)
+        pulled = router.telemetry.registry.snapshot()
+        assert pulled["obs/pull_spans"]["value"] >= 1
+        assert pulled["obs/pull_bytes"]["value"] > 0
+        # a second refresh re-pulls from the advanced cursor: zero new
+        # spans ingested — the cursor contract holds end to end
+        router.observability_snapshot(refresh=True)
+        again = router.telemetry.registry.snapshot()
+        assert again["obs/pull_spans"]["value"] == \
+            pulled["obs/pull_spans"]["value"]
+        # merged pool count == sum of the pulled per-replica counts
+        merged = snap["pool_metrics"].get("serving/ttft_ms")
+        if merged is not None:
+            assert merged["count"] == sum(
+                int(m["serving/ttft_ms"]["count"])
+                for m in router._obs_metrics.values()
+                if "serving/ttft_ms" in m)
+        assert snap["replicas"]["r1"]["obs"]["pid"] == handles[1].obs_pid
+
+        # -- ONE trace: re-parented remote spans, per-process tracks -----
+        router.telemetry.close()
+        spans = load_spans(tmp_path / "router" / "router.trace.jsonl")
+        by_uid = {}
+        for s in spans:
+            if s.get("uid") in range(8):
+                by_uid.setdefault(s["uid"], set()).add(s["trace"])
+        for i in range(8):
+            assert len(by_uid[i]) == 1, f"uid {i} split across traces"
+        srcs = {s.get("attrs", {}).get("src") for s in spans}
+        assert "r0" in srcs        # the victim's recovered spans made it
+        assert "r1" in srcs        # the survivor's pulled spans made it
+        # remote spans ride their replica's track, not the router's
+        assert all(s["tid"] == router._tids[s["attrs"]["src"]]
+                   for s in spans if s.get("attrs", {}).get("src"))
+        # span ids stayed unique through the remap (no double-ingest)
+        sids = [s["span"] for s in spans]
+        assert len(sids) == len(set(sids))
+        evs = _chrome_events(tmp_path / "router" / "router.trace.json")
+        meta = {(e["name"], e.get("tid")): e["args"]["name"]
+                for e in evs if e["ph"] == "M"}
+        assert meta[("thread_name", 0)] == "router"
+        assert meta[("thread_name", router._tids["r0"])] == "replica r0"
+        assert meta[("thread_name", router._tids["r1"])] == "replica r1"
+        # greedy parity held through the failover (same seeded model)
+        refs = [inf_engine.generate(p[None], max_new_tokens=5,
+                                    stop_on_eos=False)[0] for p in prompts]
+        for i in range(8):
+            assert np.array_equal(out[i].tokens, refs[i]), i
+    finally:
+        for h in handles:
+            h.close()
+        for p in procs:
+            p.kill()
+            p.wait()
+
+
+def test_observability_off_default_zero_files(inf_engine, tmp_path,
+                                              monkeypatch):
+    """Acceptance: the observability-off default records nothing, spools
+    nothing, writes nothing — and the snapshot/stats surfaces stay
+    serviceable (just empty)."""
+    monkeypatch.chdir(tmp_path)
+    app = ReplicaServerApp(_serving(inf_engine))
+    try:
+        assert app.spool is None                     # no tap, no file
+        assert app._observability_pull({"cursor": 0}) == {"enabled": False}
+    finally:
+        app.server.shutdown()
+    router = ServingRouter(replicas=[_serving(inf_engine)])
+    done = router.run([Request(uid=i, tokens=p, max_new_tokens=3,
+                               stop_on_eos=False)
+                       for i, p in enumerate(_prompts(2, seed=15))])
+    assert len(done) == 2
+    assert "pool_latency" not in router.stats()      # {} stays absent
+    snap = router.observability_snapshot(refresh=True)
+    assert snap["pool_latency"] == {} and snap["pool_metrics"] == {}
+    assert snap["flight_events"] == []
+    assert snap["replicas"]["r0"]["health"] == "up"
+    assert os.listdir(tmp_path) == []                # zero files on disk

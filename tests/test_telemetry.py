@@ -23,7 +23,7 @@ from deepspeed_tpu.models.gpt import GPTConfig, make_gpt_decode_model, \
 from deepspeed_tpu.telemetry import (Histogram, JsonlExporter,
                                      MetricsRegistry, MonitorBridge,
                                      PrometheusFileExporter, Telemetry,
-                                     prometheus_text)
+                                     merge_snapshots, prometheus_text)
 from deepspeed_tpu.telemetry.cli import load_latest, main as metrics_main
 
 pytestmark = pytest.mark.telemetry
@@ -81,9 +81,13 @@ def test_histogram_bucket_and_percentile_math():
 
 def test_histogram_empty_and_single():
     h = Histogram("t")
-    assert h.snapshot() == {"type": "histogram", "count": 0, "sum": 0.0,
-                            "mean": 0.0, "min": 0.0, "max": 0.0, "p50": 0.0,
-                            "p90": 0.0, "p99": 0.0}
+    snap = h.snapshot()
+    # bounds/counts ride along so snapshots stay mergeable (PR 20)
+    assert snap.pop("bounds") == list(h.bounds)
+    assert snap.pop("counts") == [0] * len(h.counts)
+    assert snap == {"type": "histogram", "count": 0, "sum": 0.0,
+                    "mean": 0.0, "min": 0.0, "max": 0.0, "p50": 0.0,
+                    "p90": 0.0, "p99": 0.0}
     h.observe(42.0)
     s = h.snapshot()
     assert s["p50"] == s["p99"] == s["min"] == s["max"] == 42.0
@@ -114,6 +118,84 @@ def test_registry_get_or_create_identity():
     r.counter("c").inc()
     r.counter("c").inc()
     assert r.snapshot()["c"]["value"] == 2.0
+
+
+def test_merge_snapshots_exact_bucketwise():
+    """Pool merge semantics (PR 20): counters sum, gauges keep a per-source
+    map, histograms merge bucket-wise EXACTLY — the merged snapshot is
+    identical to one histogram that observed the union of all samples."""
+    rng = np.random.default_rng(4)
+    union = Histogram("serving/ttft_ms")
+    per, per_counts = {}, {}
+    for i, src in enumerate(("r0", "r1", "r2")):
+        r = MetricsRegistry()
+        h = r.histogram("serving/ttft_ms")
+        vals = rng.uniform(0.3, 8000.0, size=17 + 11 * i)
+        for v in vals:
+            h.observe(v)
+            union.observe(v)
+        per_counts[src] = h.count
+        r.counter("router/completed").inc(10 * (i + 1))
+        r.gauge("serving/queue_depth").set(i)
+        per[src] = r.snapshot()
+    merged = merge_snapshots(per)
+    m = merged["serving/ttft_ms"]
+    # the acceptance equality: merged count == sum of per-source counts,
+    # and the whole snapshot (percentiles included) matches the union.
+    # sum/mean differ only by float summation order (per-source subtotals
+    # vs interleaved observes) — everything bucket-derived is bit-exact
+    assert m["count"] == sum(per_counts.values())
+    u = union.snapshot()
+    for key in ("sum", "mean"):
+        assert m[key] == pytest.approx(u[key], rel=1e-12)
+    assert {k: v for k, v in m.items() if k not in ("sum", "mean")} == \
+        {k: v for k, v in u.items() if k not in ("sum", "mean")}
+    assert merged["router/completed"]["value"] == 10 + 20 + 30
+    g = merged["serving/queue_depth"]
+    assert g["sources"] == {"r0": 0, "r1": 1, "r2": 2}
+    assert g["value"] == 3          # across-source sum (pool-additive)
+    # merges compose: a merged snapshot is itself a valid source
+    again = merge_snapshots({"pool": merged, "r3": per["r0"]})
+    assert again["serving/ttft_ms"]["count"] == \
+        m["count"] + per_counts["r0"]
+
+
+def test_merge_snapshots_conflicts_raise():
+    c = {"x": {"type": "counter", "value": 1.0}}
+    g = {"x": {"type": "gauge", "value": 1.0}}
+    with pytest.raises(ValueError, match="type conflict"):
+        merge_snapshots({"a": c, "b": g})
+    with pytest.raises(ValueError, match="unknown snapshot type"):
+        merge_snapshots({"a": {"x": {"type": "nope"}}})
+    h1 = Histogram("h", bounds=[1.0, 2.0])
+    h2 = Histogram("h", bounds=[1.0, 2.0, 4.0])
+    with pytest.raises(ValueError, match="mismatched bucket"):
+        merge_snapshots({"a": {"h": h1.snapshot()},
+                         "b": {"h": h2.snapshot()}})
+
+
+def test_dstpu_metrics_pool_mode(tmp_path, capsys):
+    """`dstpu_metrics --pool`: the latest record of every *.jsonl in the
+    dir merges into one pool table; non-metrics JSONL (trace logs) are
+    skipped."""
+    for i, name in enumerate(("r0", "r1")):
+        h = Histogram("serving/ttft_ms")
+        for v in (5.0, 50.0 * (i + 1)):
+            h.observe(v)
+        rec = {"step": i + 1, "time": 100.0 + i,
+               "metrics": {"serving/ttft_ms": h.snapshot(),
+                           "router/completed":
+                               {"type": "counter", "value": 2.0}}}
+        (tmp_path / f"{name}.jsonl").write_text(json.dumps(rec) + "\n")
+    (tmp_path / "r0.trace.jsonl").write_text('{"span": 1, "trace": "t"}\n')
+    assert metrics_main([str(tmp_path), "--pool", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["sources"] == ["r0", "r1"]
+    assert out["metrics"]["serving/ttft_ms"]["count"] == 4
+    assert out["metrics"]["router/completed"]["value"] == 4.0
+    # human table renders the merged view too
+    assert metrics_main([str(tmp_path), "--pool"]) == 0
+    assert "serving/ttft_ms" in capsys.readouterr().out
 
 
 # ----------------------------------------------------------------------
